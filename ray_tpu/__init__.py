@@ -1,0 +1,177 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Ray-equivalent capabilities (see SURVEY.md for the reference blueprint),
+built TPU-first: tasks/actors/objects orchestrate *processes and hosts*;
+jax/XLA (pjit over device meshes, Pallas kernels, ICI/DCN collectives)
+owns the chip-level compute.  Public surface mirrors python/ray/__init__.py:
+``init/shutdown/remote/get/put/wait/cancel/kill`` plus the libraries
+(``ray_tpu.data``, ``.train``, ``.tune``, ``.serve``, ``.rl``).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional, Sequence, Union
+
+from ._version import version as __version__
+from . import exceptions
+from .core.actor import ActorClass, ActorHandle, ActorMethod, exit_actor
+from .core.config import GLOBAL_CONFIG
+from .core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .core.object_ref import ObjectRef, ObjectRefGenerator
+from .core.remote_function import RemoteFunction
+from .core import runtime as _runtime_mod
+from .core.runtime import (get_runtime, is_initialized, try_get_runtime)
+from .core.task_spec import (DefaultSchedulingStrategy,
+                             NodeAffinitySchedulingStrategy,
+                             NodeLabelSchedulingStrategy,
+                             PlacementGroupSchedulingStrategy,
+                             SpreadSchedulingStrategy)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "cancel", "kill", "get_actor", "method", "exit_actor", "nodes",
+    "cluster_resources", "available_resources", "get_runtime_context",
+    "ObjectRef", "ObjectRefGenerator", "ActorClass", "ActorHandle",
+    "exceptions", "timeline", "__version__",
+]
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: Optional[str] = None,
+         runtime_env: Optional[dict] = None,
+         ignore_reinit_error: bool = False,
+         log_to_driver: bool = True,
+         _system_config: Optional[Dict[str, Any]] = None,
+         **kwargs):
+    """Start (or connect to) the runtime.
+
+    Reference: ray.init (python/ray/_private/worker.py:1270).  With no
+    address this boots an in-process head (local node, scheduler, object
+    store).  ``address="auto"``/host:port attaches to a running cluster
+    (ray_tpu.core.node, cluster mode).
+    """
+    if is_initialized():
+        if ignore_reinit_error:
+            return get_runtime()
+        raise RuntimeError(
+            "ray_tpu.init() called twice — pass ignore_reinit_error=True "
+            "to allow")
+    if _system_config:
+        GLOBAL_CONFIG.update(_system_config)
+    if address not in (None, "local"):
+        from .core.node import connect_to_cluster
+
+        return connect_to_cluster(address, namespace=namespace or "",
+                                  runtime_env=runtime_env)
+    return _runtime_mod.init_runtime(
+        num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+        namespace=namespace or "", runtime_env=runtime_env)
+
+
+def shutdown():
+    _runtime_mod.shutdown_runtime()
+
+
+def _auto_init():
+    if not is_initialized():
+        _runtime_mod.init_runtime()
+    return get_runtime()
+
+
+def remote(*args, **kwargs):
+    """Decorator converting a function into a RemoteFunction or a class
+    into an ActorClass (reference: worker.py:3352)."""
+
+    def make(target, options):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        if not callable(target):
+            raise TypeError(f"@remote target must be callable, got "
+                            f"{type(target)}")
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return make(args[0], {})
+    if args:
+        raise TypeError("@remote accepts only keyword options, e.g. "
+                        "@remote(num_cpus=2)")
+    return lambda target: make(target, kwargs)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    return _auto_init().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _auto_init().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None,
+         fetch_local: bool = True):
+    return _auto_init().wait(refs, num_returns=num_returns, timeout=timeout,
+                             fetch_local=fetch_local)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    get_runtime().cancel(ref, force=force, recursive=recursive)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    if not isinstance(actor, ActorHandle):
+        raise TypeError(f"kill() expects an ActorHandle, got {type(actor)}")
+    get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    rt = get_runtime()
+    ns = namespace if namespace is not None else rt.namespace
+    actor_id = rt.actor_manager.get_named(name, ns)
+    if actor_id is None:
+        raise ValueError(
+            f"no actor named {name!r} in namespace {ns!r}")
+    return rt.actor_manager.get_handle(actor_id)
+
+
+def method(**options):
+    """Per-method default options decorator (reference: ray.method)."""
+
+    def decorator(fn):
+        fn.__ray_tpu_method_options__ = options
+        return fn
+
+    return decorator
+
+
+def get_runtime_context():
+    return get_runtime().runtime_context
+
+
+def nodes():
+    rt = get_runtime()
+    return [{
+        "NodeID": rt.node_id.hex(),
+        "Alive": True,
+        "Resources": rt.node_resources.total,
+        "alive": True,
+    }]
+
+
+def cluster_resources() -> Dict[str, float]:
+    return get_runtime().node_resources.total
+
+
+def available_resources() -> Dict[str, float]:
+    return get_runtime().node_resources.available()
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-trace export of task events (reference: ray.timeline,
+    _private/state.py:948)."""
+    from .observability.timeline import export_timeline
+
+    return export_timeline(filename)

@@ -1,0 +1,9 @@
+"""Core runtime: tasks, actors, objects, scheduling.
+
+TPU-native rethink of Ray core (reference: src/ray/core_worker/,
+src/ray/raylet/, src/ray/gcs/ — see SURVEY.md §1 L0-L6).  The compute data
+plane is jax/XLA (HBM-resident ``jax.Array`` objects, ICI collectives); the
+control plane here is a single-controller runtime with pluggable executors
+(in-process threads for local mode, worker processes over sockets for
+cluster mode).
+"""

@@ -1,0 +1,305 @@
+"""Actor execution: per-actor ordered queues, concurrency, restarts.
+
+Reference semantics:
+- Server side: TaskReceiver + scheduling queues — sequential by default,
+  threaded pool when ``max_concurrency > 1``, asyncio event loop for
+  async actors (src/ray/core_worker/transport/task_receiver.h:51,
+  actor_scheduling_queue.h, concurrency_group_manager.h, fiber.h).
+- Control: GCS actor FSM DEPENDENCIES_UNREADY → PENDING_CREATION → ALIVE
+  → RESTARTING/DEAD with ``max_restarts`` (gcs_actor_manager.h:308).
+- Naming: named/detached actors in a namespace (worker.py:3010).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import queue
+import threading
+from enum import Enum
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .ids import ActorID
+from .task_spec import TaskSpec
+from ..exceptions import (ActorDiedError, PendingCallsLimitExceededError)
+
+
+class ActorState(Enum):
+    PENDING_CREATION = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class ActorExitSignal(BaseException):
+    """Raised by exit_actor() inside a method to terminate the actor."""
+
+
+class _ActorCore:
+    """One live actor: instance + its execution queue/threads."""
+
+    def __init__(self, runtime, info: "ActorInfo"):
+        self._runtime = runtime
+        self.info = info
+        self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+        self._threads = []
+        self._stopped = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.instance: Any = None
+        self._creation_done = threading.Event()
+        self._creation_error: Optional[BaseException] = None
+
+        if info.is_async:
+            t = threading.Thread(target=self._async_main,
+                                 name=f"actor-{info.name or info.actor_id.hex()[:8]}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        else:
+            for i in range(max(1, info.max_concurrency)):
+                t = threading.Thread(
+                    target=self._sync_main,
+                    name=f"actor-{info.name or info.actor_id.hex()[:8]}-{i}",
+                    daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    # -- creation ------------------------------------------------------------
+    def create_instance(self):
+        info = self.info
+        try:
+            self.instance = info.klass(*info.init_args, **info.init_kwargs)
+            info.state = ActorState.ALIVE
+        except BaseException as e:  # noqa: BLE001
+            self._creation_error = e
+            info.state = ActorState.DEAD
+        finally:
+            self._creation_done.set()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> None:
+        if not self._creation_done.wait(timeout):
+            from ..exceptions import GetTimeoutError
+
+            raise GetTimeoutError(
+                f"actor {self.info.display_name()} not ready after "
+                f"{timeout}s")
+        if self._creation_error is not None:
+            raise ActorDiedError(
+                self.info.actor_id,
+                f"actor {self.info.display_name()} failed during creation: "
+                f"{self._creation_error!r}")
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, spec: TaskSpec):
+        if self.info.max_pending_calls > 0 and (
+                self._queue.qsize() >= self.info.max_pending_calls):
+            raise PendingCallsLimitExceededError(
+                f"actor {self.info.display_name()} has "
+                f"{self._queue.qsize()} pending calls "
+                f"(max_pending_calls={self.info.max_pending_calls})")
+        self._queue.put(spec)
+
+    # -- execution loops -----------------------------------------------------
+    def _sync_main(self):
+        while not self._stopped.is_set():
+            spec = self._queue.get()
+            if spec is None:
+                return
+            self._run_one(spec)
+
+    def _async_main(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        sem = asyncio.Semaphore(max(1, self.info.max_concurrency))
+
+        async def pump():
+            while not self._stopped.is_set():
+                spec = await self._loop.run_in_executor(None, self._queue.get)
+                if spec is None:
+                    return
+                await sem.acquire()
+                task = self._loop.create_task(self._run_one_async(spec))
+                task.add_done_callback(lambda _t: sem.release())
+
+        try:
+            self._loop.run_until_complete(pump())
+        finally:
+            pending = asyncio.all_tasks(self._loop)
+            for t in pending:
+                t.cancel()
+            self._loop.close()
+
+    def _run_one(self, spec: TaskSpec):
+        if spec.is_actor_creation:
+            self.create_instance()
+            self._runtime.finish_actor_creation(self, spec)
+            return
+        if self.info.state == ActorState.DEAD:
+            self._runtime.task_manager.complete_error(
+                spec, self._dead_error(), allow_retry=False)
+            return
+        self._runtime.execute_task_inline(
+            spec, bound_instance=self.instance, actor_core=self)
+
+    async def _run_one_async(self, spec: TaskSpec):
+        if spec.is_actor_creation:
+            self.create_instance()
+            self._runtime.finish_actor_creation(self, spec)
+            return
+        if self.info.state == ActorState.DEAD:
+            self._runtime.task_manager.complete_error(
+                spec, self._dead_error(), allow_retry=False)
+            return
+        await self._runtime.execute_task_inline_async(
+            spec, bound_instance=self.instance, actor_core=self)
+
+    def _dead_error(self) -> ActorDiedError:
+        return ActorDiedError(
+            self.info.actor_id,
+            f"actor {self.info.display_name()} is dead")
+
+    # -- teardown ------------------------------------------------------------
+    def stop(self):
+        self._stopped.set()
+        # Fail everything still queued.
+        try:
+            while True:
+                spec = self._queue.get_nowait()
+                if spec is not None:
+                    self._runtime.task_manager.complete_error(
+                        spec, self._dead_error(), allow_retry=False)
+        except queue.Empty:
+            pass
+        for _ in self._threads:
+            self._queue.put(None)
+
+
+class ActorInfo:
+    def __init__(self, actor_id: ActorID, klass: type, init_args, init_kwargs,
+                 *, name: str = "", namespace: str = "", max_restarts: int = 0,
+                 max_task_retries: int = 0,
+                 max_concurrency: Optional[int] = None,
+                 max_pending_calls: int = -1, lifetime: Optional[str] = None,
+                 resources: Optional[Dict[str, float]] = None):
+        self.actor_id = actor_id
+        self.klass = klass
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.name = name
+        self.namespace = namespace
+        self.max_restarts = max_restarts
+        self.max_task_retries = max_task_retries
+        self.max_pending_calls = max_pending_calls
+        self.lifetime = lifetime
+        self.resources = resources or {}
+        self.state = ActorState.PENDING_CREATION
+        self.num_restarts = 0
+        self.is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _n, m in inspect.getmembers(klass,
+                                            inspect.iscoroutinefunction))
+        # Async actors default to high concurrency (reference: actor.py —
+        # asyncio actors use max_concurrency=1000 unless set explicitly);
+        # sync actors default to 1 (ordered execution).
+        if max_concurrency is None:
+            max_concurrency = 1000 if self.is_async else 1
+        self.max_concurrency = max_concurrency
+
+    def display_name(self) -> str:
+        return self.name or f"{self.klass.__name__}({self.actor_id.hex()[:8]})"
+
+
+class ActorManager:
+    """Registry of actors — the in-process stand-in for the GCS actor
+    table (gcs_actor_manager.h)."""
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+        self._lock = threading.Lock()
+        self._cores: Dict[ActorID, _ActorCore] = {}
+        self._named: Dict[Tuple[str, str], ActorID] = {}
+
+    def create(self, info: ActorInfo) -> _ActorCore:
+        with self._lock:
+            key = (info.namespace, info.name)
+            if info.name:
+                if key in self._named:
+                    existing = self._cores.get(self._named[key])
+                    if existing is not None and existing.info.state not in (
+                            ActorState.DEAD,):
+                        raise ValueError(
+                            f"actor name {info.name!r} already taken in "
+                            f"namespace {info.namespace!r}")
+                self._named[key] = info.actor_id
+            core = _ActorCore(self._runtime, info)
+            self._cores[info.actor_id] = core
+            return core
+
+    def get_core(self, actor_id: ActorID) -> Optional[_ActorCore]:
+        with self._lock:
+            return self._cores.get(actor_id)
+
+    def get_named(self, name: str, namespace: str) -> Optional[ActorID]:
+        with self._lock:
+            return self._named.get((namespace, name))
+
+    def list_named(self, namespace: Optional[str] = None):
+        with self._lock:
+            return [
+                {"name": n, "namespace": ns, "actor_id": aid.hex()}
+                for (ns, n), aid in self._named.items()
+                if namespace is None or ns == namespace
+            ]
+
+    def actor_name(self, actor_id: ActorID) -> str:
+        core = self.get_core(actor_id)
+        return core.info.name if core else ""
+
+    def num_restarts(self, actor_id: ActorID) -> int:
+        core = self.get_core(actor_id)
+        return core.info.num_restarts if core else 0
+
+    def get_handle(self, actor_id: ActorID):
+        from .actor import ActorHandle
+
+        core = self.get_core(actor_id)
+        if core is None:
+            raise ValueError(f"no such actor: {actor_id!r}")
+        return ActorHandle(actor_id, core.info.klass, self._runtime)
+
+    def kill(self, actor_id: ActorID, no_restart: bool = True):
+        core = self.get_core(actor_id)
+        if core is None:
+            return
+        info = core.info
+        if (not no_restart and info.max_restarts != 0
+                and (info.max_restarts < 0
+                     or info.num_restarts < info.max_restarts)):
+            # Restart: new core, re-run constructor (state is lost —
+            # matches reference restart semantics).
+            info.num_restarts += 1
+            info.state = ActorState.RESTARTING
+            core.stop()
+            new_core = _ActorCore(self._runtime, info)
+            with self._lock:
+                self._cores[actor_id] = new_core
+            self._runtime.submit_actor_creation_for_restart(new_core)
+            return
+        info.state = ActorState.DEAD
+        core.stop()
+        with self._lock:
+            if info.name and self._named.get(
+                    (info.namespace, info.name)) == actor_id:
+                del self._named[(info.namespace, info.name)]
+
+    def shutdown(self):
+        with self._lock:
+            cores = list(self._cores.values())
+        for core in cores:
+            core.info.state = ActorState.DEAD
+            core.stop()
+
+    def num_alive(self) -> int:
+        with self._lock:
+            return sum(1 for c in self._cores.values()
+                       if c.info.state == ActorState.ALIVE)

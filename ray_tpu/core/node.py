@@ -1,0 +1,17 @@
+"""Cluster-mode attach point.
+
+Reference semantics: ray.init(address=...) connects a driver to a
+running cluster (worker.py:2256 connect()).  The multi-process cluster
+runtime (head/GCS + per-node workers over sockets) is under active
+construction; until it lands, attaching raises a clear error rather than
+silently degrading to local mode.
+"""
+
+from __future__ import annotations
+
+
+def connect_to_cluster(address: str, namespace: str = "",
+                       runtime_env=None):
+    raise NotImplementedError(
+        f"cluster attach (address={address!r}) is not available yet in "
+        f"this build — use ray_tpu.init() for the in-process runtime")

@@ -1,0 +1,172 @@
+"""In-memory object store: the owner's view of object values.
+
+Reference semantics: the core-worker in-process memory store
+(src/ray/core_worker/store_provider/memory_store/memory_store.h:43) —
+small/inlined results live here; big values live in the node's shared
+store (ray_tpu.core.plasma, cluster mode).  Objects are immutable once
+sealed; sealing fires completion callbacks (get waiters, dependency
+resolution, streaming consumers).
+
+TPU note: values may be ``jax.Array``s.  They are kept by reference (no
+copy, no host transfer) so HBM-resident arrays flow between tasks on the
+same process at zero cost; cross-process transfer goes through the
+serialization layer which devices-gets only at the boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .ids import ObjectID
+from ..exceptions import GetTimeoutError, ObjectFreedError
+
+
+class RayObject:
+    """A sealed object: exactly one of value / error is meaningful."""
+
+    __slots__ = ("value", "error", "size_bytes")
+
+    def __init__(self, value: Any = None, error: Optional[BaseException] = None,
+                 size_bytes: int = 0):
+        self.value = value
+        self.error = error
+        self.size_bytes = size_bytes
+
+    def is_error(self) -> bool:
+        return self.error is not None
+
+
+class MemoryStore:
+    """Thread-safe object table with completion events + callbacks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[ObjectID, RayObject] = {}
+        self._events: Dict[ObjectID, threading.Event] = {}
+        self._callbacks: Dict[ObjectID, List[Callable[[RayObject], None]]] = {}
+        self._total_bytes = 0
+
+    # -- write side ----------------------------------------------------------
+    def put(self, object_id: ObjectID, obj: RayObject) -> None:
+        with self._lock:
+            if object_id in self._objects:
+                # Objects are immutable: double-seal keeps the first value.
+                # (Happens on speculative retries racing a slow original.)
+                return
+            self._objects[object_id] = obj
+            self._total_bytes += obj.size_bytes
+            event = self._events.pop(object_id, None)
+            callbacks = self._callbacks.pop(object_id, [])
+        if event is not None:
+            event.set()
+        for cb in callbacks:
+            cb(obj)
+
+    def free(self, object_id: ObjectID) -> None:
+        with self._lock:
+            obj = self._objects.pop(object_id, None)
+            if obj is not None:
+                self._total_bytes -= obj.size_bytes
+            self._events.pop(object_id, None)
+            self._callbacks.pop(object_id, None)
+
+    def replace_with_error(self, object_id: ObjectID, error: BaseException):
+        """Used by GC/eviction to leave a tombstone."""
+        with self._lock:
+            old = self._objects.pop(object_id, None)
+            if old is not None:
+                self._total_bytes -= old.size_bytes
+            self._objects[object_id] = RayObject(error=error)
+
+    # -- read side -----------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_if_exists(self, object_id: ObjectID) -> Optional[RayObject]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def completion_event(self, object_id: ObjectID) -> threading.Event:
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                ev = threading.Event()
+                ev.set()
+                return ev
+            ev = self._events.get(object_id)
+            if ev is None:
+                ev = threading.Event()
+                self._events[object_id] = ev
+            return ev
+
+    def add_done_callback(self, object_id: ObjectID,
+                          callback: Callable[[RayObject], None]):
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None:
+                self._callbacks.setdefault(object_id, []).append(callback)
+                return
+        callback(obj)
+
+    def wait_and_get(self, object_id: ObjectID,
+                     timeout: Optional[float] = None) -> RayObject:
+        ev = self.completion_event(object_id)
+        if not ev.wait(timeout):
+            raise GetTimeoutError(
+                f"get() timed out after {timeout}s waiting for {object_id!r}"
+            )
+        with self._lock:
+            obj = self._objects.get(object_id)
+        if obj is None:
+            # Freed between event set and read.
+            raise ObjectFreedError(reason=f"{object_id!r} was freed")
+        return obj
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "total_bytes": self._total_bytes,
+                "num_waiters": len(self._events),
+            }
+
+
+def wait_refs(store: MemoryStore, object_ids, num_returns: int,
+              timeout: Optional[float]):
+    """Core of ``ray.wait``: first-completed ordering, stable within ready.
+
+    Reference: CoreWorker::Wait (core_worker.cc:1901) — returns
+    (ready, not_ready) preserving input order among the ready set.
+    """
+    deadline = None if timeout is None else time.monotonic() + timeout
+    ready: List = []
+    pending = list(object_ids)
+    done = threading.Event()
+    lock = threading.Lock()
+
+    def make_cb(oid):
+        def cb(_obj):
+            with lock:
+                if oid not in ready:
+                    ready.append(oid)
+                if len(ready) >= num_returns:
+                    done.set()
+
+        return cb
+
+    for oid in pending:
+        store.add_done_callback(oid, make_cb(oid))
+
+    if deadline is None:
+        done.wait()
+    else:
+        done.wait(max(0.0, deadline - time.monotonic()))
+
+    with lock:
+        ready_set = set(ready[:num_returns])
+    ready_ordered = [o for o in object_ids if o in ready_set]
+    not_ready = [o for o in object_ids if o not in ready_set]
+    return ready_ordered, not_ready

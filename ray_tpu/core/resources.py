@@ -1,0 +1,117 @@
+"""Resource accounting.
+
+Reference semantics: src/ray/common/scheduling/ — a node advertises a
+total resource set ({"CPU": n, "TPU": m, custom...}); tasks demand
+resources which are acquired at dispatch and released at completion.
+TPU note: a TPU host additionally advertises topology labels
+(``TPU-v5p-16-head``, ICI coordinates) so placement can pack along the
+torus — see ray_tpu.parallel.mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class ResourceSet:
+    def __init__(self, total: Dict[str, float]):
+        self._total = {k: float(v) for k, v in total.items() if v}
+        self._available = dict(self._total)
+        self._cond = threading.Condition()
+
+    @property
+    def total(self) -> Dict[str, float]:
+        return dict(self._total)
+
+    def available(self) -> Dict[str, float]:
+        with self._cond:
+            return dict(self._available)
+
+    def can_ever_fit(self, demand: Dict[str, float]) -> bool:
+        return all(self._total.get(k, 0.0) >= v for k, v in demand.items())
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        with self._cond:
+            if all(self._available.get(k, 0.0) >= v - 1e-9
+                   for k, v in demand.items()):
+                for k, v in demand.items():
+                    self._available[k] = self._available.get(k, 0.0) - v
+                return True
+            return False
+
+    def acquire(self, demand: Dict[str, float],
+                timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: all(self._available.get(k, 0.0) >= v - 1e-9
+                            for k, v in demand.items()),
+                timeout,
+            )
+            if not ok:
+                return False
+            for k, v in demand.items():
+                self._available[k] = self._available.get(k, 0.0) - v
+            return True
+
+    def release(self, demand: Dict[str, float]):
+        with self._cond:
+            for k, v in demand.items():
+                self._available[k] = min(
+                    self._total.get(k, 0.0), self._available.get(k, 0.0) + v
+                )
+            self._cond.notify_all()
+
+    def add_capacity(self, extra: Dict[str, float]):
+        """Used by placement groups to mint bundle resources."""
+        with self._cond:
+            for k, v in extra.items():
+                self._total[k] = self._total.get(k, 0.0) + v
+                self._available[k] = self._available.get(k, 0.0) + v
+            self._cond.notify_all()
+
+    def remove_capacity(self, extra: Dict[str, float]):
+        with self._cond:
+            for k, v in extra.items():
+                self._total[k] = max(0.0, self._total.get(k, 0.0) - v)
+                self._available[k] = max(
+                    0.0, self._available.get(k, 0.0) - v)
+            self._cond.notify_all()
+
+
+def detect_node_resources(num_cpus: Optional[float] = None,
+                          num_tpus: Optional[float] = None,
+                          resources: Optional[Dict[str, float]] = None
+                          ) -> Dict[str, float]:
+    """Auto-detect this host's resources (reference:
+    _private/accelerators/tpu.py detects TPU chips via env/libtpu)."""
+    import os
+
+    total: Dict[str, float] = {}
+    total["CPU"] = float(num_cpus if num_cpus is not None
+                         else os.cpu_count() or 1)
+    if num_tpus is None:
+        try:
+            import jax
+
+            num_tpus = float(len([d for d in jax.devices()
+                                  if d.platform != "cpu"]))
+        except Exception:
+            num_tpus = 0.0
+    if num_tpus:
+        total["TPU"] = float(num_tpus)
+    total["memory"] = float(_detect_memory_bytes())
+    if resources:
+        total.update({k: float(v) for k, v in resources.items()})
+    return total
+
+
+def _detect_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 8 * 1024**3

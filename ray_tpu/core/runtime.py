@@ -1,0 +1,549 @@
+"""The single-controller runtime: init/shutdown, task submission, execution.
+
+Reference semantics: this file plays the role of CoreWorker
+(src/ray/core_worker/core_worker.h:162) + the driver-side of worker.py —
+it owns the object store view, reference counter, task manager, local
+scheduler, and actor manager, and it executes user code (the in-process
+analogue of the task-execution callback, _raylet.pyx:2244).
+
+Architecture note (TPU-first): the runtime is deliberately
+single-controller per process.  Distributed execution attaches node
+backends (ray_tpu.core.node, cluster mode) underneath the same submission
+API; SPMD compute *inside* a task is jax's job (pjit over a Mesh), not
+the runtime's — the runtime orchestrates processes and objects, XLA
+orchestrates chips.
+"""
+
+from __future__ import annotations
+
+import atexit
+import inspect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from . import runtime_context as rc_mod
+from .actor_runtime import (ActorExitSignal, ActorInfo, ActorManager,
+                            ActorState)
+from .config import GLOBAL_CONFIG
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .object_ref import ObjectRef, ObjectRefGenerator
+from .object_store import MemoryStore, RayObject, wait_refs
+from .reference_count import ReferenceCounter
+from .resources import ResourceSet, detect_node_resources
+from .runtime_context import RuntimeContext, TaskContext
+from .scheduler import LocalScheduler
+from .streaming import StreamingGeneratorManager
+from .task_manager import TaskManager, _sizeof
+from .task_spec import (STREAMING, FunctionDescriptor, TaskOptions, TaskSpec)
+from ..exceptions import TaskCancelledError, TaskError
+
+_global_lock = threading.Lock()
+_global_runtime: Optional["Runtime"] = None
+
+
+class Runtime:
+    def __init__(self, *, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 namespace: str = "", runtime_env: Optional[dict] = None,
+                 job_id: Optional[JobID] = None):
+        self.job_id = job_id or JobID.from_int(1)
+        self.node_id = NodeID.from_random()
+        self.worker_id = WorkerID.from_random()
+        self.namespace = namespace or "default"
+        self.runtime_env = runtime_env
+        self.is_shutdown = False
+        self.start_time = time.time()
+
+        self.object_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(
+            on_object_out_of_scope=self.object_store.free)
+        self.streaming_manager = StreamingGeneratorManager()
+        self.task_manager = TaskManager(self)
+        self.node_resources = ResourceSet(
+            detect_node_resources(num_cpus, num_tpus, resources))
+        self.scheduler = LocalScheduler(
+            self.node_resources,
+            execute_fn=self.execute_task_inline,
+            on_cancelled=self._on_task_cancelled,
+            object_store=self.object_store)
+        self.actor_manager = ActorManager(self)
+        self.runtime_context = RuntimeContext(self)
+
+        self._driver_task_id = TaskID.for_driver(self.job_id)
+        self._put_counters: Dict[TaskID, int] = {}
+        self._put_lock = threading.Lock()
+        self._pg_counter = 0
+
+    # ------------------------------------------------------------------ ids
+    def current_task_id(self) -> TaskID:
+        ctx = rc_mod.current_task_context()
+        return ctx.task_id if ctx else self._driver_task_id
+
+    def _next_put_id(self) -> ObjectID:
+        task_id = self.current_task_id()
+        with self._put_lock:
+            idx = self._put_counters.get(task_id, 0)
+            self._put_counters[task_id] = idx + 1
+        return ObjectID.for_put(task_id, idx)
+
+    # ------------------------------------------------------------- objects
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed "
+                            "(matches reference semantics)")
+        oid = self._next_put_id()
+        self.reference_counter.add_owned_object(oid)
+        self.object_store.put(
+            oid, RayObject(value=value, size_bytes=_sizeof(value)))
+        return ObjectRef(oid, self)
+
+    def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]],
+            timeout: Optional[float] = None):
+        single = isinstance(refs, (ObjectRef, ObjectRefGenerator))
+        if single:
+            ref_list = [refs]
+        else:
+            try:
+                ref_list = list(refs)
+            except TypeError:
+                raise TypeError(
+                    f"get() expects an ObjectRef or a list of ObjectRefs, "
+                    f"got {type(refs).__name__}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        values = []
+        for ref in ref_list:
+            if isinstance(ref, ObjectRefGenerator):
+                raise TypeError(
+                    "get() on a streaming generator — iterate it instead")
+            if not isinstance(ref, ObjectRef):
+                raise TypeError(f"get() expects ObjectRefs, got {type(ref)}")
+            t = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            obj = self.object_store.wait_and_get(ref.object_id(), t)
+            if obj.is_error():
+                raise obj.error
+            values.append(obj.value)
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if not isinstance(refs, list):
+            raise TypeError("wait() expects a list of ObjectRefs")
+        if len(set(r.object_id() for r in refs)) != len(refs):
+            raise ValueError("wait() got duplicate ObjectRefs")
+        if num_returns <= 0 or num_returns > len(refs):
+            raise ValueError(f"num_returns must be in [1, {len(refs)}]")
+        by_id = {r.object_id(): r for r in refs}
+        ready_ids, not_ready_ids = wait_refs(
+            self.object_store, [r.object_id() for r in refs], num_returns,
+            timeout)
+        return ([by_id[i] for i in ready_ids],
+                [by_id[i] for i in not_ready_ids])
+
+    # --------------------------------------------------------------- tasks
+    def make_task_spec(self, function, args, kwargs,
+                       options: TaskOptions) -> TaskSpec:
+        parent = self.current_task_id()
+        task_id = TaskID.for_task(ActorID.nil_for_job(self.job_id))
+        n = options.num_returns
+        if n == STREAMING:
+            return_ids = (ObjectID.for_return(task_id, 0),)
+        else:
+            return_ids = tuple(
+                ObjectID.for_return(task_id, i) for i in range(int(n)))
+        return TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            function=function,
+            descriptor=FunctionDescriptor.from_function(function),
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            num_returns=n,
+            resources=options.resource_demand(),
+            max_retries=options.max_retries,
+            retry_exceptions=options.retry_exceptions,
+            scheduling_strategy=options.scheduling_strategy,
+            name=options.name,
+            parent_task_id=parent,
+            return_ids=return_ids,
+        )
+
+    def submit_task(self, function, args, kwargs, options: TaskOptions):
+        spec = self.make_task_spec(function, args, kwargs, options)
+        self._apply_pg_strategy(spec)
+        self._register_and_submit(spec)
+        return self._refs_for(spec)
+
+    def resubmit_task(self, spec: TaskSpec):
+        delay_ms = GLOBAL_CONFIG.task_retry_delay_ms()
+        if delay_ms:
+            timer = threading.Timer(
+                delay_ms / 1000.0, lambda: self.scheduler.submit(spec))
+            timer.daemon = True
+            timer.start()
+        else:
+            self.scheduler.submit(spec)
+
+    def _register_and_submit(self, spec: TaskSpec):
+        self.task_manager.register_pending(spec)
+        arg_ids = [a.object_id() for a in spec.args
+                   if isinstance(a, ObjectRef)]
+        arg_ids += [v.object_id() for v in spec.kwargs.values()
+                    if isinstance(v, ObjectRef)]
+        self.reference_counter.add_submitted_task_references(arg_ids)
+        if spec.num_returns == STREAMING:
+            self.streaming_manager.create_stream(spec.return_ids[0])
+        self.scheduler.submit(spec)
+
+    def _refs_for(self, spec: TaskSpec):
+        if spec.num_returns == STREAMING:
+            return ObjectRefGenerator(spec.return_ids[0], self)
+        refs = [ObjectRef(oid, self, call_site=spec.repr_name())
+                for oid in spec.return_ids]
+        if spec.num_returns == 0:
+            return None
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs
+
+    def _apply_pg_strategy(self, spec: TaskSpec):
+        """Rewrite resource demand onto placement-group synthetic
+        resources (reference A.13: CPU_group_<pgid> resources)."""
+        from ..util.placement_group import PlacementGroupSchedulingStrategy
+
+        strat = spec.scheduling_strategy
+        if not isinstance(strat, PlacementGroupSchedulingStrategy):
+            return
+        pg = strat.placement_group
+        spec.resources = pg.wrap_resources(
+            spec.resources, strat.placement_group_bundle_index)
+
+    # ----------------------------------------------------------- execution
+    def _resolve_args(self, spec: TaskSpec):
+        """Top-level ObjectRef substitution; returns (args, kwargs, error)."""
+        error = None
+
+        def resolve(v):
+            nonlocal error
+            if isinstance(v, ObjectRef):
+                obj = self.object_store.get_if_exists(v.object_id())
+                if obj is None:
+                    raise RuntimeError(
+                        f"dependency {v!r} not local at dispatch time")
+                if obj.is_error() and error is None:
+                    error = obj.error
+                    return None
+                return obj.value
+            return v
+
+        args = tuple(resolve(a) for a in spec.args)
+        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs, error
+
+    def _release_arg_refs(self, spec: TaskSpec):
+        arg_ids = [a.object_id() for a in spec.args
+                   if isinstance(a, ObjectRef)]
+        arg_ids += [v.object_id() for v in spec.kwargs.values()
+                    if isinstance(v, ObjectRef)]
+        self.reference_counter.remove_submitted_task_references(arg_ids)
+
+    def _lookup_callable(self, spec: TaskSpec, bound_instance):
+        if bound_instance is not None and spec.is_actor_task:
+            return getattr(bound_instance, spec.descriptor.function_name)
+        return spec.function
+
+    def execute_task_inline(self, spec: TaskSpec, bound_instance=None,
+                            actor_core=None):
+        args, kwargs, dep_error = self._resolve_args(spec)
+        if dep_error is not None:
+            # Dependency failed: propagate its error to our outputs
+            # without retrying (matches owner failure propagation).
+            self.task_manager.complete_error(spec, dep_error,
+                                             allow_retry=False)
+            return
+        ctx = TaskContext(spec.task_id, spec.repr_name(),
+                          actor_id=spec.actor_id,
+                          attempt_number=spec.attempt_number,
+                          parent_task_id=spec.parent_task_id)
+        rc_mod.set_task_context(ctx)
+        try:
+            fn = self._lookup_callable(spec, bound_instance)
+            result = fn(*args, **kwargs)
+            if spec.num_returns == STREAMING:
+                self._consume_stream(spec, result)
+            else:
+                self.task_manager.complete_success(spec, result)
+        except ActorExitSignal:
+            self.task_manager.complete_success(spec, None)
+            if actor_core is not None:
+                self.kill_actor(spec.actor_id, no_restart=True)
+        except TaskCancelledError as e:
+            self.task_manager.complete_error(spec, e, allow_retry=False)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError(
+                spec.repr_name(), e)
+            self.task_manager.complete_error(spec, err)
+        finally:
+            rc_mod.set_task_context(None)
+
+    async def execute_task_inline_async(self, spec: TaskSpec,
+                                        bound_instance=None,
+                                        actor_core=None):
+        args, kwargs, dep_error = self._resolve_args(spec)
+        if dep_error is not None:
+            self.task_manager.complete_error(spec, dep_error,
+                                             allow_retry=False)
+            return
+        ctx = TaskContext(spec.task_id, spec.repr_name(),
+                          actor_id=spec.actor_id,
+                          attempt_number=spec.attempt_number)
+        rc_mod.set_task_context(ctx)
+        try:
+            fn = self._lookup_callable(spec, bound_instance)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            if spec.num_returns == STREAMING:
+                if inspect.isasyncgen(result):
+                    items = []
+                    async for item in result:
+                        self._seal_stream_item(spec, len(items), item)
+                        items.append(None)
+                    self.streaming_manager.finish(spec.return_ids[0])
+                else:
+                    self._consume_stream(spec, result)
+            else:
+                self.task_manager.complete_success(spec, result)
+        except ActorExitSignal:
+            self.task_manager.complete_success(spec, None)
+            if actor_core is not None:
+                self.kill_actor(spec.actor_id, no_restart=True)
+        except TaskCancelledError as e:
+            self.task_manager.complete_error(spec, e, allow_retry=False)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError(
+                spec.repr_name(), e)
+            self.task_manager.complete_error(spec, err)
+        finally:
+            rc_mod.set_task_context(None)
+
+    def _seal_stream_item(self, spec: TaskSpec, index: int, item):
+        item_id = ObjectID.for_return(spec.task_id, index + 1)
+        self.reference_counter.add_owned_object(item_id)
+        self.object_store.put(
+            item_id, RayObject(value=item, size_bytes=_sizeof(item)))
+        self.streaming_manager.report_item(spec.return_ids[0], item_id)
+
+    def _consume_stream(self, spec: TaskSpec, generator):
+        try:
+            for i, item in enumerate(generator):
+                self._seal_stream_item(spec, i, item)
+            self.streaming_manager.finish(spec.return_ids[0])
+            self.task_manager.complete_success(spec, None)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError(
+                spec.repr_name(), e)
+            self.task_manager.complete_error(spec, err, allow_retry=False)
+            self.streaming_manager.finish(spec.return_ids[0])
+
+    def _on_task_cancelled(self, spec: TaskSpec):
+        self.task_manager.complete_error(
+            spec, TaskCancelledError(spec.task_id), allow_retry=False)
+
+    # --------------------------------------------------------------- actors
+    def create_actor(self, klass: type, args, kwargs, *,
+                     name: str = "", namespace: Optional[str] = None,
+                     max_restarts: int = 0, max_task_retries: int = 0,
+                     max_concurrency: Optional[int] = None,
+                     max_pending_calls: int = -1,
+                     lifetime: Optional[str] = None,
+                     num_cpus: Optional[float] = None,
+                     num_tpus: Optional[float] = None,
+                     resources: Optional[Dict[str, float]] = None,
+                     scheduling_strategy=None,
+                     get_if_exists: bool = False):
+        from .actor import ActorHandle
+
+        ns = namespace if namespace is not None else self.namespace
+        if get_if_exists and name:
+            existing = self.actor_manager.get_named(name, ns)
+            if existing is not None:
+                return self.actor_manager.get_handle(existing)
+
+        actor_id = ActorID.of(self.job_id)
+        demand: Dict[str, float] = dict(resources or {})
+        # Actors default to 1 CPU for *placement* but hold 0 while idle in
+        # the reference; in-process we hold what was requested explicitly.
+        if num_cpus:
+            demand["CPU"] = float(num_cpus)
+        if num_tpus:
+            demand["TPU"] = float(num_tpus)
+        from ..util.placement_group import PlacementGroupSchedulingStrategy
+
+        if isinstance(scheduling_strategy, PlacementGroupSchedulingStrategy):
+            demand = scheduling_strategy.placement_group.wrap_resources(
+                demand, scheduling_strategy.placement_group_bundle_index)
+
+        if demand and not self.node_resources.can_ever_fit(demand):
+            raise ValueError(
+                f"actor {klass.__name__} demands {demand}, which can never "
+                f"be satisfied by node resources {self.node_resources.total}")
+
+        info = ActorInfo(
+            actor_id, klass, args, kwargs, name=name or "", namespace=ns,
+            max_restarts=max_restarts, max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            max_pending_calls=max_pending_calls, lifetime=lifetime,
+            resources=demand)
+        core = self.actor_manager.create(info)
+
+        creation_task_id = TaskID.for_task(actor_id)
+        creation_spec = TaskSpec(
+            task_id=creation_task_id, job_id=self.job_id, function=None,
+            descriptor=FunctionDescriptor.from_class(klass),
+            args=(), kwargs={}, num_returns=1, resources={},
+            max_retries=0, retry_exceptions=False,
+            actor_id=actor_id, is_actor_creation=True,
+            return_ids=(ObjectID.for_return(creation_task_id, 0),),
+        )
+        self.task_manager.register_pending(creation_spec)
+
+        def acquire_and_go():
+            if demand:
+                self.node_resources.acquire(demand)
+            core.submit(creation_spec)
+
+        threading.Thread(target=acquire_and_go, daemon=True).start()
+        return ActorHandle(actor_id, klass, self,
+                           creation_ref=ObjectRef(
+                               creation_spec.return_ids[0], self))
+
+    def finish_actor_creation(self, core, spec: TaskSpec):
+        if core.info.state == ActorState.ALIVE:
+            self.task_manager.complete_success(spec, None)
+        else:
+            from ..exceptions import ActorDiedError
+
+            err = ActorDiedError(
+                core.info.actor_id,
+                f"actor {core.info.display_name()} failed during creation: "
+                f"{core._creation_error!r}")
+            self.task_manager.complete_error(spec, err, allow_retry=False)
+            if core.info.resources:
+                self.node_resources.release(core.info.resources)
+            core.stop()
+
+    def submit_actor_creation_for_restart(self, core):
+        creation_task_id = TaskID.for_task(core.info.actor_id)
+        spec = TaskSpec(
+            task_id=creation_task_id, job_id=self.job_id, function=None,
+            descriptor=FunctionDescriptor.from_class(core.info.klass),
+            args=(), kwargs={}, num_returns=1, resources={},
+            max_retries=0, retry_exceptions=False,
+            actor_id=core.info.actor_id, is_actor_creation=True,
+            return_ids=(ObjectID.for_return(creation_task_id, 0),),
+        )
+        self.task_manager.register_pending(spec)
+        core.submit(spec)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args, kwargs, options: TaskOptions):
+        core = self.actor_manager.get_core(actor_id)
+        if core is None:
+            raise ValueError(f"no such actor {actor_id!r}")
+        from ..exceptions import ActorDiedError
+
+        task_id = TaskID.for_task(actor_id)
+        n = options.num_returns
+        if n == STREAMING:
+            return_ids = (ObjectID.for_return(task_id, 0),)
+        else:
+            return_ids = tuple(
+                ObjectID.for_return(task_id, i) for i in range(int(n)))
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, function=None,
+            descriptor=FunctionDescriptor(
+                core.info.klass.__module__, method_name,
+                core.info.klass.__qualname__),
+            args=tuple(args), kwargs=dict(kwargs), num_returns=n,
+            resources={}, max_retries=options.max_retries,
+            retry_exceptions=options.retry_exceptions,
+            name=options.name, actor_id=actor_id, is_actor_task=True,
+            parent_task_id=self.current_task_id(), return_ids=return_ids)
+        self.task_manager.register_pending(spec)
+        arg_ids = [a.object_id() for a in spec.args
+                   if isinstance(a, ObjectRef)]
+        arg_ids += [v.object_id() for v in spec.kwargs.values()
+                    if isinstance(v, ObjectRef)]
+        self.reference_counter.add_submitted_task_references(arg_ids)
+        if n == STREAMING:
+            self.streaming_manager.create_stream(spec.return_ids[0])
+        if core.info.state == ActorState.DEAD:
+            self.task_manager.complete_error(
+                spec, ActorDiedError(actor_id, "actor is dead"),
+                allow_retry=False)
+        else:
+            core.submit(spec)
+        return self._refs_for(spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        core = self.actor_manager.get_core(actor_id)
+        self.actor_manager.kill(actor_id, no_restart)
+        if (core is not None and core.info.state == ActorState.DEAD
+                and core.info.resources):
+            self.node_resources.release(core.info.resources)
+
+    # ------------------------------------------------------------- cancel
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = True):
+        self.scheduler.cancel(ref.task_id(), force=force,
+                              recursive=recursive)
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self):
+        if self.is_shutdown:
+            return
+        self.is_shutdown = True
+        self.actor_manager.shutdown()
+        self.scheduler.shutdown()
+
+
+# ---------------------------------------------------------------- global API
+def init_runtime(**kwargs) -> Runtime:
+    global _global_runtime
+    with _global_lock:
+        if _global_runtime is not None and not _global_runtime.is_shutdown:
+            return _global_runtime
+        _global_runtime = Runtime(**kwargs)
+        atexit.register(shutdown_runtime)
+        return _global_runtime
+
+
+def get_runtime() -> Runtime:
+    rt = _global_runtime
+    if rt is None or rt.is_shutdown:
+        raise RuntimeError(
+            "ray_tpu has not been initialized — call ray_tpu.init() first")
+    return rt
+
+
+def try_get_runtime() -> Optional[Runtime]:
+    rt = _global_runtime
+    if rt is None or rt.is_shutdown:
+        return None
+    return rt
+
+
+def is_initialized() -> bool:
+    return try_get_runtime() is not None
+
+
+def shutdown_runtime():
+    global _global_runtime
+    with _global_lock:
+        if _global_runtime is not None:
+            _global_runtime.shutdown()
+            _global_runtime = None

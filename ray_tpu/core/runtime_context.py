@@ -1,0 +1,100 @@
+"""Per-thread execution context (who am I, which task am I running).
+
+Reference semantics: python/ray/runtime_context.py:15 — introspection of
+current job/task/actor/node plus ``was_current_actor_reconstructed`` etc.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .ids import ActorID, JobID, NodeID, TaskID, WorkerID
+
+_local = threading.local()
+
+
+class TaskContext:
+    __slots__ = ("task_id", "task_name", "actor_id", "attempt_number",
+                 "parent_task_id")
+
+    def __init__(self, task_id: TaskID, task_name: str = "",
+                 actor_id: Optional[ActorID] = None, attempt_number: int = 0,
+                 parent_task_id: Optional[TaskID] = None):
+        self.task_id = task_id
+        self.task_name = task_name
+        self.actor_id = actor_id
+        self.attempt_number = attempt_number
+        self.parent_task_id = parent_task_id
+
+
+def set_task_context(ctx: Optional[TaskContext]):
+    _local.ctx = ctx
+
+
+def current_task_context() -> Optional[TaskContext]:
+    return getattr(_local, "ctx", None)
+
+
+class RuntimeContext:
+    def __init__(self, runtime):
+        self._runtime = runtime
+
+    def get_job_id(self) -> str:
+        return self._runtime.job_id.hex()
+
+    def get_node_id(self) -> str:
+        return self._runtime.node_id.hex()
+
+    def get_worker_id(self) -> str:
+        return self._runtime.worker_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        ctx = current_task_context()
+        return ctx.task_id.hex() if ctx else None
+
+    def get_task_name(self) -> Optional[str]:
+        ctx = current_task_context()
+        return ctx.task_name if ctx else None
+
+    def get_actor_id(self) -> Optional[str]:
+        ctx = current_task_context()
+        if ctx and ctx.actor_id is not None:
+            return ctx.actor_id.hex()
+        return None
+
+    def get_actor_name(self) -> Optional[str]:
+        aid = self.get_actor_id()
+        if aid is None:
+            return None
+        return self._runtime.actor_manager.actor_name(ActorID.from_hex(aid))
+
+    def get_attempt_number(self) -> int:
+        ctx = current_task_context()
+        return ctx.attempt_number if ctx else 0
+
+    def current_actor(self):
+        aid = self.get_actor_id()
+        if aid is None:
+            raise RuntimeError("not running inside an actor")
+        return self._runtime.actor_manager.get_handle(ActorID.from_hex(aid))
+
+    @property
+    def namespace(self) -> str:
+        return self._runtime.namespace
+
+    def get_runtime_env(self) -> Dict[str, Any]:
+        return dict(self._runtime.runtime_env or {})
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        ctx = current_task_context()
+        if ctx is None:
+            return {}
+        return self._runtime.scheduler.assigned_resources(ctx.task_id)
+
+    def was_current_actor_reconstructed(self) -> bool:
+        aid = self.get_actor_id()
+        if aid is None:
+            return False
+        return self._runtime.actor_manager.num_restarts(
+            ActorID.from_hex(aid)) > 0

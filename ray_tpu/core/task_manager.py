@@ -1,0 +1,149 @@
+"""Owner-side task bookkeeping: pending table, retries, lineage.
+
+Reference semantics: src/ray/core_worker/task_manager.h:212 — the owner
+keeps every submitted task's spec until its returns are sealed; on
+failure it resubmits up to ``max_retries``; specs of *finished* tasks are
+retained ("lineage pinning", task_manager.h:219-240) while any of their
+return objects are still in scope, so a lost object can be recomputed by
+re-running its creating task (object_recovery_manager.h:41).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from .ids import ObjectID, TaskID
+from .object_store import RayObject
+from .task_spec import TaskSpec, STREAMING
+from ..exceptions import TaskCancelledError, TaskError
+
+
+class TaskManager:
+    def __init__(self, runtime):
+        self._runtime = runtime
+        self._lock = threading.Lock()
+        self._pending: Dict[TaskID, TaskSpec] = {}
+        self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._lineage_refcount: Dict[TaskID, int] = {}
+        self._num_retries: int = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def register_pending(self, spec: TaskSpec):
+        with self._lock:
+            self._pending[spec.task_id] = spec
+        for oid in spec.return_ids:
+            self._runtime.reference_counter.add_owned_object(
+                oid, pinned_for_lineage=True)
+
+    def complete_success(self, spec: TaskSpec, result):
+        """Seal return objects from the task's result value."""
+        store = self._runtime.object_store
+        n = spec.num_returns
+        if n == STREAMING:
+            # Items were already sealed by the executor as they were
+            # yielded; nothing left to do but drop from pending.
+            pass
+        elif n == 1:
+            store.put(spec.return_ids[0],
+                      RayObject(value=result, size_bytes=_sizeof(result)))
+        elif n == 0:
+            pass
+        else:
+            values = list(result)
+            if len(values) != n:
+                err = TaskError(
+                    spec.repr_name(),
+                    ValueError(f"expected {n} return values, got "
+                               f"{len(values)}"))
+                self.complete_error(spec, err, allow_retry=False)
+                return
+            for oid, v in zip(spec.return_ids, values):
+                store.put(oid, RayObject(value=v, size_bytes=_sizeof(v)))
+        self._finish(spec)
+
+    def complete_error(self, spec: TaskSpec, error: BaseException,
+                       allow_retry: bool = True):
+        if (allow_retry and not isinstance(error, TaskCancelledError)
+                and spec.should_retry(error)):
+            with self._lock:
+                self._num_retries += 1
+            spec.attempt_number += 1
+            self._runtime.resubmit_task(spec)
+            return
+        store = self._runtime.object_store
+        if spec.num_returns == STREAMING:
+            # Error terminates the stream; readers see it via the
+            # sentinel error item.
+            err_id = ObjectID.for_return(spec.task_id, 2**20)
+            store.put(err_id, RayObject(error=error))
+            self._runtime.streaming_manager.report_item(
+                spec.return_ids[0], err_id)
+            self._runtime.streaming_manager.finish(spec.return_ids[0])
+        for oid in spec.return_ids:
+            store.put(oid, RayObject(error=error))
+        self._finish(spec)
+
+    def _finish(self, spec: TaskSpec):
+        # Task is done for good (no further retries): drop the
+        # submitted-task references on its arguments.
+        self._runtime._release_arg_refs(spec)
+        with self._lock:
+            self._pending.pop(spec.task_id, None)
+            live_returns = 0
+            for oid in spec.return_ids:
+                if self._runtime.reference_counter.has_reference(oid):
+                    live_returns += 1
+            if live_returns and spec.function is not None:
+                self._lineage[spec.task_id] = spec
+                self._lineage_refcount[spec.task_id] = live_returns
+        # Release lineage when the last return goes out of scope.
+        for oid in spec.return_ids:
+            self._runtime.reference_counter.on_out_of_scope(
+                oid, self._on_return_out_of_scope)
+
+    def _on_return_out_of_scope(self, object_id: ObjectID):
+        task_id = object_id.task_id()
+        with self._lock:
+            if task_id in self._lineage_refcount:
+                self._lineage_refcount[task_id] -= 1
+                if self._lineage_refcount[task_id] <= 0:
+                    del self._lineage_refcount[task_id]
+                    self._lineage.pop(task_id, None)
+
+    # -- introspection / recovery -------------------------------------------
+    def is_pending(self, task_id: TaskID) -> bool:
+        with self._lock:
+            return task_id in self._pending
+
+    def get_pending_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            return self._pending.get(task_id)
+
+    def lineage_spec(self, object_id: ObjectID) -> Optional[TaskSpec]:
+        """Spec of the task that created this object, if pinned."""
+        with self._lock:
+            return self._lineage.get(object_id.task_id())
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def num_lineage_entries(self) -> int:
+        with self._lock:
+            return len(self._lineage)
+
+    def num_retries(self) -> int:
+        with self._lock:
+            return self._num_retries
+
+
+def _sizeof(value) -> int:
+    try:
+        import sys
+
+        if hasattr(value, "nbytes"):
+            return int(value.nbytes)
+        return sys.getsizeof(value)
+    except Exception:
+        return 0

@@ -1,0 +1,5 @@
+from .dag_node import (ClassMethodNode, ClassNode, DAGNode, FunctionNode,
+                       InputNode, MultiOutputNode)
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
+           "InputNode", "MultiOutputNode"]

@@ -1,0 +1,50 @@
+"""Compiled DAG execution (aDAG equivalent).
+
+Reference semantics: python/ray/dag/compiled_dag_node.py:691 — a bound
+DAG is compiled once into per-actor static execution loops connected by
+pre-allocated channels, replacing per-call RPC with channel write/read.
+
+Current implementation: caches the topological submission plan so
+``execute`` re-walks a precomputed order (no re-traversal / re-binding);
+channel-based execution over mutable objects + ICI p2p lands with the
+cluster runtime (ray_tpu.core.node).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from .dag_node import DAGNode, InputNode
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, **_options):
+        self._root = root
+        self._order = self._toposort(root)
+
+    @staticmethod
+    def _toposort(root: DAGNode) -> List[DAGNode]:
+        seen: Dict[int, DAGNode] = {}
+        order: List[DAGNode] = []
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for child in node._children():
+                visit(child)
+            order.append(node)
+
+        visit(root)
+        return order
+
+    def execute(self, *input_values) -> Any:
+        input_value = input_values[0] if input_values else None
+        cache: Dict[int, Any] = {}
+        for node in self._order:
+            if not isinstance(node, InputNode):
+                node._execute_impl(cache, input_value)
+        return self._root._execute_impl(cache, input_value)
+
+    def teardown(self):
+        pass

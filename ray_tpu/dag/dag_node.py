@@ -1,0 +1,167 @@
+"""Lazy DAG construction over tasks/actors.
+
+Reference semantics: python/ray/dag/dag_node.py — ``fn.bind(...)`` builds
+a DAGNode instead of submitting; ``dag.execute(input)`` walks the graph
+submitting tasks/actor calls with parent outputs as ObjectRef args.
+``experimental_compile`` (compiled graphs / aDAG, dag_node.py:184) is the
+static-schedule fast path; here it maps to the channel-based executor in
+ray_tpu.dag.compiled (built on mutable-object channels + ICI p2p for
+jax arrays) once that lands — bind/execute works today.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """Base: a lazily-bound call whose args may contain other DAGNodes."""
+
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal -----------------------------------------------------------
+    def _children(self) -> List["DAGNode"]:
+        out = []
+
+        def scan(v):
+            if isinstance(v, DAGNode):
+                out.append(v)
+
+        for a in self._bound_args:
+            scan(a)
+        for v in self._bound_kwargs.values():
+            scan(v)
+        return out
+
+    def _resolve_args(self, cache: Dict[int, Any], input_value):
+        args = tuple(
+            a._execute_impl(cache, input_value) if isinstance(a, DAGNode)
+            else a
+            for a in self._bound_args)
+        kwargs = {
+            k: (v._execute_impl(cache, input_value) if isinstance(v, DAGNode)
+                else v)
+            for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_impl(self, cache: Dict[int, Any], input_value):
+        key = id(self)
+        if key not in cache:
+            cache[key] = self._submit(cache, input_value)
+        return cache[key]
+
+    def _submit(self, cache, input_value):
+        raise NotImplementedError
+
+    def execute(self, *input_values):
+        """Run the DAG; returns ObjectRef(s) for the terminal node(s)."""
+        input_value = input_values[0] if input_values else None
+        return self._execute_impl({}, input_value)
+
+    def experimental_compile(self, **kwargs):
+        from .compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder bound to the value passed to ``execute``. Usable as a
+    context manager for parity with the reference API:
+
+        with InputNode() as inp:
+            dag = f.bind(inp)
+    """
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, cache, input_value):
+        return input_value
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs,
+                 options: Optional[Dict[str, Any]] = None):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+        self._options = options or {}
+
+    def _submit(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache, input_value)
+        handle = (self._remote_fn.options(**self._options)
+                  if self._options else self._remote_fn)
+        return handle.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """Actor-creation node; attribute access yields method nodes."""
+
+    def __init__(self, actor_class, args, kwargs,
+                 options: Optional[Dict[str, Any]] = None):
+        super().__init__(args, kwargs)
+        self._actor_class = actor_class
+        self._options = options or {}
+        self._handle_lock = threading.Lock()
+        self._handle = None
+
+    def _get_or_create_handle(self, cache, input_value):
+        with self._handle_lock:
+            if self._handle is None:
+                args, kwargs = self._resolve_args(cache, input_value)
+                cls = (self._actor_class.options(**self._options)
+                       if self._options else self._actor_class)
+                self._handle = cls.remote(*args, **kwargs)
+            return self._handle
+
+    def _execute_impl(self, cache, input_value):
+        return self._get_or_create_handle(cache, input_value)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundMethod(self, name)
+
+
+class _UnboundMethod:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name, args,
+                               kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, target, method_name: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._target = target  # ActorHandle or ClassNode
+        self._method_name = method_name
+
+    def _submit(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache, input_value)
+        if isinstance(self._target, ClassNode):
+            handle = self._target._get_or_create_handle(cache, input_value)
+        else:
+            handle = self._target
+        return getattr(handle, self._method_name).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal node aggregating several outputs into a list of refs."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _submit(self, cache, input_value):
+        return [o._execute_impl(cache, input_value)
+                for o in self._bound_args]

@@ -1,0 +1,96 @@
+"""User-facing error types.
+
+Reference semantics: python/ray/exceptions.py — errors are themselves
+objects: a failed task's return object *contains* the error, so it
+propagates through dependency chains (TaskError wrapping) and surfaces at
+``get`` time.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ``get`` of its outputs.
+
+    Mirrors RayTaskError (python/ray/exceptions.py) including cause
+    chaining: if a task fails because an *argument* holds a TaskError,
+    the original error is propagated unwrapped.
+    """
+
+    def __init__(self, function_name: str, cause: BaseException,
+                 tb_str: str | None = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.tb_str = tb_str or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(f"task {function_name} failed: {cause!r}")
+
+    def __str__(self):
+        return (f"{type(self.cause).__name__} in task {self.function_name}\n"
+                f"{self.tb_str}")
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead (creation failed, killed, or out of restarts)."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(reason)
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object value unrecoverable (all copies lost, lineage exhausted)."""
+
+    def __init__(self, object_ref=None, reason: str = "object lost"):
+        self.object_ref = object_ref
+        super().__init__(reason)
+
+
+class ObjectFreedError(ObjectLostError):
+    """Object was explicitly freed by the application."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The object's owner process died; value and lineage are gone."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__("task was cancelled")
+
+
+class PendingCallsLimitExceededError(RayTpuError):
+    """Actor's max_pending_calls exceeded."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` exceeded its timeout."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing a worker's runtime environment failed."""
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Worker killed by the memory monitor (reference: OOM killer, N22)."""

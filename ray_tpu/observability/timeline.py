@@ -1,0 +1,64 @@
+"""Chrome-trace timeline export (reference: ray.timeline →
+_private/state.py:948; events from the per-worker TaskEventBuffer,
+task_event_buffer.h:220).
+
+The in-process runtime records task begin/end events into a bounded
+buffer; export emits Chrome trace-event JSON loadable in
+chrome://tracing / Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[Dict] = []
+_MAX_EVENTS = 100_000
+
+
+def record_event(name: str, phase: str, *, pid: str = "driver",
+                 tid: str = "main", ts: Optional[float] = None,
+                 args: Optional[Dict] = None):
+    event = {
+        "name": name,
+        "ph": phase,  # "B" begin / "E" end / "X" complete
+        "pid": pid,
+        "tid": tid,
+        "ts": (ts if ts is not None else time.time()) * 1e6,
+    }
+    if args:
+        event["args"] = args
+    with _lock:
+        if len(_events) < _MAX_EVENTS:
+            _events.append(event)
+
+
+def record_span(name: str, start: float, end: float, *, pid: str = "driver",
+                tid: str = "main", args: Optional[Dict] = None):
+    event = {
+        "name": name, "ph": "X", "pid": pid, "tid": tid,
+        "ts": start * 1e6, "dur": (end - start) * 1e6,
+    }
+    if args:
+        event["args"] = args
+    with _lock:
+        if len(_events) < _MAX_EVENTS:
+            _events.append(event)
+
+
+def export_timeline(filename: Optional[str] = None):
+    with _lock:
+        data = list(_events)
+    if filename is None:
+        return data
+    with open(filename, "w") as f:
+        json.dump(data, f)
+    return filename
+
+
+def clear():
+    with _lock:
+        _events.clear()

@@ -1,0 +1,88 @@
+"""Pool of actors for map-style workloads (reference:
+python/ray/util/actor_pool.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[Tuple[Callable, Any]] = []
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any):
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        from .. import get
+
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        idx = self._next_return_index
+        self._next_return_index += 1
+        future = self._index_to_future.pop(idx)
+        result = get(future, timeout=timeout)
+        self._return_actor(future)
+        return result
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        from .. import get, wait
+
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ready, _ = wait(list(self._future_to_actor), num_returns=1,
+                        timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        idx, _actor = self._future_to_actor[future]
+        self._index_to_future.pop(idx, None)
+        result = get(future)
+        self._return_actor(future)
+        return result
+
+    def _return_actor(self, future):
+        _idx, actor = self._future_to_actor.pop(future)
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor: Any):
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)

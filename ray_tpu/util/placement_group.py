@@ -1,0 +1,178 @@
+"""Placement groups: gang-reserve resource bundles.
+
+Reference semantics: python/ray/util/placement_group.py + GCS two-phase
+bundle scheduling (SURVEY.md A.13).  A PG reserves a list of bundles with
+a strategy (PACK/SPREAD/STRICT_PACK/STRICT_SPREAD); reserved capacity is
+exposed as synthetic per-group resources (``CPU_group_<pgid>``) that
+tasks/actors consume via PlacementGroupSchedulingStrategy.
+
+TPU note: STRICT_PACK on a TPU slice means "same ICI domain" — the mesh
+builder (ray_tpu.parallel.mesh) consumes PG bundle topology labels to lay
+meshes along the torus.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..core.ids import PlacementGroupID
+from ..core.runtime import get_runtime
+from ..core.task_spec import PlacementGroupSchedulingStrategy  # re-export
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+_lock = threading.Lock()
+_groups: Dict[PlacementGroupID, "PlacementGroup"] = {}
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self._ready_event = threading.Event()
+        self._removed = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def ready(self):
+        """Returns an ObjectRef resolving when all bundles are reserved
+        (reference: PlacementGroup.ready())."""
+        from .. import remote
+
+        @remote
+        def _pg_ready(pg_id_hex: str):
+            pg = get_placement_group_by_id(
+                PlacementGroupID.from_hex(pg_id_hex))
+            pg.wait(timeout_seconds=None)
+            return pg
+
+        return _pg_ready.remote(self.id.hex())
+
+    def wait(self, timeout_seconds: Optional[float] = 30) -> bool:
+        return self._ready_event.wait(timeout_seconds)
+
+    def is_ready(self) -> bool:
+        return self._ready_event.is_set()
+
+    # -- resource mapping ----------------------------------------------------
+    def group_resource_name(self, base: str, bundle_index: int = -1) -> str:
+        if bundle_index >= 0:
+            return f"{base}_group_{bundle_index}_{self.id.hex()}"
+        return f"{base}_group_{self.id.hex()}"
+
+    def wrap_resources(self, demand: Dict[str, float],
+                       bundle_index: int = -1) -> Dict[str, float]:
+        """Rewrite a task's demand onto this PG's synthetic resources.
+
+        Single-node note: capacity is minted only at the aggregate
+        (wildcard) level, so indexed and wildcard consumers draw from one
+        pool — on one node every bundle is co-located anyway, and a split
+        pool would let the two forms double-spend the reservation.
+        Cluster mode places bundles on nodes and enforces per-bundle
+        capacity there.
+        """
+        if self._removed:
+            raise ValueError(f"placement group {self.id!r} was removed")
+        if bundle_index >= len(self.bundles):
+            raise ValueError(
+                f"bundle index {bundle_index} out of range "
+                f"(PG has {len(self.bundles)} bundles)")
+        return {self.group_resource_name(k): v for k, v in demand.items()}
+
+    def synthetic_capacity(self) -> Dict[str, float]:
+        cap: Dict[str, float] = {}
+        for bundle in self.bundles:
+            for k, v in bundle.items():
+                name = self.group_resource_name(k)
+                cap[name] = cap.get(name, 0.0) + v
+        return cap
+
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return [dict(b) for b in self.bundles]
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def __reduce__(self):
+        return (get_placement_group_by_id, (self.id,))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime: Optional[str] = None
+                    ) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty "
+                         "resource dicts")
+    for b in bundles:
+        if any(v < 0 for v in b.values()):
+            raise ValueError("bundle resources must be non-negative")
+    rt = get_runtime()
+    pg = PlacementGroup(PlacementGroupID.from_random(), bundles, strategy,
+                        name)
+    with _lock:
+        _groups[pg.id] = pg
+
+    # Reserve: acquire the aggregate demand from the node, then mint
+    # synthetic bundle resources (the one-node analogue of the GCS
+    # two-phase prepare/commit across raylets).
+    total: Dict[str, float] = {}
+    for b in bundles:
+        for k, v in b.items():
+            total[k] = total.get(k, 0.0) + v
+
+    def reserve():
+        if not rt.node_resources.can_ever_fit(total):
+            return  # infeasible — stays pending forever, like reference
+        rt.node_resources.acquire(total)
+        rt.node_resources.add_capacity(pg.synthetic_capacity())
+        pg._ready_event.set()
+
+    threading.Thread(target=reserve, daemon=True).start()
+    return pg
+
+
+def get_placement_group_by_id(pg_id: PlacementGroupID) -> PlacementGroup:
+    with _lock:
+        pg = _groups.get(pg_id)
+    if pg is None:
+        raise ValueError(f"no such placement group: {pg_id!r}")
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup):
+    rt = get_runtime()
+    with _lock:
+        _groups.pop(pg.id, None)
+    if pg.is_ready():
+        rt.node_resources.remove_capacity(pg.synthetic_capacity())
+        total: Dict[str, float] = {}
+        for b in pg.bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        rt.node_resources.release(total)
+    pg._removed = True
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    # In-process runtime: tasks don't implicitly capture the parent's PG
+    # unless placement_group_capture_child_tasks is set; we expose None
+    # outside PG tasks. Cluster mode threads this through TaskContext.
+    return None
+
+
+def placement_group_table() -> List[Dict[str, Any]]:
+    with _lock:
+        return [
+            {"id": pg.id.hex(), "name": pg.name, "strategy": pg.strategy,
+             "bundles": pg.bundle_specs(), "ready": pg.is_ready()}
+            for pg in _groups.values()
+        ]

@@ -1,0 +1,38 @@
+"""Test fixtures.
+
+Parallelism tests run on a simulated 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8), mirroring the
+reference's in-process multi-node simulation strategy
+(SURVEY.md §4.3 ray_start_cluster / cluster_utils.Cluster).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Fresh runtime per test (reference: conftest.py:463)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    yield None
+    ray_tpu.shutdown()

@@ -1,0 +1,98 @@
+"""DAG bind/execute tests (reference: python/ray/dag/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+def test_function_dag(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), double.bind(inp))
+    assert ray_tpu.get(dag.execute(5)) == 20
+
+
+def test_shared_node_executes_once(ray_start_regular):
+    calls = []
+
+    @ray_tpu.remote
+    def work(x):
+        calls.append(1)
+        return x + 1
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        shared = work.bind(inp)
+        dag = join.bind(shared, shared)
+    assert ray_tpu.get(dag.execute(1)) == 4
+    assert len(calls) == 1
+
+
+def test_actor_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    node = Adder.bind(100)
+    dag = node.add.bind(InputNode())
+    assert ray_tpu.get(dag.execute(5)) == 105
+
+
+def test_multi_output(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([f.bind(inp), f.bind(f.bind(inp))])
+    refs = dag.execute(0)
+    assert ray_tpu.get(refs) == [1, 2]
+
+
+def test_compiled_dag(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = inc.bind(inc.bind(inp))
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(0)) == 2
+    assert ray_tpu.get(compiled.execute(10)) == 12
+
+
+def test_async_queue_roundtrip(ray_start_regular):
+    # Regression: async actors must default to high max_concurrency or
+    # the actor-backed Queue deadlocks on blocking get before put.
+    import threading
+    from ray_tpu.util import Queue
+
+    q = Queue()
+    out = []
+
+    def consumer():
+        out.append(q.get(timeout=10))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    import time
+
+    time.sleep(0.3)
+    q.put("hello")
+    t.join(timeout=10)
+    assert out == ["hello"]

@@ -1,0 +1,99 @@
+"""Placement group + util tests (reference: test_placement_group*.py,
+util/queue, util/actor_pool)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (ActorPool, PlacementGroup, Queue, placement_group,
+                          remove_placement_group)
+from ray_tpu.util.placement_group import PlacementGroupSchedulingStrategy
+
+
+def test_pg_reserves_and_schedules(ray_start_regular):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 4.0  # 8 - 4 reserved
+
+    @ray_tpu.remote(num_cpus=2)
+    def inside():
+        return "ran"
+
+    ref = inside.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(ref, timeout=10) == "ran"
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    assert ray_tpu.available_resources()["CPU"] == 8.0
+
+
+def test_pg_strict_pack_actor(ray_start_regular):
+    pg = placement_group([{"CPU": 4}], strategy="STRICT_PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(
+        num_cpus=2,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg)).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+
+
+def test_pg_invalid_strategy(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+def test_pg_infeasible_stays_pending(ray_start_regular):
+    pg = placement_group([{"CPU": 1000}])
+    assert not pg.wait(0.3)
+
+
+def test_queue(ray_start_regular):
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+
+
+def test_queue_nowait_full(ray_start_regular):
+    from ray_tpu.exceptions import TaskError
+
+    q = Queue(maxsize=1)
+    q.put_nowait("a")
+    with pytest.raises(TaskError):
+        q.put_nowait("b")
+
+
+def test_actor_pool(ray_start_regular):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert out == [i * 2 for i in range(10)]
+
+
+def test_reference_counting_frees_objects(ray_start_regular):
+    rt = ray_tpu.get_runtime()
+    ref = ray_tpu.put(list(range(1000)))
+    oid = ref.object_id()
+    assert rt.object_store.contains(oid)
+    del ref
+    import gc
+
+    gc.collect()
+    time.sleep(0.1)
+    assert not rt.object_store.contains(oid)
