@@ -1,0 +1,115 @@
+"""Headline benchmark: training-step throughput on the flagship model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no absolute model-level throughput (BASELINE.md:
+"published" is empty), so vs_baseline is null until a measured reference
+number exists.
+
+Run on real TPU (driver does this at end of round); falls back to a tiny
+CPU config so it always emits a line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _peak_bf16_flops(device_kind: str):
+    """Per-chip bf16 peak by device kind (public TPU spec sheets)."""
+    kind = device_kind.lower()
+    table = [
+        ("v6", 918e12),          # Trillium / v6e
+        ("v5 lite", 394e12),     # v5e
+        ("v5litepod", 394e12),
+        ("v5e", 394e12),
+        ("v5p", 459e12),
+        ("v5", 459e12),          # bare v5 → assume v5p
+        ("v4", 275e12),
+        ("v3", 123e12),
+        ("v2", 46e12),
+    ]
+    for key, flops in table:
+        if key in kind:
+            return flops
+    return None
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+
+    if on_tpu:
+        # ~125M-param Llama, bf16, seq 2048 — fits a single v5e chip
+        # with adam state in f32 (remat on: einsum attention stores SxS
+        # probs otherwise; flash-attention kernel will lift this).
+        cfg = llama.LlamaConfig.llama_125m(max_seq_len=2048)
+        batch, seq, steps, warmup = 8, 2048, 20, 3
+    else:
+        cfg = llama.LlamaConfig.debug()
+        batch, seq, steps, warmup = 8, 64, 5, 1
+
+    state = llama.init_train_state(jax.random.key(0), cfg)
+    step = llama.make_train_step(cfg)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch_data = {"tokens": tokens}
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch_data)
+    float(metrics["loss"])  # host transfer = real sync (axon's
+    # block_until_ready returns before execution completes)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    # Steps chain through `state`, so fetching the last loss waits for
+    # the whole sequence.
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * (seq - 1)
+    tps = tokens_per_step * steps / dt
+
+    n_params = llama.param_count(
+        jax.eval_shape(lambda: llama.init_params(jax.random.key(0), cfg)))
+    flops_per_tok = 6 * n_params  # dense-LM training approximation
+    mfu_denom = _peak_bf16_flops(jax.devices()[0].device_kind)
+    extra = {
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "model_params": int(n_params),
+        "batch": batch,
+        "seq": seq,
+        "loss": float(metrics["loss"]),
+    }
+    if mfu_denom and on_tpu:
+        extra["mfu"] = round(tps * flops_per_tok / mfu_denom, 4)
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        **extra,
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit one parseable line
+        print(json.dumps({
+            "metric": "train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)

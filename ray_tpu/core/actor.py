@@ -97,7 +97,12 @@ class ActorMethod:
         self._overrides = overrides or {}
 
     def remote(self, *args, **kwargs):
-        options = _build_options({"max_retries": 0}, self._overrides)
+        # Per-method defaults from @ray_tpu.method(...) sit between the
+        # built-in defaults and .options() overrides.
+        method = getattr(self._handle._klass, self._method_name, None)
+        decorated = getattr(method, "__ray_tpu_method_options__", {})
+        options = _build_options({"max_retries": 0, **decorated},
+                                 self._overrides)
         return get_runtime().submit_actor_task(
             self._handle._actor_id, self._method_name, args, kwargs, options)
 

@@ -44,10 +44,16 @@ class _ActorCore:
         self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
         self._threads = []
         self._stopped = threading.Event()
+        # Serializes submit() vs stop() so no spec can be enqueued
+        # behind the shutdown sentinels (it would hang forever).
+        self._submit_lock = threading.Lock()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.instance: Any = None
         self._creation_done = threading.Event()
         self._creation_error: Optional[BaseException] = None
+        # Set by Runtime.create_actor; lets kill paths resolve a
+        # still-pending creation ref.
+        self.creation_spec = None
 
         if info.is_async:
             t = threading.Thread(target=self._async_main,
@@ -91,13 +97,16 @@ class _ActorCore:
 
     # -- submission ----------------------------------------------------------
     def submit(self, spec: TaskSpec):
-        if self.info.max_pending_calls > 0 and (
-                self._queue.qsize() >= self.info.max_pending_calls):
-            raise PendingCallsLimitExceededError(
-                f"actor {self.info.display_name()} has "
-                f"{self._queue.qsize()} pending calls "
-                f"(max_pending_calls={self.info.max_pending_calls})")
-        self._queue.put(spec)
+        with self._submit_lock:
+            if self._stopped.is_set():
+                raise self._dead_error()
+            if self.info.max_pending_calls > 0 and (
+                    self._queue.qsize() >= self.info.max_pending_calls):
+                raise PendingCallsLimitExceededError(
+                    f"actor {self.info.display_name()} has "
+                    f"{self._queue.qsize()} pending calls "
+                    f"(max_pending_calls={self.info.max_pending_calls})")
+            self._queue.put(spec)
 
     # -- execution loops -----------------------------------------------------
     def _sync_main(self):
@@ -160,18 +169,19 @@ class _ActorCore:
 
     # -- teardown ------------------------------------------------------------
     def stop(self):
-        self._stopped.set()
-        # Fail everything still queued.
-        try:
-            while True:
-                spec = self._queue.get_nowait()
-                if spec is not None:
-                    self._runtime.task_manager.complete_error(
-                        spec, self._dead_error(), allow_retry=False)
-        except queue.Empty:
-            pass
-        for _ in self._threads:
-            self._queue.put(None)
+        with self._submit_lock:
+            self._stopped.set()
+            # Fail everything still queued.
+            try:
+                while True:
+                    spec = self._queue.get_nowait()
+                    if spec is not None:
+                        self._runtime.task_manager.complete_error(
+                            spec, self._dead_error(), allow_retry=False)
+            except queue.Empty:
+                pass
+            for _ in self._threads:
+                self._queue.put(None)
 
 
 class ActorInfo:
@@ -192,12 +202,20 @@ class ActorInfo:
         self.max_pending_calls = max_pending_calls
         self.lifetime = lifetime
         self.resources = resources or {}
+        # Resource-accounting flags: acquire happens on a background
+        # thread at creation; release must happen exactly once across
+        # the kill / failed-creation / double-kill paths.
+        self.resources_acquired = False
+        self.resources_released = False
         self.state = ActorState.PENDING_CREATION
         self.num_restarts = 0
-        self.is_async = any(
-            inspect.iscoroutinefunction(m)
-            for _n, m in inspect.getmembers(klass,
-                                            inspect.iscoroutinefunction))
+        # Coroutine *and* async-generator methods make an actor async
+        # (iscoroutinefunction alone misses ``async def`` generators).
+        def _is_async_callable(m):
+            return (inspect.iscoroutinefunction(m)
+                    or inspect.isasyncgenfunction(m))
+
+        self.is_async = bool(inspect.getmembers(klass, _is_async_callable))
         # Async actors default to high concurrency (reference: actor.py —
         # asyncio actors use max_concurrency=1000 unless set explicitly);
         # sync actors default to 1 (ordered execution).

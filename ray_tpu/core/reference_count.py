@@ -21,14 +21,13 @@ from .ids import ObjectID, TaskID
 
 
 class _Ref:
-    __slots__ = ("local_refs", "submitted_task_refs", "pinned_for_lineage",
-                 "owned")
+    # Lineage pinning itself lives in TaskManager._lineage_refcount;
+    # this table only counts references.
+    __slots__ = ("local_refs", "submitted_task_refs")
 
-    def __init__(self, owned: bool = True):
+    def __init__(self):
         self.local_refs = 0
         self.submitted_task_refs = 0
-        self.pinned_for_lineage = False
-        self.owned = owned
 
     def total(self) -> int:
         return self.local_refs + self.submitted_task_refs
@@ -41,11 +40,9 @@ class ReferenceCounter:
         self._on_out_of_scope = on_object_out_of_scope
         self._out_of_scope_listeners: Dict[ObjectID, list] = {}
 
-    def add_owned_object(self, object_id: ObjectID,
-                         pinned_for_lineage: bool = False):
+    def add_owned_object(self, object_id: ObjectID):
         with self._lock:
-            ref = self._refs.setdefault(object_id, _Ref(owned=True))
-            ref.pinned_for_lineage = pinned_for_lineage
+            self._refs.setdefault(object_id, _Ref())
 
     def add_local_reference(self, object_id: ObjectID):
         with self._lock:
@@ -81,6 +78,16 @@ class ReferenceCounter:
             self._on_out_of_scope(to_free)
             for cb in listeners:
                 cb(to_free)
+
+    def forget_if_unreferenced(self, object_id: ObjectID):
+        """Drop a zero-count owned entry without firing the
+        out-of-scope hook (used to back out never-submitted tasks whose
+        return refs were never handed to anyone)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None and ref.total() == 0:
+                del self._refs[object_id]
+                self._out_of_scope_listeners.pop(object_id, None)
 
     def has_reference(self, object_id: ObjectID) -> bool:
         with self._lock:

@@ -54,6 +54,9 @@ class Runtime:
         self.namespace = namespace or "default"
         self.runtime_env = runtime_env
         self.is_shutdown = False
+        # Guards the exactly-once actor-resource release across the
+        # kill / failed-creation / acquire-thread paths.
+        self._resource_release_lock = threading.Lock()
         self.start_time = time.time()
 
         self.object_store = MemoryStore()
@@ -180,10 +183,29 @@ class Runtime:
     def resubmit_task(self, spec: TaskSpec):
         delay_ms = GLOBAL_CONFIG.task_retry_delay_ms()
         if delay_ms:
-            timer = threading.Timer(
-                delay_ms / 1000.0, lambda: self.scheduler.submit(spec))
+            timer = threading.Timer(delay_ms / 1000.0,
+                                    lambda: self._do_resubmit(spec))
             timer.daemon = True
             timer.start()
+        else:
+            self._do_resubmit(spec)
+
+    def _do_resubmit(self, spec: TaskSpec):
+        """Retries route actor tasks back to the actor core; only plain
+        tasks go to the task scheduler."""
+        if spec.is_actor_task and spec.actor_id is not None:
+            from ..exceptions import ActorDiedError
+
+            core = self.actor_manager.get_core(spec.actor_id)
+            if core is None or core.info.state == ActorState.DEAD:
+                self.task_manager.complete_error(
+                    spec, ActorDiedError(spec.actor_id, "actor is dead"),
+                    allow_retry=False)
+                return
+            try:
+                core.submit(spec)
+            except Exception as e:
+                self.task_manager.complete_error(spec, e, allow_retry=False)
         else:
             self.scheduler.submit(spec)
 
@@ -308,11 +330,7 @@ class Runtime:
                 result = await result
             if spec.num_returns == STREAMING:
                 if inspect.isasyncgen(result):
-                    items = []
-                    async for item in result:
-                        self._seal_stream_item(spec, len(items), item)
-                        items.append(None)
-                    self.streaming_manager.finish(spec.return_ids[0])
+                    await self._consume_stream_async(spec, result)
                 else:
                     self._consume_stream(spec, result)
             else:
@@ -336,6 +354,22 @@ class Runtime:
         self.object_store.put(
             item_id, RayObject(value=item, size_bytes=_sizeof(item)))
         self.streaming_manager.report_item(spec.return_ids[0], item_id)
+
+    async def _consume_stream_async(self, spec: TaskSpec, agen):
+        # Mirrors _consume_stream: mid-stream failures must not retry
+        # (items already reported would be duplicated on a re-run).
+        try:
+            count = 0
+            async for item in agen:
+                self._seal_stream_item(spec, count, item)
+                count += 1
+            self.streaming_manager.finish(spec.return_ids[0])
+            self.task_manager.complete_success(spec, None)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError(
+                spec.repr_name(), e)
+            self.task_manager.complete_error(spec, err, allow_retry=False)
+            self.streaming_manager.finish(spec.return_ids[0])
 
     def _consume_stream(self, spec: TaskSpec, generator):
         try:
@@ -410,10 +444,24 @@ class Runtime:
             return_ids=(ObjectID.for_return(creation_task_id, 0),),
         )
         self.task_manager.register_pending(creation_spec)
+        core.creation_spec = creation_spec
 
         def acquire_and_go():
+            from ..exceptions import ActorDiedError
+
             if demand:
                 self.node_resources.acquire(demand)
+                core.info.resources_acquired = True
+            if core.info.state == ActorState.DEAD:
+                # Killed while we were blocked in acquire: give back the
+                # resources and resolve the creation ref, else both leak.
+                self._release_actor_resources(core.info)
+                self.task_manager.complete_error(
+                    creation_spec,
+                    ActorDiedError(actor_id,
+                                   "actor was killed before creation"),
+                    allow_retry=False)
+                return
             core.submit(creation_spec)
 
         threading.Thread(target=acquire_and_go, daemon=True).start()
@@ -432,8 +480,7 @@ class Runtime:
                 f"actor {core.info.display_name()} failed during creation: "
                 f"{core._creation_error!r}")
             self.task_manager.complete_error(spec, err, allow_retry=False)
-            if core.info.resources:
-                self.node_resources.release(core.info.resources)
+            self._release_actor_resources(core.info)
             core.stop()
 
     def submit_actor_creation_for_restart(self, core):
@@ -486,15 +533,50 @@ class Runtime:
                 spec, ActorDiedError(actor_id, "actor is dead"),
                 allow_retry=False)
         else:
-            core.submit(spec)
+            try:
+                core.submit(spec)
+            except ActorDiedError as e:
+                # Raced a kill: same observable behavior as the DEAD
+                # pre-check above (refs resolve to the error).
+                self.task_manager.complete_error(spec, e,
+                                                 allow_retry=False)
+            except Exception:
+                # Back out the owner-side bookkeeping (pending-table
+                # entry + arg refs + never-handed-out return refs)
+                # before re-raising, e.g. on
+                # PendingCallsLimitExceededError.  The caller gets the
+                # exception, not error-valued refs.
+                if n == STREAMING:
+                    self.streaming_manager.finish(spec.return_ids[0])
+                self.task_manager.abandon(spec)
+                raise
         return self._refs_for(spec)
+
+    def _release_actor_resources(self, info):
+        """Release exactly once, and only after the creation thread's
+        acquire has happened."""
+        with self._resource_release_lock:
+            if not (info.resources and info.resources_acquired
+                    and not info.resources_released):
+                return
+            info.resources_released = True
+        self.node_resources.release(info.resources)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         core = self.actor_manager.get_core(actor_id)
         self.actor_manager.kill(actor_id, no_restart)
-        if (core is not None and core.info.state == ActorState.DEAD
-                and core.info.resources):
-            self.node_resources.release(core.info.resources)
+        if core is not None and core.info.state == ActorState.DEAD:
+            self._release_actor_resources(core.info)
+            # If the kill landed between the creation thread's acquire
+            # and the creation task running, resolve the creation ref.
+            spec = core.creation_spec
+            if spec is not None and self.task_manager.is_pending(
+                    spec.task_id):
+                from ..exceptions import ActorDiedError
+
+                self.task_manager.complete_error(
+                    spec, ActorDiedError(actor_id, "actor was killed"),
+                    allow_retry=False)
 
     # ------------------------------------------------------------- cancel
     def cancel(self, ref: ObjectRef, force: bool = False,

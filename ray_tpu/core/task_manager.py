@@ -33,8 +33,7 @@ class TaskManager:
         with self._lock:
             self._pending[spec.task_id] = spec
         for oid in spec.return_ids:
-            self._runtime.reference_counter.add_owned_object(
-                oid, pinned_for_lineage=True)
+            self._runtime.reference_counter.add_owned_object(oid)
 
     def complete_success(self, spec: TaskSpec, result):
         """Seal return objects from the task's result value."""
@@ -101,6 +100,16 @@ class TaskManager:
         for oid in spec.return_ids:
             self._runtime.reference_counter.on_out_of_scope(
                 oid, self._on_return_out_of_scope)
+
+    def abandon(self, spec: TaskSpec):
+        """Back out a task that was registered but never submitted (the
+        caller keeps the exception; no error objects are sealed and the
+        never-handed-out return refs are forgotten entirely)."""
+        self._runtime._release_arg_refs(spec)
+        with self._lock:
+            self._pending.pop(spec.task_id, None)
+        for oid in spec.return_ids:
+            self._runtime.reference_counter.forget_if_unreferenced(oid)
 
     def _on_return_out_of_scope(self, object_id: ObjectID):
         task_id = object_id.task_id()

@@ -1,0 +1,29 @@
+"""Model zoo (TPU-native, functional jax).
+
+The reference ships no model implementations in its core (RLlib's
+catalog is torch/tf); the TPU framework needs native models because
+there is no external engine to delegate to (SURVEY.md §2.3).  Flagship:
+Llama-family decoder LM (:mod:`ray_tpu.models.llama`) built
+scan-over-layers with logical-axis shardings so one implementation
+serves DP/FSDP/TP/SP/PP/EP via :mod:`ray_tpu.parallel` rule tables.
+"""
+
+from .llama import (
+    LlamaConfig,
+    init_params,
+    param_logical_axes,
+    forward,
+    loss_fn,
+    make_train_step,
+    init_train_state,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "param_logical_axes",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+    "init_train_state",
+]

@@ -1,0 +1,46 @@
+"""TPU-native parallelism layer.
+
+The reference (zszheng/ray) is an orchestration layer that delegates
+chip-level parallelism to external engines (SURVEY.md §2.3: TP/PP/SP/EP
+"Not implemented" — torch DDP/FSDP wrappers only, reference
+train/torch/train_loop_utils.py:162-188).  On TPU there is nothing to
+delegate to, so parallelism is first-class here:
+
+- :class:`MeshSpec` — named device-mesh axes (data/fsdp/pipe/tensor/
+  seq/expert) over ``jax.sharding.Mesh`` (ICI intra-slice, DCN
+  inter-slice).
+- Logical-axis sharding rules (:mod:`ray_tpu.parallel.sharding`) map
+  model-level axis names ("batch", "embed", "heads", …) to mesh axes;
+  ``with_logical_constraint`` annotates activations inside jit.
+- :mod:`ray_tpu.parallel.collective` — ray.util.collective-shaped group
+  API (reference util/collective/collective.py:120) whose device path
+  lowers to XLA collectives (psum/all_gather/reduce_scatter/all_to_all)
+  instead of NCCL.
+"""
+
+from .mesh import MeshSpec, build_mesh, get_abstract_mesh, local_mesh
+from .sharding import (
+    ShardingRules,
+    DEFAULT_RULES,
+    logical_sharding,
+    use_sharding_rules,
+    with_logical_constraint,
+    shard_params,
+    current_mesh,
+    use_mesh,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "get_abstract_mesh",
+    "ShardingRules",
+    "DEFAULT_RULES",
+    "logical_sharding",
+    "use_sharding_rules",
+    "with_logical_constraint",
+    "shard_params",
+    "current_mesh",
+    "use_mesh",
+]

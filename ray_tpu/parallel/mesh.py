@@ -1,0 +1,135 @@
+"""Device-mesh construction with named parallelism axes.
+
+TPU-first replacement for the reference's process-group bootstrap
+(train/torch/config.py:66 ``_setup_torch_process_group``): instead of a
+rank/world NCCL group, parallelism is expressed as a
+``jax.sharding.Mesh`` whose named axes carry the strategy:
+
+==========  ============================================================
+axis        meaning
+==========  ============================================================
+``data``    pure data parallelism (gradients psum'd over it)
+``fsdp``    data parallelism with parameter/optimizer sharding (ZeRO-3);
+            weights are sharded over it and all-gathered per layer
+``pipe``    pipeline stages (inter-slice over DCN on multi-slice pods)
+``tensor``  megatron-style tensor parallelism (heads/mlp sharded)
+``seq``     sequence/context parallelism (ring attention axis)
+``expert``  MoE expert parallelism (ragged all_to_all dispatch axis)
+==========  ============================================================
+
+Axis order matters: the last axes change fastest over the physical
+device list, so ``tensor``/``seq`` (highest-bandwidth collectives) sit
+innermost to ride ICI, while ``pipe``/``data`` sit outermost where DCN
+hops are tolerable (scaling-book layout recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Outer-to-inner physical ordering (see module docstring).
+AXIS_ORDER: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "seq",
+                               "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape: one int per parallelism axis.
+
+    ``MeshSpec(fsdp=-1)`` lets one axis absorb all remaining devices
+    (like a -1 in a reshape).
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    pipe: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {name: getattr(self, name) for name in AXIS_ORDER}
+
+    def resolved(self, n_devices: int) -> "MeshSpec":
+        """Resolve a single -1 axis against ``n_devices``."""
+        sizes = self.axis_sizes()
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        if wild:
+            fixed = math.prod(v for v in sizes.values() if v != -1)
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed} ({sizes})")
+            sizes[wild[0]] = n_devices // fixed
+        total = math.prod(sizes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices, have {n_devices}")
+        return MeshSpec(**sizes)
+
+    @property
+    def n_devices(self) -> int:
+        sizes = self.axis_sizes()
+        if any(v == -1 for v in sizes.values()):
+            raise ValueError("unresolved -1 axis; call resolved() first")
+        return math.prod(sizes.values())
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        return build_mesh(self, devices)
+
+    @classmethod
+    def auto(cls, n_devices: int, *, tensor: int = 1, seq: int = 1,
+             pipe: int = 1, expert: int = 1, fsdp: bool = True) -> "MeshSpec":
+        """Fill the leftover devices into fsdp (default) or data."""
+        spec = cls(tensor=tensor, seq=seq, pipe=pipe, expert=expert,
+                   fsdp=-1 if fsdp else 1, data=1 if fsdp else -1)
+        return spec.resolved(n_devices)
+
+
+def build_mesh(spec: MeshSpec,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Materialize a ``jax.sharding.Mesh`` for ``spec``.
+
+    Devices are laid out row-major over ``AXIS_ORDER`` so the innermost
+    axes map to physically adjacent devices.  On real TPU slices
+    ``jax.devices()`` is already ordered by torus coordinates, which
+    keeps ``tensor``/``seq`` collectives on nearest-neighbor ICI links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolved(len(devices))
+    sizes = spec.axis_sizes()
+    dev_array = np.asarray(devices, dtype=object).reshape(
+        tuple(sizes[a] for a in AXIS_ORDER))
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def get_abstract_mesh(spec: MeshSpec,
+                      n_devices: Optional[int] = None
+                      ) -> jax.sharding.AbstractMesh:
+    """Shape-only mesh for tracing/compile-ahead without real devices.
+
+    Pass ``n_devices`` to resolve a -1 wildcard axis; otherwise the
+    spec must be fully specified.
+    """
+    if n_devices is not None:
+        spec = spec.resolved(n_devices)
+    sizes = spec.axis_sizes()  # raises on unresolved -1 via n_devices
+    if any(v == -1 for v in sizes.values()):
+        raise ValueError("spec has a -1 axis; pass n_devices")
+    return jax.sharding.AbstractMesh(
+        tuple(sizes[a] for a in AXIS_ORDER), AXIS_ORDER)
+
+
+def local_mesh() -> Mesh:
+    """Single-device mesh (all axes size 1) — the degenerate case used
+    for single-chip runs and tests."""
+    return build_mesh(MeshSpec(), jax.devices()[:1])

@@ -8,12 +8,19 @@ reference's in-process multi-node simulation strategy
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the CPU backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu"
+# at interpreter start; override back so tests run on the simulated
+# 8-device CPU mesh regardless of environment.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

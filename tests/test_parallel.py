@@ -1,0 +1,84 @@
+"""Mesh + logical sharding tests on the simulated 8-device CPU mesh
+(SURVEY.md §4.3 multi-node-without-a-cluster strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel import (DEFAULT_RULES, MeshSpec, ShardingRules,
+                              build_mesh, logical_sharding, shard_params,
+                              use_mesh, with_logical_constraint)
+
+
+def test_meshspec_resolve_wildcard():
+    spec = MeshSpec(tensor=2, fsdp=-1).resolved(8)
+    assert spec.fsdp == 4 and spec.tensor == 2
+    assert spec.n_devices == 8
+
+
+def test_meshspec_bad_shapes():
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolved(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).resolved(8)
+
+
+def test_build_mesh_axis_names():
+    mesh = MeshSpec(data=2, fsdp=2, tensor=2).build()
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.size == 8
+
+
+def test_meshspec_auto():
+    spec = MeshSpec.auto(8, tensor=2)
+    assert spec.fsdp == 4 and spec.tensor == 2
+
+
+def test_rules_spec_basic():
+    rules = DEFAULT_RULES
+    assert rules.spec(("batch", "seq", None)) == P(("data", "fsdp"), "seq")
+    assert rules.spec(("embed", "heads")) == P("fsdp", "tensor")
+    # Trailing Nones trimmed.
+    assert rules.spec((None, None)) == P()
+
+
+def test_rules_no_duplicate_mesh_axis():
+    rules = ShardingRules(("a", "tensor"), ("b", "tensor"))
+    # Second use of the same mesh axis falls back to replication.
+    assert rules.spec(("a", "b")) == P("tensor")
+
+
+def test_logical_sharding_and_constraint():
+    mesh = MeshSpec(data=2, fsdp=2, tensor=2).build()
+    with use_mesh(mesh):
+        sh = logical_sharding(("batch", None))
+        assert sh.spec == P(("data", "fsdp"))
+
+        @jax.jit
+        def f(x):
+            return with_logical_constraint(x * 2, "batch", None)
+
+        x = jnp.ones((8, 4))
+        y = f(x)
+        np.testing.assert_allclose(np.asarray(y), 2.0)
+
+
+def test_with_logical_constraint_noop_outside_mesh():
+    x = jnp.ones((4, 4))
+    y = with_logical_constraint(x, "batch", None)
+    assert y is x
+
+
+def test_shard_params_places_leaves():
+    mesh = MeshSpec(fsdp=4, tensor=2).build()
+    params = {"w": jnp.ones((8, 16)), "b": jnp.ones((16,))}
+    axes = {"w": ("embed", "mlp"), "b": (None,)}
+    with use_mesh(mesh):
+        sharded = shard_params(params, axes)
+    assert sharded["w"].sharding.spec == P("fsdp", "tensor")
+    # Per-device shard shape: 8/4 × 16/2.
+    shard = sharded["w"].addressable_shards[0]
+    assert shard.data.shape == (2, 8)
