@@ -21,9 +21,9 @@ def _peak_bf16_flops(device_kind: str):
     kind = device_kind.lower()
     table = [
         ("v6", 918e12),          # Trillium / v6e
-        ("v5 lite", 394e12),     # v5e
-        ("v5litepod", 394e12),
-        ("v5e", 394e12),
+        ("v5 lite", 197e12),     # v5e (394 is the int8 number)
+        ("v5litepod", 197e12),
+        ("v5e", 197e12),
         ("v5p", 459e12),
         ("v5", 459e12),          # bare v5 → assume v5p
         ("v4", 275e12),
@@ -46,11 +46,12 @@ def main():
     on_tpu = platform not in ("cpu",)
 
     if on_tpu:
-        # ~125M-param Llama, bf16, seq 2048 — fits a single v5e chip
-        # with adam state in f32 (remat on: einsum attention stores SxS
-        # probs otherwise; flash-attention kernel will lift this).
-        cfg = llama.LlamaConfig.llama_125m(max_seq_len=2048)
-        batch, seq, steps, warmup = 8, 2048, 20, 3
+        # 440M-param Llama with the Pallas flash-attention kernel —
+        # the largest config that trains with f32 adam state in 16 GB
+        # HBM (measured); bigger hidden → better MXU utilization than
+        # the 125M preset (17.9% vs 13.2% MFU on v5e).
+        cfg = llama.LlamaConfig.llama_440m()
+        batch, seq, steps, warmup = 16, 2048, 10, 3
     else:
         cfg = llama.LlamaConfig.debug()
         batch, seq, steps, warmup = 8, 64, 5, 1

@@ -96,11 +96,14 @@ class _ActorCore:
                 f"{self._creation_error!r}")
 
     # -- submission ----------------------------------------------------------
-    def submit(self, spec: TaskSpec):
+    def submit(self, spec: TaskSpec, bypass_limit: bool = False):
+        """``bypass_limit``: retries of already-accepted tasks skip the
+        pending-calls backpressure check (the limit is a submission-time
+        contract, not a retry gate)."""
         with self._submit_lock:
             if self._stopped.is_set():
                 raise self._dead_error()
-            if self.info.max_pending_calls > 0 and (
+            if not bypass_limit and self.info.max_pending_calls > 0 and (
                     self._queue.qsize() >= self.info.max_pending_calls):
                 raise PendingCallsLimitExceededError(
                     f"actor {self.info.display_name()} has "

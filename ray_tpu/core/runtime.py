@@ -203,7 +203,7 @@ class Runtime:
                     allow_retry=False)
                 return
             try:
-                core.submit(spec)
+                core.submit(spec, bypass_limit=True)
             except Exception as e:
                 self.task_manager.complete_error(spec, e, allow_retry=False)
         else:
@@ -462,7 +462,17 @@ class Runtime:
                                    "actor was killed before creation"),
                     allow_retry=False)
                 return
-            core.submit(creation_spec)
+            try:
+                core.submit(creation_spec)
+            except ActorDiedError as e:
+                # Kill landed between the DEAD check and the submit;
+                # kill_actor usually resolves the creation ref, but
+                # complete_error is idempotent so resolve here too
+                # rather than crashing the daemon thread.
+                self._release_actor_resources(core.info)
+                if self.task_manager.is_pending(creation_spec.task_id):
+                    self.task_manager.complete_error(creation_spec, e,
+                                                     allow_retry=False)
 
         threading.Thread(target=acquire_and_go, daemon=True).start()
         return ActorHandle(actor_id, klass, self,

@@ -1,0 +1,453 @@
+"""Flash attention for TPU (Pallas), fwd + bwd, GQA-aware.
+
+Memory-bound einsum attention materializes the (S, S) score matrix in
+HBM (measured 6.5% MFU on the 125M bench at seq 2048); this kernel
+streams K/V blocks through VMEM with an online softmax so scores never
+leave the chip.  Design points:
+
+- Layout (B, H, S, D) inside the kernel (S on sublanes, D on lanes);
+  the public wrapper takes the model's (B, S, H, D) and transposes.
+- GQA without materializing K/V per q-head in the forward: the kv
+  BlockSpec index-maps ``head // group`` so grouped q-heads share the
+  same K/V blocks.  The backward expands K/V to q-heads (2 extra bf16
+  copies) and group-sums dK/dV — simple and still HBM-light.
+- Causal blocks strictly above the diagonal are skipped via
+  ``pl.when`` + index-map redirect (no DMA, no compute).
+- f32 accumulators in VMEM scratch; running (m, l) kept lane-replicated
+  (shape (block_q, 128)) per TPU layout rules.
+- lse is saved for the backward (recompute-based, à la FA-2).
+
+Interpret mode runs the same kernels on CPU for the simulated-mesh
+test suite.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+DEFAULT_BLOCK = 512
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+def _block_sizes(sq: int, sk: int, block_q: Optional[int],
+                 block_k: Optional[int]):
+    bq = block_q or min(DEFAULT_BLOCK, sq)
+    bk = block_k or min(DEFAULT_BLOCK, sk)
+    while sq % bq:
+        bq //= 2
+    while sk % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+def _supported(sq: int, sk: int, d: int) -> bool:
+    """Shapes the TPU kernel handles without padding."""
+    if d > LANES and d % LANES:
+        return False
+    return sq % 8 == 0 and sk % LANES == 0
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, block_q, block_k, nk, causal):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        # Run blocks on or below the diagonal only.
+        should_run = ki * block_k <= qi * block_q + block_q - 1
+        last_k = jnp.minimum(nk - 1,
+                             (qi * block_q + block_q - 1) // block_k)
+    else:
+        should_run = True
+        last_k = nk - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        l = l_scr[:, :1]
+        # Fully-masked rows (possible in the non-causal ring steps)
+        # produce l == 0; emit zeros and lse == NEG_INF so downstream
+        # merging ignores them.
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l_scr[:] > 0.0,
+                        m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-37)),
+                        NEG_INF)
+        lse_ref[0, 0, :, :] = lse
+
+
+def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    """q: (B, Hq, Sq, D) pre-scaled; k/v: (B, Hkv, Sk, D).
+    Returns o (B, Hq, Sq, D), lse (B, Hq, Sq, LANES) f32."""
+    B, Hq, Sq, D = q.shape
+    _, Hkv, Sk, _ = k.shape
+    group = Hq // Hkv
+    bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
+    nq, nk = Sq // bq, Sk // bk
+    grid = (B, Hq, nq, nk)
+
+    def q_map(b, h, qi, ki):
+        return (b, h, qi, 0)
+
+    def kv_map(b, h, qi, ki):
+        if causal:
+            # Skipped above-diagonal blocks: redirect the prefetch to
+            # block 0 (it will be needed for the next q row).
+            ki = jax.lax.select(bk * ki <= bq * qi + bq - 1, ki, 0)
+        return (b, h // group, ki, 0)
+
+    def o_map(b, h, qi, ki):
+        return (b, h, qi, 0)
+
+    kernel = functools.partial(_fwd_kernel, block_q=bq, block_k=bk,
+                               nk=nk, causal=causal)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), q_map),
+            pl.BlockSpec((1, 1, bk, D), kv_map),
+            pl.BlockSpec((1, 1, bk, D), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), o_map),
+            pl.BlockSpec((1, 1, bq, LANES), o_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, Sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, block_q, block_k, nk, causal):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        should_run = ki * block_k <= qi * block_q + block_q - 1
+        last_k = jnp.minimum(nk - 1,
+                             (qi * block_q + block_q - 1) // block_k)
+    else:
+        should_run = True
+        last_k = nk - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_scr, dv_scr,
+                 *, block_q, block_k, nq, causal):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        # Need q rows at or below this kv block's diagonal.
+        should_run = qi * block_q + block_q - 1 >= ki * block_k
+    else:
+        should_run = True
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        pt = p.astype(do.dtype)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
+              interpret):
+    """All inputs (B, Hq, S, D) (k/v pre-expanded to q heads); returns
+    (dq, dk, dv) at q-head granularity, un-scaled."""
+    B, Hq, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
+    nq, nk = Sq // bq, Sk // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # (B, Hq, Sq)
+    delta = jnp.broadcast_to(delta[..., None], (B, Hq, Sq, LANES))
+
+    def q_map(b, h, qi, ki):
+        return (b, h, qi, 0)
+
+    def k_map_q(b, h, qi, ki):
+        if causal:
+            ki = jax.lax.select(bk * ki <= bq * qi + bq - 1, ki, 0)
+        return (b, h, ki, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=bq, block_k=bk, nk=nk,
+                          causal=causal),
+        grid=(B, Hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), q_map),
+            pl.BlockSpec((1, 1, bk, D), k_map_q),
+            pl.BlockSpec((1, 1, bk, D), k_map_q),
+            pl.BlockSpec((1, 1, bq, D), q_map),
+            pl.BlockSpec((1, 1, bq, LANES), q_map),
+            pl.BlockSpec((1, 1, bq, LANES), q_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), q_map),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    def kv_map(b, h, ki, qi):
+        return (b, h, ki, 0)
+
+    def q_map_kv(b, h, ki, qi):
+        if causal:
+            # Above-diagonal (skipped) blocks: redirect prefetch to the
+            # last q block, which is always executed.
+            qi = jax.lax.select(bq * qi + bq - 1 >= bk * ki, qi, nq - 1)
+        return (b, h, qi, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, block_q=bq, block_k=bk, nq=nq,
+                          causal=causal),
+        grid=(B, Hq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), q_map_kv),
+            pl.BlockSpec((1, 1, bk, D), kv_map),
+            pl.BlockSpec((1, 1, bk, D), kv_map),
+            pl.BlockSpec((1, 1, bq, D), q_map_kv),
+            pl.BlockSpec((1, 1, bq, LANES), q_map_kv),
+            pl.BlockSpec((1, 1, bq, LANES), q_map_kv),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), kv_map),
+            pl.BlockSpec((1, 1, bk, D), kv_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Sk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, Sk, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API (custom VJP)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    o, _ = _flash_fwd_res(q, k, v, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_res(q, k, v, causal, block_q, block_k):
+    B, S, Hq, D = q.shape
+    scale = D ** -0.5
+    qt = jnp.transpose(q, (0, 2, 1, 3)) * jnp.asarray(scale, q.dtype)
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    o, lse = _fwd(qt, kt, vt, causal=causal, block_q=block_q,
+                  block_k=block_k, interpret=_use_interpret())
+    out = jnp.transpose(o, (0, 2, 1, 3))
+    return out, (qt, kt, vt, o, lse)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    out, res = _flash_fwd_res(q, k, v, causal, block_q, block_k)
+    return out, res
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    qt, kt, vt, o, lse = res
+    B, Hq, Sq, D = qt.shape
+    Hkv = kt.shape[1]
+    group = Hq // Hkv
+    scale = D ** -0.5
+    do = jnp.transpose(g, (0, 2, 1, 3))
+    k_full = jnp.repeat(kt, group, axis=1)
+    v_full = jnp.repeat(vt, group, axis=1)
+    dq, dk, dv = _bwd_impl(qt, k_full, v_full, o, lse, do,
+                           causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=_use_interpret())
+    dq = dq * scale  # qt was pre-scaled; undo for d(original q)
+    dk = dk.reshape(B, Hkv, group, -1, D).sum(axis=2)
+    dv = dv.reshape(B, Hkv, group, -1, D).sum(axis=2)
+    dq = jnp.transpose(dq, (0, 2, 1, 3)).astype(qt.dtype)
+    dk = jnp.transpose(dk, (0, 2, 1, 3)).astype(kt.dtype)
+    dv = jnp.transpose(dv, (0, 2, 1, 3)).astype(vt.dtype)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None) -> jax.Array:
+    """Flash attention.  q: (B, S, Hq, D); k/v: (B, S, Hkv, D) with
+    Hq % Hkv == 0 (GQA).  Softmax scale is D**-0.5 (applied inside).
+
+    Falls back to the einsum path for shapes the TPU kernel does not
+    tile (tiny/odd S or D) — numerics are identical either way.
+    """
+    B, Sq, Hq, D = q.shape
+    Sk = k.shape[1]
+    if Hq % k.shape[2]:
+        raise ValueError(f"Hq={Hq} not a multiple of Hkv={k.shape[2]}")
+    if not _use_interpret() and not _supported(Sq, Sk, D):
+        return _einsum_fallback(q, k, v, causal)
+    return _flash(q, k, v, causal, block_q, block_k)
+
+
+def _einsum_fallback(q, k, v, causal):
+    B, Sq, Hq, D = q.shape
+    if causal:
+        from ray_tpu.models.llama import dot_attention
+
+        positions = jnp.broadcast_to(jnp.arange(Sq, dtype=jnp.int32),
+                                     (B, Sq))
+        return dot_attention(q, k, v, positions)
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+    qg = q.reshape(B, Sq, Hkv, group, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v)
+    return out.reshape(B, Sq, Hq, D)
+
+
+def flash_attention_causal(q, k, v, positions=None):
+    """Drop-in for models.llama.dot_attention (standard causal layout;
+    packed/offset positions must use the dot path)."""
+    return flash_attention(q, k, v, causal=True)

@@ -141,3 +141,22 @@ def test_pending_calls_limit_backout_no_leak(ray_start_regular):
     # `arg` itself (+ nothing else) should be pinned by us.
     assert _wait(lambda: rt.task_manager.num_pending() == 0)
     assert rt.reference_counter.num_tracked() <= tracked_before
+
+
+def test_retry_bypasses_pending_calls_limit(ray_start_regular):
+    @ray_tpu.remote(max_pending_calls=1)
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def flaky(self):
+            self.calls += 1
+            if self.calls == 1:
+                raise ValueError("first call fails")
+            return self.calls
+
+    a = Flaky.remote()
+    ref = a.flaky.options(max_retries=3, retry_exceptions=True).remote()
+    # The retry of an accepted task must not be rejected by the
+    # submission-time pending-calls limit.
+    assert ray_tpu.get(ref) == 2
