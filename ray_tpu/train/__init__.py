@@ -1,0 +1,33 @@
+"""ray_tpu.train — distributed training orchestration (Ray Train
+equivalent, SURVEY.md §2.5, TPU-native).
+
+Public surface mirrors ray.train:
+``JaxTrainer`` (the torch/TF/lightning trainers' TPU counterpart),
+``ScalingConfig``/``RunConfig``/``FailureConfig``/``CheckpointConfig``,
+``Checkpoint``, ``report``/``get_context``/``get_checkpoint``/
+``get_dataset_shard``.
+"""
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (CheckpointConfig, FailureConfig, Result, RunConfig,
+                     ScalingConfig)
+from .session import (get_checkpoint, get_context, get_dataset_shard,
+                      make_temp_checkpoint_dir, report)
+from .trainer import JaxTrainer, TrainingFailedError
+
+__all__ = [
+    "JaxTrainer",
+    "TrainingFailedError",
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "Checkpoint",
+    "CheckpointManager",
+    "Result",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "get_dataset_shard",
+    "make_temp_checkpoint_dir",
+]

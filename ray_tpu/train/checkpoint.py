@@ -1,0 +1,209 @@
+"""Checkpoints: directory handles + top-k retention + jax-state IO.
+
+Reference: Checkpoint (train/_checkpoint.py:56) is a directory on a
+filesystem; CheckpointManager (train/_internal/checkpoint_manager.py)
+keeps the top-k by a score attribute; StorageContext persists
+(train/_internal/storage.py:358,514).
+
+TPU-native state IO uses orbax when available (async-capable,
+sharding-aware restore for `jax.Array` pytrees) with an msgpack-free
+numpy fallback so checkpoints work in minimal environments.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Checkpoint:
+    """A directory of checkpoint data (reference: train/_checkpoint.py:56)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        dst = path or tempfile.mkdtemp(prefix="ckpt_")
+        if os.path.abspath(dst) != self.path:
+            shutil.copytree(self.path, dst, dirs_exist_ok=True)
+        return dst
+
+    # ---- jax-state convenience ------------------------------------------
+    def save_state(self, state: Any, name: str = "state"):
+        save_pytree(state, os.path.join(self.path, name))
+
+    def load_state(self, name: str = "state",
+                   template: Optional[Any] = None) -> Any:
+        return load_pytree(os.path.join(self.path, name), template)
+
+    def update_metadata(self, metadata: Dict[str, Any]):
+        meta_path = os.path.join(self.path, "_metadata.json")
+        meta = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        meta.update(metadata)
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        meta_path = os.path.join(self.path, "_metadata.json")
+        if not os.path.exists(meta_path):
+            return {}
+        with open(meta_path) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path!r})"
+
+
+# ---------------------------------------------------------------------------
+# PyTree state IO (orbax with pickle/numpy fallback)
+# ---------------------------------------------------------------------------
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except Exception:
+        return None
+
+
+def save_pytree(state: Any, path: str):
+    """Persist a pytree of jax/numpy arrays to ``path`` (a directory)."""
+    ocp = _try_orbax()
+    path = os.path.abspath(path)
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        ckptr.save(path, state)
+        return
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    leaves, treedef = jax.tree.flatten(state)
+    import numpy as np
+
+    np.savez(os.path.join(path, "leaves.npz"),
+             **{str(i): np.asarray(x) for i, x in enumerate(leaves)})
+    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def load_pytree(path: str, template: Optional[Any] = None) -> Any:
+    ocp = _try_orbax()
+    path = os.path.abspath(path)
+    if ocp is not None and not os.path.exists(
+            os.path.join(path, "treedef.pkl")):
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(path, item=template)
+        return restored
+    import jax
+    import numpy as np
+
+    data = np.load(os.path.join(path, "leaves.npz"))
+    leaves = [data[str(i)] for i in range(len(data.files))]
+    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Top-k retention
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Registers reported checkpoints, retains top-k by score
+    (reference: train/_internal/checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str,
+                 num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.storage_path = os.path.abspath(storage_path)
+        os.makedirs(self.storage_path, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._lock = threading.Lock()
+        # [(path, metrics, index)]
+        self._checkpoints: List[Tuple[str, Dict[str, Any], int]] = []
+        self._index = 0
+
+    def register(self, source_dir: str,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        """Copy a worker-produced checkpoint dir into storage."""
+        with self._lock:
+            idx = self._index
+            self._index += 1
+        dst = os.path.join(self.storage_path, f"checkpoint_{idx:06d}")
+        if os.path.abspath(source_dir) != dst:
+            shutil.copytree(source_dir, dst, dirs_exist_ok=True)
+        ckpt = Checkpoint(dst)
+        ckpt.update_metadata({"metrics": _json_safe(metrics),
+                              "index": idx,
+                              "time": time.time()})
+        with self._lock:
+            self._checkpoints.append((dst, metrics, idx))
+            self._evict_locked()
+        return ckpt
+
+    def _score(self, entry):
+        path, metrics, idx = entry
+        if self.score_attribute and self.score_attribute in metrics:
+            v = metrics[self.score_attribute]
+            return v if self.score_order == "max" else -v
+        return idx  # recency
+
+    def _evict_locked(self):
+        if self.num_to_keep is None:
+            return
+        while len(self._checkpoints) > self.num_to_keep:
+            worst = min(self._checkpoints, key=self._score)
+            self._checkpoints.remove(worst)
+            shutil.rmtree(worst[0], ignore_errors=True)
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._checkpoints:
+                return None
+            best = max(self._checkpoints, key=self._score)
+        return Checkpoint(best[0])
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._checkpoints:
+                return None
+            latest = max(self._checkpoints, key=lambda e: e[2])
+        return Checkpoint(latest[0])
+
+    def list_checkpoints(self) -> List[Checkpoint]:
+        with self._lock:
+            return [Checkpoint(p) for p, _m, _i in
+                    sorted(self._checkpoints, key=lambda e: e[2])]
+
+
+def _json_safe(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in metrics.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = float(v) if hasattr(v, "__float__") else repr(v)
+    return out

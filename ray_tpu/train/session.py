@@ -1,0 +1,130 @@
+"""Per-worker training session.
+
+Reference: train/_internal/session.py:111 (_TrainSession), :403/:667
+(report), :478 (get_context), :1067 (get_dataset_shard).  The session
+is thread-local state inside each worker actor; ``ray_tpu.train.report``
+and friends resolve it.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+
+class TrainContext:
+    """What the user loop can introspect (reference session accessors:
+    get_world_size/get_world_rank/get_local_rank etc.)."""
+
+    def __init__(self, *, rank: int, world_size: int, local_rank: int = 0,
+                 mesh=None, experiment_name: str = "",
+                 storage_path: str = "", datasets=None,
+                 latest_checkpoint: Optional[Checkpoint] = None):
+        self._rank = rank
+        self._world_size = world_size
+        self._local_rank = local_rank
+        self.mesh = mesh
+        self._experiment_name = experiment_name
+        self._storage_path = storage_path
+        self._datasets = datasets or {}
+        self._latest_checkpoint = latest_checkpoint
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_world_rank(self) -> int:
+        return self._rank
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_experiment_name(self) -> str:
+        return self._experiment_name
+
+    def get_storage_path(self) -> str:
+        return self._storage_path
+
+
+class _Session:
+    def __init__(self, context: TrainContext, collector,
+                 latest_checkpoint: Optional[Checkpoint]):
+        self.context = context
+        self.collector = collector  # _ReportCollector actor handle
+        self.latest_checkpoint = latest_checkpoint
+        self.iteration = 0
+
+
+class _SessionHolder(threading.local):
+    def __init__(self):
+        self.session: Optional[_Session] = None
+
+
+_holder = _SessionHolder()
+
+
+def _set_session(session: Optional[_Session]):
+    _holder.session = session
+
+
+def _get_session() -> _Session:
+    if _holder.session is None:
+        raise RuntimeError(
+            "No train session active — this API must be called from "
+            "inside train_loop_per_worker")
+    return _holder.session
+
+
+def in_session() -> bool:
+    return _holder.session is not None
+
+
+# ------------------------------------------------------------------ public
+def get_context() -> TrainContext:
+    return _get_session().context
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (+ optionally a checkpoint) to the trainer
+    (reference: train.report, session.py:667)."""
+    import ray_tpu
+
+    s = _get_session()
+    s.iteration += 1
+    ckpt_dir = checkpoint.path if checkpoint is not None else None
+    ray_tpu.get(s.collector.report.remote(
+        s.context.get_world_rank(), s.iteration, dict(metrics), ckpt_dir))
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _get_session().latest_checkpoint
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    """Per-worker shard of a dataset passed to the trainer
+    (reference: session.py:1067 + train/_internal/data_config.py)."""
+    s = _get_session()
+    ds = s.context._datasets.get(dataset_name)
+    if ds is None:
+        raise KeyError(f"no dataset named {dataset_name!r} "
+                       f"(have {list(s.context._datasets)})")
+    rank = s.context.get_world_rank()
+    world = s.context.get_world_size()
+    # ray_tpu.data.Dataset → streaming split; plain iterables → strided.
+    if hasattr(ds, "streaming_split"):
+        return ds.streaming_split(world)[rank]
+    return _strided_shard(ds, rank, world)
+
+
+def _strided_shard(iterable, rank: int, world: int):
+    for i, item in enumerate(iterable):
+        if i % world == rank:
+            yield item
+
+
+def make_temp_checkpoint_dir() -> str:
+    return tempfile.mkdtemp(prefix="ray_tpu_ckpt_")

@@ -1,0 +1,126 @@
+"""JaxTrainer: the DataParallelTrainer equivalent.
+
+Reference flow: BaseTrainer.fit (train/base_trainer.py:567) →
+DataParallelTrainer loop (data_parallel_trainer.py:25) →
+BackendExecutor.start (backend_executor.py:135) creates a WorkerGroup
+and runs `train_loop_per_worker` on every worker; FailureConfig
+restarts from the latest checkpoint (air/config.py:394).
+
+TPU-native differences: the distributed backend is a jax device mesh
+(`ScalingConfig.mesh`), not a torch process group, and parallelism
+strategies (dp/fsdp/tp/pp/sp/ep) are mesh axes rather than wrapper
+classes.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (CheckpointConfig, FailureConfig, Result, RunConfig,
+                     ScalingConfig)
+from .worker_group import WorkerGroup, _ReportCollector
+
+
+class JaxTrainer:
+    """Run ``train_loop_per_worker`` on a gang of workers over a jax
+    mesh.  Inside the loop use ``ray_tpu.train.report`` /
+    ``get_context`` / ``get_dataset_shard`` / ``get_checkpoint``.
+    """
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------
+    def fit(self) -> Result:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+
+        name = self.run_config.name or "jax_trainer"
+        storage = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results", name)
+        ckpt_cfg: CheckpointConfig = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            storage,
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order)
+
+        failure: FailureConfig = self.run_config.failure_config
+        max_failures = failure.max_failures
+        attempts = 0
+        latest_ckpt = self.resume_from_checkpoint
+        last_error: Optional[BaseException] = None
+        all_metrics: list = []
+
+        while True:
+            collector = _ReportCollector.remote()
+            group = WorkerGroup(
+                self.scaling_config.num_workers,
+                self.scaling_config.worker_resources(),
+                self.scaling_config.placement_strategy)
+            try:
+                refs = group.run_all_async(
+                    "run", self.train_loop_per_worker,
+                    self.train_loop_config, self.scaling_config.mesh,
+                    collector, name, storage, self.datasets,
+                    latest_ckpt.path if latest_ckpt else None)
+                ray_tpu.get(refs)
+                reports, ckpt_dirs = ray_tpu.get(collector.drain.remote())
+                for metrics, cdir in zip(reports, ckpt_dirs):
+                    all_metrics.append(metrics)
+                    if cdir:
+                        latest_ckpt = manager.register(cdir, metrics)
+                last_error = None
+                break
+            except Exception as e:  # worker failure
+                reports, ckpt_dirs = ray_tpu.get(collector.drain.remote())
+                for metrics, cdir in zip(reports, ckpt_dirs):
+                    all_metrics.append(metrics)
+                    if cdir:
+                        latest_ckpt = manager.register(cdir, metrics)
+                last_error = e
+                attempts += 1
+                if max_failures >= 0 and attempts > max_failures:
+                    break
+                if manager.latest_checkpoint() is not None:
+                    latest_ckpt = manager.latest_checkpoint()
+            finally:
+                group.shutdown()
+                try:
+                    ray_tpu.kill(collector)
+                except Exception:
+                    pass
+
+        final_ckpt = manager.best_checkpoint() or latest_ckpt
+        result = Result(
+            metrics=all_metrics[-1] if all_metrics else {},
+            checkpoint=final_ckpt,
+            error=last_error,
+            path=storage)
+        result._best_checkpoints = manager.list_checkpoints()
+        if last_error is not None and max_failures >= 0:
+            raise TrainingFailedError(
+                f"training failed after {attempts} attempt(s)"
+            ) from last_error
+        return result
+
+
+class TrainingFailedError(RuntimeError):
+    """Reference: ray.train.base_trainer.TrainingFailedError."""
