@@ -132,6 +132,11 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     ns = namespace if namespace is not None else rt.namespace
     actor_id = rt.actor_manager.get_named(name, ns)
     if actor_id is None:
+        if rt.cluster is not None:
+            found = rt.cluster.lookup_named_actor(name, ns)
+            if found is not None:
+                aid_bytes, klass, _node, _addr = found
+                return ActorHandle(ActorID(aid_bytes), klass, rt)
         raise ValueError(
             f"no actor named {name!r} in namespace {ns!r}")
     return rt.actor_manager.get_handle(actor_id)
@@ -153,6 +158,12 @@ def get_runtime_context():
 
 def nodes():
     rt = get_runtime()
+    if rt.cluster is not None:
+        return [{
+            "NodeID": n["node_id"], "Alive": n["alive"],
+            "Resources": n["total"], "alive": n["alive"],
+            "NodeManagerAddress": n["address"],
+        } for n in rt.cluster.list_nodes()]
     return [{
         "NodeID": rt.node_id.hex(),
         "Alive": True,
@@ -162,11 +173,27 @@ def nodes():
 
 
 def cluster_resources() -> Dict[str, float]:
-    return get_runtime().node_resources.total
+    rt = get_runtime()
+    if rt.cluster is not None:
+        total: Dict[str, float] = {}
+        for n in rt.cluster.list_nodes():
+            if n["alive"]:
+                for k, v in n["total"].items():
+                    total[k] = total.get(k, 0) + v
+        return total
+    return rt.node_resources.total
 
 
 def available_resources() -> Dict[str, float]:
-    return get_runtime().node_resources.available()
+    rt = get_runtime()
+    if rt.cluster is not None:
+        total: Dict[str, float] = {}
+        for n in rt.cluster.list_nodes():
+            if n["alive"]:
+                for k, v in n["available"].items():
+                    total[k] = total.get(k, 0) + v
+        return total
+    return rt.node_resources.available()
 
 
 def timeline(filename: Optional[str] = None):

@@ -1,0 +1,435 @@
+"""Node attachment: every cluster participant (driver or worker) runs a
+NodeServer (execution + object service) and a ClusterClient (control
+client + remote submitters).
+
+Reference analogues:
+- NodeServer ≈ the task receiver + object-serving half of CoreWorker
+  (src/ray/core_worker/transport/task_receiver.h:51,
+  core_worker.cc:3660 HandlePushTask) plus the raylet's role as the
+  node-local execution host.
+- ClusterClient ≈ NormalTaskSubmitter / ActorTaskSubmitter
+  (transport/normal_task_submitter.h:74, actor_task_submitter.h:75):
+  owner-side placement, push, completion, and failure handling, with
+  the head standing in for GCS.
+
+Ownership model (simplified borrower protocol): the process that
+creates an object owns it; refs carry the owner's address; consumers
+fetch from the owner on demand and cache a local immutable copy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+from .rpc import ClientPool, Deferred, RpcClient, RpcServer
+from .serialization import dumps, from_wire, loads, to_wire
+
+_HEARTBEAT_S = 1.0
+
+
+class ClusterClient:
+    """Attached to a Runtime; makes it a cluster node."""
+
+    def __init__(self, runtime, head_address: str,
+                 node_name: str = "", labels: Optional[Dict] = None):
+        self.runtime = runtime
+        self.head = RpcClient(head_address)
+        self.head_address = head_address
+        self.pool = ClientPool()
+        self.node_id = runtime.node_id.hex()
+        self.node_name = node_name
+        # actor_id -> (node_id, address) location cache
+        self._actor_locations: Dict[Any, Tuple[str, str]] = {}
+        self._loc_lock = threading.Lock()
+        self._stopped = threading.Event()
+
+        self.server = NodeServer(runtime, self)
+        self.address = self.server.address
+        self.head.call("register_node", {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources": dict(runtime.node_resources.total),
+            "labels": dict(labels or {}), "name": node_name,
+        })
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"cluster-hb-{self.node_id[:8]}")
+        self._hb_thread.start()
+
+    # ---------------------------------------------------------- heartbeat
+    def _heartbeat_loop(self):
+        while not self._stopped.wait(_HEARTBEAT_S):
+            try:
+                self.head.call("heartbeat", {
+                    "node_id": self.node_id,
+                    "available": dict(self.runtime.node_resources.available),
+                }, timeout=5.0)
+            except (ConnectionError, TimeoutError):
+                if self._stopped.is_set():
+                    return
+                # Head unreachable: keep trying (reference: retryable
+                # gRPC client to GCS).
+                time.sleep(_HEARTBEAT_S)
+            except Exception:
+                traceback.print_exc()
+
+    # ------------------------------------------------------------- tasks
+    def submit_remote_task(self, spec) -> None:
+        """Owner-side push of a plain task to a remote node.  Completion
+        (success, user error, node death) seals the owner's return refs
+        via the local TaskManager, so retries and ref semantics are
+        identical to local execution."""
+        from ..exceptions import NodeDiedError, TaskError
+
+        try:
+            placed = self._place(spec.resources,
+                                 exclude=spec.excluded_nodes())
+        except Exception as e:
+            self.runtime.task_manager.complete_error(
+                spec, TaskError(spec.repr_name(), e), allow_retry=False)
+            return
+        node_id, address = placed
+        bundle = dumps({
+            "function": spec.function,
+            "args": spec.args, "kwargs": spec.kwargs,
+            "num_returns": spec.num_returns,
+            "name": spec.name,
+        })
+
+        def on_done(result, is_error):
+            if is_error:
+                # Transport failure → node presumed dead → retriable.
+                self._report_node_failure(node_id)
+                spec.exclude_node(node_id)
+                self.runtime.task_manager.complete_error(
+                    spec, NodeDiedError(
+                        f"node {node_id[:8]} died running "
+                        f"{spec.repr_name()}: {result}"))
+                return
+            status, payload = result
+            if status == "ok":
+                self.runtime.task_manager.complete_success(
+                    spec, loads(payload))
+            else:
+                self.runtime.task_manager.complete_error(spec, payload)
+
+        try:
+            self.pool.get(address).call_async(
+                "push_task", bundle, callback=on_done)
+        except ConnectionError as e:
+            self._report_node_failure(node_id)
+            spec.exclude_node(node_id)
+            self.runtime.task_manager.complete_error(
+                spec, NodeDiedError(f"push to {node_id[:8]} failed: {e}"))
+
+    def _place(self, resources, exclude=()) -> Tuple[str, str]:
+        resp = self.head.call("place", {
+            "resources": dict(resources or {}),
+            "exclude": list(exclude)}, timeout=30.0)
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "placement failed"))
+        return resp["node_id"], resp["address"]
+
+    def _report_node_failure(self, node_id: str):
+        try:
+            self.head.call("report_node_failure", {"node_id": node_id},
+                           timeout=5.0)
+        except Exception:
+            pass
+        with self._loc_lock:
+            for aid in [a for a, (n, _addr) in
+                        self._actor_locations.items() if n == node_id]:
+                del self._actor_locations[aid]
+
+    # ------------------------------------------------------------ objects
+    def fetch_object(self, ref) -> None:
+        """Pull an object from its owner and seal a local copy."""
+        from ..core.object_store import RayObject
+        from ..exceptions import OwnerDiedError
+
+        oid = ref.object_id()
+        owner = ref.owner_address()
+        try:
+            resp = self.pool.get(owner).call(
+                "get_object", {"oid": oid}, timeout=300.0)
+        except (ConnectionError, TimeoutError) as e:
+            self.runtime.object_store.put(
+                oid, RayObject(error=OwnerDiedError(
+                    f"owner {owner} of {ref!r} unreachable: {e}")))
+            return
+        if resp.get("error") is not None:
+            self.runtime.object_store.put(
+                oid, RayObject(error=resp["error"]))
+        else:
+            self.runtime.object_store.put(
+                oid, RayObject(sealed=from_wire(resp["data"])))
+
+    def ensure_local(self, ref) -> None:
+        owner = ref.owner_address()
+        if not owner or owner == self.address:
+            return
+        if self.runtime.object_store.contains(ref.object_id()):
+            return
+        self.fetch_object(ref)
+
+    def ensure_args_local(self, args, kwargs) -> None:
+        from ..core.object_ref import ObjectRef
+
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, ObjectRef):
+                self.ensure_local(a)
+
+    # ------------------------------------------------------------- actors
+    def create_remote_actor(self, actor_id, klass, args, kwargs,
+                            options: Dict[str, Any],
+                            demand: Dict[str, float]) -> Tuple[str, str]:
+        """Place + create an actor on a remote node; returns its
+        location.  Raises if no node fits."""
+        node_id, address = self._place(demand)
+        bundle = dumps({
+            "actor_id": actor_id, "klass": klass,
+            "args": args, "kwargs": kwargs, "options": options,
+        })
+        resp = self.pool.get(address).call("create_actor", bundle,
+                                           timeout=300.0)
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "actor creation failed"))
+        with self._loc_lock:
+            self._actor_locations[actor_id] = (node_id, address)
+        self.head.call("register_actor", {
+            "actor_id": actor_id.binary(),
+            "node_id": node_id, "address": address,
+            "name": options.get("name", ""),
+            "namespace": options.get("namespace", ""),
+            "klass": dumps(klass),
+        })
+        return node_id, address
+
+    def locate_actor(self, actor_id) -> Optional[Tuple[str, str]]:
+        with self._loc_lock:
+            loc = self._actor_locations.get(actor_id)
+        if loc is not None:
+            return loc
+        resp = self.head.call("lookup_actor",
+                              {"actor_id": actor_id.binary()})
+        if not resp.get("found"):
+            return None
+        loc = (resp["node_id"], resp["address"])
+        with self._loc_lock:
+            self._actor_locations[actor_id] = loc
+        return loc
+
+    def lookup_named_actor(self, name: str, namespace: str):
+        """Returns (actor_id_bytes, klass, node_id, address) or None."""
+        resp = self.head.call("lookup_named_actor",
+                              {"name": name, "namespace": namespace})
+        if not resp.get("found"):
+            return None
+        return (resp["actor_id"], loads(resp["klass"]),
+                resp["node_id"], resp["address"])
+
+    def submit_remote_actor_task(self, spec, location) -> None:
+        """Owner-side push of an actor method call.  Same completion
+        contract as submit_remote_task."""
+        from ..exceptions import ActorDiedError
+
+        node_id, address = location
+        bundle = dumps({
+            "actor_id": spec.actor_id,
+            "method": spec.descriptor.function_name,
+            "args": spec.args, "kwargs": spec.kwargs,
+            "num_returns": spec.num_returns,
+        })
+
+        def on_done(result, is_error):
+            if is_error:
+                self._report_node_failure(node_id)
+                self.runtime.task_manager.complete_error(
+                    spec, ActorDiedError(
+                        spec.actor_id,
+                        f"actor's node {node_id[:8]} died: {result}"),
+                    allow_retry=False)
+                return
+            status, payload = result
+            if status == "ok":
+                self.runtime.task_manager.complete_success(
+                    spec, loads(payload))
+            else:
+                self.runtime.task_manager.complete_error(
+                    spec, payload, allow_retry=False)
+
+        try:
+            self.pool.get(address).call_async(
+                "actor_call", bundle, callback=on_done)
+        except ConnectionError as e:
+            self._report_node_failure(node_id)
+            self.runtime.task_manager.complete_error(
+                spec, ActorDiedError(spec.actor_id,
+                                     f"actor node unreachable: {e}"),
+                allow_retry=False)
+
+    def kill_remote_actor(self, actor_id, no_restart: bool = True):
+        loc = self.locate_actor(actor_id)
+        if loc is None:
+            return
+        _node_id, address = loc
+        try:
+            self.pool.get(address).call(
+                "kill_actor", {"actor_id": actor_id,
+                               "no_restart": no_restart}, timeout=30.0)
+        except (ConnectionError, TimeoutError):
+            pass
+        self.head.call("remove_actor", {"actor_id": actor_id.binary()})
+        with self._loc_lock:
+            self._actor_locations.pop(actor_id, None)
+
+    def wait_remote_actor_ready(self, actor_id, timeout=None):
+        loc = self.locate_actor(actor_id)
+        if loc is None:
+            raise ValueError(f"no such actor {actor_id!r}")
+        _node_id, address = loc
+        resp = self.pool.get(address).call(
+            "actor_ready", {"actor_id": actor_id, "timeout": timeout},
+            timeout=None if timeout is None else timeout + 5.0)
+        if resp.get("error") is not None:
+            raise resp["error"]
+
+    # ------------------------------------------------------------------ kv
+    def kv_put(self, key: str, value, ns: str = "",
+               overwrite: bool = True) -> bool:
+        return self.head.call("kv_put", {
+            "ns": ns, "key": key, "value": value,
+            "overwrite": overwrite})["added"]
+
+    def kv_get(self, key: str, ns: str = ""):
+        resp = self.head.call("kv_get", {"ns": ns, "key": key})
+        return resp["value"] if resp["found"] else None
+
+    def kv_del(self, key: str, ns: str = "") -> bool:
+        return self.head.call("kv_del", {"ns": ns, "key": key})["deleted"]
+
+    def kv_keys(self, prefix: str = "", ns: str = ""):
+        return self.head.call("kv_keys", {"ns": ns, "prefix": prefix})
+
+    def list_nodes(self):
+        return self.head.call("list_nodes", {})
+
+    # ------------------------------------------------------------ teardown
+    def detach(self):
+        self._stopped.set()
+        try:
+            self.head.call("drain_node", {"node_id": self.node_id},
+                           timeout=2.0)
+        except Exception:
+            pass
+        self.server.shutdown()
+        self.pool.close_all()
+        self.head.close()
+
+
+class NodeServer:
+    """The node-local execution + object service."""
+
+    def __init__(self, runtime, client: ClusterClient):
+        self.runtime = runtime
+        self.client = client
+        self._server = RpcServer({
+            "push_task": self._push_task,
+            "create_actor": self._create_actor,
+            "actor_call": self._actor_call,
+            "actor_ready": self._actor_ready,
+            "kill_actor": self._kill_actor,
+            "get_object": self._get_object,
+            "ping": lambda p: "pong",
+        }, ordered={"actor_call"})
+        self.address = self._server.address
+
+    # Completion helper: collect refs → ("ok", wire) | ("error", exc)
+    def _collect(self, refs, num_returns):
+        from ..core.task_spec import STREAMING
+
+        try:
+            if num_returns == 0 or refs is None:
+                value = None
+                if refs is not None:
+                    self.runtime.get(refs)
+            elif isinstance(refs, list):
+                value = tuple(self.runtime.get(refs))
+            else:
+                value = self.runtime.get(refs)
+            return ("ok", dumps(value))
+        except BaseException as e:  # noqa: BLE001
+            return ("error", e)
+
+    def _push_task(self, wire):
+        from ..core.task_spec import TaskOptions
+
+        bundle = loads(wire)
+        self.client.ensure_args_local(bundle["args"], bundle["kwargs"])
+        opts = TaskOptions(num_returns=bundle["num_returns"],
+                           max_retries=0, name=bundle.get("name"))
+        refs = self.runtime.submit_task(
+            bundle["function"], bundle["args"], bundle["kwargs"], opts)
+        return self._collect(refs, bundle["num_returns"])
+
+    def _create_actor(self, wire):
+        b = loads(wire)
+        o = b["options"]
+        try:
+            self.runtime.create_actor(
+                b["klass"], b["args"], b["kwargs"],
+                name=o.get("name", ""), namespace=o.get("namespace"),
+                max_restarts=o.get("max_restarts", 0),
+                max_task_retries=o.get("max_task_retries", 0),
+                max_concurrency=o.get("max_concurrency"),
+                max_pending_calls=o.get("max_pending_calls", -1),
+                lifetime=o.get("lifetime"),
+                resources=o.get("resources"),
+                _actor_id=b["actor_id"], _skip_cluster_routing=True)
+            return {"ok": True}
+        except Exception as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _actor_call(self, wire):
+        """Ordered: submission runs inline on the connection reader so
+        calls from one caller enter the actor queue in send order."""
+        from ..core.task_spec import TaskOptions
+
+        b = loads(wire)
+        self.client.ensure_args_local(b["args"], b["kwargs"])
+        opts = TaskOptions(num_returns=b["num_returns"], max_retries=0)
+        try:
+            refs = self.runtime.submit_actor_task(
+                b["actor_id"], b["method"], b["args"], b["kwargs"], opts)
+        except BaseException as e:  # noqa: BLE001
+            return ("error", e)
+        return Deferred(lambda: self._collect(refs, b["num_returns"]))
+
+    def _actor_ready(self, p):
+        core = self.runtime.actor_manager.get_core(p["actor_id"])
+        if core is None:
+            return {"error": ValueError(
+                f"no such actor {p['actor_id']!r} on this node")}
+        try:
+            core.wait_ready(p.get("timeout"))
+            return {"error": None}
+        except BaseException as e:  # noqa: BLE001
+            return {"error": e}
+
+    def _kill_actor(self, p):
+        self.runtime.kill_actor(p["actor_id"],
+                                no_restart=p.get("no_restart", True))
+        return {"ok": True}
+
+    def _get_object(self, p):
+        obj = self.runtime.object_store.wait_and_get(p["oid"],
+                                                     timeout=300.0)
+        if obj.is_error():
+            return {"error": obj.error, "data": None}
+        return {"error": None, "data": to_wire(obj.sealed)}
+
+    def shutdown(self):
+        self._server.shutdown()

@@ -1,0 +1,68 @@
+"""In-process multi-node cluster for tests.
+
+Reference: python/ray/cluster_utils.py:135 (Cluster) and the
+``ray_start_cluster`` fixtures (python/ray/tests/conftest.py:508) —
+many nodes on one machine.  Here: the head server runs in the driver
+process; each added node is a real OS subprocess with its own Runtime,
+so tasks/objects/actors genuinely cross process + serialization
+boundaries.
+"""
+
+from __future__ import annotations
+
+import atexit
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True):
+        from ..core.node import start_head
+
+        self.head_address = start_head() if initialize_head else ""
+        self._procs: List[subprocess.Popen] = []
+        atexit.register(self.shutdown)
+
+    def add_node(self, *, num_cpus: float = 1.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 name: str = "", wait: bool = True,
+                 env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+        from ..core.node import start_worker_process, wait_for_nodes
+
+        proc = start_worker_process(
+            self.head_address, num_cpus=num_cpus, resources=resources,
+            node_name=name, env=env)
+        self._procs.append(proc)
+        if wait:
+            # +1: the driver itself registers as a node on connect.
+            alive_target = len(self._procs)
+            wait_for_nodes(self.head_address, alive_target, timeout=60.0)
+        return proc
+
+    def connect(self, **kwargs):
+        """Attach the current process as the driver node."""
+        import ray_tpu
+
+        return ray_tpu.init(address=self.head_address, **kwargs)
+
+    def kill_node(self, proc: subprocess.Popen, timeout: float = 5.0):
+        """Hard-kill a worker node (chaos: reference RayletKiller,
+        _private/test_utils.py:1563)."""
+        proc.kill()
+        proc.wait(timeout=timeout)
+
+    def shutdown(self):
+        from ..core.node import stop_head
+
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 5.0
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs.clear()
+        stop_head()

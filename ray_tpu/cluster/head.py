@@ -1,0 +1,327 @@
+"""Cluster head: the control-plane authority.
+
+Reference analogue: the GCS server (src/ray/gcs/gcs_server/gcs_server.h:88)
+— node table (gcs_node_manager.h:45), actor registry + named actors
+(gcs_actor_manager.h:308), placement groups
+(gcs_placement_group_manager.h:228), internal KV (gcs_kv_manager.h),
+health probing (gcs_health_check_manager.h:45).
+
+Differences by design: scheduling here is *capacity-fit placement* — the
+head picks a node whose total resources fit the demand (preferring the
+most currently-available node from heartbeats) and the node's own local
+scheduler gates actual execution.  This mirrors the reference's
+two-level split (GCS/cluster policy picks, raylet local dispatch gates)
+without leases.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .rpc import RpcServer
+
+_DEAD_AFTER_S = 10.0  # heartbeats missed before a node is declared dead
+
+
+class NodeEntry:
+    __slots__ = ("node_id", "address", "total", "available",
+                 "last_heartbeat", "alive", "labels")
+
+    def __init__(self, node_id: str, address: str,
+                 total: Dict[str, float], labels: Dict[str, str]):
+        self.node_id = node_id
+        self.address = address
+        self.total = dict(total)
+        self.available = dict(total)
+        self.last_heartbeat = time.monotonic()
+        self.alive = True
+        self.labels = labels
+
+
+class HeadServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, NodeEntry] = {}
+        # actor_id(bytes) -> {node_id, address, name, namespace, klass}
+        self._actors: Dict[bytes, Dict[str, Any]] = {}
+        self._named: Dict[Tuple[str, str], bytes] = {}
+        self._kv: Dict[Tuple[str, str], Any] = {}
+        # pg_id -> {bundles: [...], nodes: [node_id per bundle]}
+        self._pgs: Dict[str, Dict[str, Any]] = {}
+        self._server = RpcServer({
+            "register_node": self._register_node,
+            "heartbeat": self._heartbeat,
+            "drain_node": self._drain_node,
+            "list_nodes": self._list_nodes,
+            "place": self._place,
+            "kv_put": self._kv_put,
+            "kv_get": self._kv_get,
+            "kv_del": self._kv_del,
+            "kv_keys": self._kv_keys,
+            "register_actor": self._register_actor,
+            "lookup_actor": self._lookup_actor,
+            "lookup_named_actor": self._lookup_named_actor,
+            "remove_actor": self._remove_actor,
+            "list_actors": self._list_actors_rpc,
+            "create_pg": self._create_pg,
+            "remove_pg": self._remove_pg,
+            "report_node_failure": self._report_node_failure,
+            "ping": lambda p: "pong",
+        }, host=host, port=port)
+        self.address = self._server.address
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        self._reaper.start()
+
+    # ------------------------------------------------------------- nodes
+    def _register_node(self, p):
+        entry = NodeEntry(p["node_id"], p["address"], p["resources"],
+                          p.get("labels", {}))
+        with self._lock:
+            self._nodes[p["node_id"]] = entry
+        return {"ok": True, "num_nodes": len(self._nodes)}
+
+    def _heartbeat(self, p):
+        with self._lock:
+            entry = self._nodes.get(p["node_id"])
+            if entry is None:
+                return {"ok": False, "reregister": True}
+            entry.last_heartbeat = time.monotonic()
+            entry.alive = True
+            if "available" in p:
+                entry.available = dict(p["available"])
+            if "add_resources" in p:
+                for k, v in p["add_resources"].items():
+                    entry.total[k] = entry.total.get(k, 0) + v
+                    entry.available[k] = entry.available.get(k, 0) + v
+            if "remove_resources" in p:
+                for k in p["remove_resources"]:
+                    entry.total.pop(k, None)
+                    entry.available.pop(k, None)
+        return {"ok": True}
+
+    def _drain_node(self, p):
+        with self._lock:
+            entry = self._nodes.pop(p["node_id"], None)
+            self._forget_actors_on(p["node_id"])
+        return {"ok": entry is not None}
+
+    def _report_node_failure(self, p):
+        """A peer observed a broken connection to this node."""
+        with self._lock:
+            entry = self._nodes.get(p["node_id"])
+            if entry is not None:
+                entry.alive = False
+            dead_actors = self._forget_actors_on(p["node_id"])
+        return {"ok": True, "dead_actors": dead_actors}
+
+    def _forget_actors_on(self, node_id: str) -> List[bytes]:
+        dead = [aid for aid, info in self._actors.items()
+                if info["node_id"] == node_id]
+        for aid in dead:
+            info = self._actors.pop(aid)
+            if info.get("name"):
+                self._named.pop((info.get("namespace", ""), info["name"]),
+                                None)
+        return dead
+
+    def _list_nodes(self, _p):
+        with self._lock:
+            return [{
+                "node_id": e.node_id, "address": e.address,
+                "total": dict(e.total), "available": dict(e.available),
+                "alive": e.alive, "labels": dict(e.labels),
+            } for e in self._nodes.values()]
+
+    def _reap_loop(self):
+        while True:
+            time.sleep(_DEAD_AFTER_S / 4)
+            cutoff = time.monotonic() - _DEAD_AFTER_S
+            with self._lock:
+                for e in self._nodes.values():
+                    if e.alive and e.last_heartbeat < cutoff:
+                        e.alive = False
+                        self._forget_actors_on(e.node_id)
+
+    # ---------------------------------------------------------- placement
+    def _place(self, p):
+        """Pick a node whose TOTAL resources fit the demand; prefer the
+        one with the most available (hybrid-lite: the caller already
+        preferred itself if it fit locally)."""
+        demand: Dict[str, float] = p["resources"]
+        exclude = set(p.get("exclude", ()))
+        with self._lock:
+            candidates = [
+                e for e in self._nodes.values()
+                if e.alive and e.node_id not in exclude
+                and all(e.total.get(k, 0) >= v for k, v in demand.items())
+            ]
+            if not candidates:
+                return {"ok": False,
+                        "error": f"no node can fit {demand} "
+                                 f"(nodes: {[ (e.node_id[:8], e.total) for e in self._nodes.values()]})"}
+
+            def headroom(e: NodeEntry) -> float:
+                return min((e.available.get(k, 0) - v
+                            for k, v in demand.items()), default=0)
+
+            best = max(candidates, key=headroom)
+            # Optimistic debit until the next heartbeat refreshes truth.
+            for k, v in demand.items():
+                best.available[k] = best.available.get(k, 0) - v
+        return {"ok": True, "node_id": best.node_id,
+                "address": best.address}
+
+    # ----------------------------------------------------------------- kv
+    def _kv_put(self, p):
+        key = (p.get("ns", ""), p["key"])
+        with self._lock:
+            exists = key in self._kv
+            if p.get("overwrite", True) or not exists:
+                self._kv[key] = p["value"]
+                return {"ok": True, "added": not exists}
+        return {"ok": True, "added": False}
+
+    def _kv_get(self, p):
+        with self._lock:
+            key = (p.get("ns", ""), p["key"])
+            return {"found": key in self._kv,
+                    "value": self._kv.get(key)}
+
+    def _kv_del(self, p):
+        with self._lock:
+            return {"deleted": self._kv.pop(
+                (p.get("ns", ""), p["key"]), None) is not None}
+
+    def _kv_keys(self, p):
+        prefix = p.get("prefix", "")
+        ns = p.get("ns", "")
+        with self._lock:
+            return [k for (n, k) in self._kv if n == ns
+                    and k.startswith(prefix)]
+
+    # ------------------------------------------------------------- actors
+    def _register_actor(self, p):
+        with self._lock:
+            self._actors[p["actor_id"]] = {
+                "node_id": p["node_id"], "address": p["address"],
+                "name": p.get("name", ""),
+                "namespace": p.get("namespace", ""),
+                "klass": p.get("klass"),
+            }
+            if p.get("name"):
+                key = (p.get("namespace", ""), p["name"])
+                if key in self._named:
+                    existing = self._named[key]
+                    if existing != p["actor_id"]:
+                        return {"ok": False,
+                                "error": f"actor name {p['name']!r} "
+                                         "already taken",
+                                "existing": existing}
+                self._named[key] = p["actor_id"]
+        return {"ok": True}
+
+    def _lookup_actor(self, p):
+        with self._lock:
+            info = self._actors.get(p["actor_id"])
+        if info is None:
+            return {"found": False}
+        return {"found": True, **info}
+
+    def _lookup_named_actor(self, p):
+        key = (p.get("namespace", ""), p["name"])
+        with self._lock:
+            aid = self._named.get(key)
+            info = self._actors.get(aid) if aid else None
+        if info is None:
+            return {"found": False}
+        return {"found": True, "actor_id": aid, **info}
+
+    def _remove_actor(self, p):
+        with self._lock:
+            info = self._actors.pop(p["actor_id"], None)
+            if info and info.get("name"):
+                self._named.pop(
+                    (info.get("namespace", ""), info["name"]), None)
+        return {"ok": info is not None}
+
+    def _list_actors_rpc(self, _p):
+        with self._lock:
+            return [{"actor_id": aid, "node_id": i["node_id"],
+                     "name": i["name"]} for aid, i in self._actors.items()]
+
+    # ---------------------------------------------------------------- pgs
+    def _create_pg(self, p):
+        """Assign each bundle a node (PACK: fill one node first;
+        SPREAD: round-robin) and debit the head's availability view.
+        Reference: two-phase commit against raylets (A.13) — collapsed
+        to one phase here since the head's view is authoritative for
+        placement and nodes gate locally."""
+        bundles: List[Dict[str, float]] = p["bundles"]
+        strategy = p.get("strategy", "PACK")
+        pg_id = p["pg_id"]
+        with self._lock:
+            alive = [e for e in self._nodes.values() if e.alive]
+            if not alive:
+                return {"ok": False, "error": "no alive nodes"}
+            assignment: List[str] = []
+            # Track debits against a scratch copy; commit on success.
+            scratch = {e.node_id: dict(e.available) for e in alive}
+            order = sorted(alive, key=lambda e: -sum(e.total.values()))
+            rr = 0
+            for bundle in bundles:
+                placed = None
+                if strategy in ("PACK", "STRICT_PACK"):
+                    pool = order
+                else:  # SPREAD / STRICT_SPREAD round-robin
+                    pool = order[rr:] + order[:rr]
+                    rr = (rr + 1) % len(order)
+                for e in pool:
+                    avail = scratch[e.node_id]
+                    if all(e.total.get(k, 0) >= v
+                           for k, v in bundle.items()):
+                        if strategy in ("STRICT_SPREAD",) and \
+                                e.node_id in assignment:
+                            continue
+                        for k, v in bundle.items():
+                            avail[k] = avail.get(k, 0) - v
+                        placed = e.node_id
+                        break
+                if placed is None:
+                    return {"ok": False,
+                            "error": f"bundle {bundle} does not fit "
+                                     f"any node (strategy={strategy})"}
+                assignment.append(placed)
+            self._pgs[pg_id] = {"bundles": bundles, "nodes": assignment}
+            addr = {e.node_id: e.address for e in alive}
+        return {"ok": True, "nodes": assignment,
+                "addresses": [addr[n] for n in assignment]}
+
+    def _remove_pg(self, p):
+        with self._lock:
+            return {"ok": self._pgs.pop(p["pg_id"], None) is not None}
+
+    def shutdown(self):
+        self._server.shutdown()
+
+
+def main():  # pragma: no cover - exercised via subprocess in tests
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    head = HeadServer(args.host, args.port)
+    print(f"RAY_TPU_HEAD_ADDRESS={head.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

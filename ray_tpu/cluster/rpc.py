@@ -1,0 +1,361 @@
+"""Socket RPC: length-prefixed pickled messages, request/response.
+
+Reference analogue: src/ray/rpc/ (gRPC server/client wrappers with a
+retryable client and chaos injection, rpc_chaos.h:23).  This framework
+keeps the same shape — a threaded server dispatching named methods, a
+client with pending-request correlation and bounded retries, and a
+fault-injection hook driven by ``RAY_TPU_TESTING_RPC_FAILURE`` — over
+plain TCP sockets (no gRPC dependency; the control plane is low-rate,
+the data plane's heavy bytes ride the same framed stream).
+
+Wire format: 8-byte big-endian length + pickled ``(kind, request_id,
+method, payload)`` where kind is "req" / "resp" / "err".
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct(">Q")
+
+
+# ---------------------------------------------------------------------------
+# Chaos injection (reference: rpc_chaos.h:23 — RAY_testing_rpc_failure)
+# ---------------------------------------------------------------------------
+
+class _Chaos:
+    """Parses ``RAY_TPU_TESTING_RPC_FAILURE="method=N,method2=M"`` and
+    drops the first N calls of each listed method (raises ConnectionError
+    at the caller, exercising retry/failover paths)."""
+
+    def __init__(self):
+        self._budget: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        spec = os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", "")
+        for part in spec.split(","):
+            if "=" in part:
+                method, n = part.split("=", 1)
+                try:
+                    self._budget[method.strip()] = int(n)
+                except ValueError:
+                    pass
+
+    def maybe_fail(self, method: str):
+        with self._lock:
+            left = self._budget.get(method, 0)
+            if left > 0:
+                self._budget[method] = left - 1
+                raise ConnectionError(
+                    f"[chaos] injected rpc failure for {method!r}")
+
+
+def _send_msg(sock: socket.socket, obj: Any, lock: threading.Lock):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    with lock:
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class Deferred:
+    """A handler may return ``Deferred(fn)``: the submission phase ran
+    inline (preserving per-connection arrival order — actor-call
+    ordering, reference actor_scheduling_queue.h) and ``fn()`` produces
+    the response later on a worker thread."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+
+class RpcServer:
+    """Threaded method-dispatch server.
+
+    ``handlers`` maps method name → fn(payload) -> response payload.
+    Each connection gets a reader thread; each request gets a worker
+    thread (requests may block, e.g. ``get_object`` waits for a seal —
+    reference server-call concurrency, rpc/server_call.h).  Methods in
+    ``ordered`` run their handler inline on the connection reader
+    thread so same-connection requests enter in arrival order; they
+    should return a ``Deferred`` for any blocking completion work.
+    """
+
+    def __init__(self, handlers: Dict[str, Callable[[Any], Any]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 ordered: Optional[set] = None):
+        self.handlers = dict(handlers)
+        self.ordered = set(ordered or ())
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.address = "%s:%d" % self._sock.getsockname()
+        self._stopped = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-accept-{self.address}")
+        self._accept_thread.start()
+
+    def add_handler(self, method: str, fn: Callable[[Any], Any]):
+        self.handlers[method] = fn
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket):
+        wlock = threading.Lock()
+        try:
+            while not self._stopped.is_set():
+                kind, req_id, method, payload = _recv_msg(conn)
+                if method in self.ordered:
+                    # Inline submission phase; Deferred completion runs
+                    # on its own thread.
+                    self._handle_one(conn, wlock, req_id, method, payload,
+                                     inline=True)
+                else:
+                    threading.Thread(
+                        target=self._handle_one,
+                        args=(conn, wlock, req_id, method, payload),
+                        daemon=True).start()
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_one(self, conn, wlock, req_id, method, payload,
+                    inline: bool = False):
+        try:
+            fn = self.handlers.get(method)
+            if fn is None:
+                raise AttributeError(f"no rpc method {method!r}")
+            result = fn(payload)
+            if isinstance(result, Deferred):
+                threading.Thread(
+                    target=self._finish_deferred,
+                    args=(conn, wlock, req_id, method, result.fn),
+                    daemon=True).start()
+                return
+            msg = ("resp", req_id, method, result)
+        except BaseException as e:  # noqa: BLE001
+            try:
+                pickle.dumps(e)
+                err: BaseException = e
+            except Exception:
+                err = RuntimeError(f"{type(e).__name__}: {e}")
+            msg = ("err", req_id, method, err)
+        try:
+            _send_msg(conn, msg, wlock)
+        except (ConnectionError, OSError):
+            pass
+
+    def _finish_deferred(self, conn, wlock, req_id, method, fn):
+        try:
+            msg = ("resp", req_id, method, fn())
+        except BaseException as e:  # noqa: BLE001
+            try:
+                pickle.dumps(e)
+                err: BaseException = e
+            except Exception:
+                err = RuntimeError(f"{type(e).__name__}: {e}")
+            msg = ("err", req_id, method, err)
+        try:
+            _send_msg(conn, msg, wlock)
+        except (ConnectionError, OSError):
+            pass
+
+    def shutdown(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Persistent connection to one RpcServer; thread-safe concurrent
+    calls correlated by request id (reference: retryable_grpc_client.h)."""
+
+    def __init__(self, address: str, connect_timeout: float = 10.0):
+        self.address = address
+        self._chaos = _Chaos()
+        self._lock = threading.Lock()      # connection state
+        self._wlock = threading.Lock()     # socket writes
+        self._pending: Dict[str, _PendingCall] = {}
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+        self._connect(connect_timeout)
+
+    def _connect(self, timeout: float):
+        host, port = self.address.rsplit(":", 1)
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(None)
+                self._sock = sock
+                threading.Thread(target=self._read_loop, args=(sock,),
+                                 daemon=True,
+                                 name=f"rpc-read-{self.address}").start()
+                return
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        raise ConnectionError(
+            f"cannot connect to {self.address}: {last_err}")
+
+    def _read_loop(self, sock: socket.socket):
+        try:
+            while True:
+                kind, req_id, _method, payload = _recv_msg(sock)
+                with self._lock:
+                    call = self._pending.pop(req_id, None)
+                if call is not None:
+                    call.finish(payload, is_error=(kind == "err"))
+        except (ConnectionError, EOFError, OSError) as e:
+            self._fail_all(e)
+
+    def _fail_all(self, exc: Exception):
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+            self._sock = None
+        err = ConnectionError(
+            f"connection to {self.address} lost: {exc}")
+        for call in pending:
+            call.finish(err, is_error=True)
+
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None) -> Any:
+        return self.call_async(method, payload).result(timeout)
+
+    def call_async(self, method: str, payload: Any = None,
+                   callback: Optional[Callable[[Any, bool], None]] = None
+                   ) -> "_PendingCall":
+        self._chaos.maybe_fail(method)
+        req_id = uuid.uuid4().hex
+        call = _PendingCall(method, callback)
+        with self._lock:
+            sock = self._sock
+            if sock is None or self._closed:
+                raise ConnectionError(f"not connected to {self.address}")
+            self._pending[req_id] = call
+        try:
+            _send_msg(sock, ("req", req_id, method, payload), self._wlock)
+        except (ConnectionError, OSError) as e:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise ConnectionError(
+                f"send to {self.address} failed: {e}") from e
+        return call
+
+    def close(self):
+        self._closed = True
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._fail_all(ConnectionError("client closed"))
+
+
+class _PendingCall:
+    def __init__(self, method: str,
+                 callback: Optional[Callable[[Any, bool], None]] = None):
+        self.method = method
+        self._event = threading.Event()
+        self._result: Any = None
+        self._is_error = False
+        self._callback = callback
+
+    def finish(self, result: Any, is_error: bool):
+        self._result = result
+        self._is_error = is_error
+        self._event.set()
+        if self._callback is not None:
+            try:
+                self._callback(result, is_error)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"rpc {self.method!r} timed out after {timeout}s")
+        if self._is_error:
+            raise self._result
+        return self._result
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+
+class ClientPool:
+    """Caches one RpcClient per address (worker↔worker object fetches,
+    driver↔many-nodes pushes)."""
+
+    def __init__(self):
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._clients.get(address)
+        if client is not None and client._sock is not None:
+            return client
+        fresh = RpcClient(address)
+        with self._lock:
+            self._clients[address] = fresh
+        return fresh
+
+    def invalidate(self, address: str):
+        with self._lock:
+            client = self._clients.pop(address, None)
+        if client is not None:
+            client.close()
+
+    def close_all(self):
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()

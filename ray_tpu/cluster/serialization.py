@@ -1,0 +1,164 @@
+"""The serialization boundary.
+
+Reference semantics: python/ray/_private/serialization.py — every value
+crossing a task/object boundary is serialized (cloudpickle) so consumers
+get their own copy; numpy arrays are stored out-of-band and returned as
+zero-copy READ-ONLY views (plasma semantics); mutating a ``get`` result
+never aliases the producer's copy.
+
+TPU-native twist: ``jax.Array`` leaves are immutable by construction, so
+in-process they are shared by reference at zero cost (no device→host
+transfer).  Only at a *process* boundary are they pulled to host numpy
+and re-``device_put`` on the receiving side.
+
+Implementation: a cloudpickle Pickler with ``persistent_id`` hooks pulls
+array leaves out of the payload into an extern table:
+
+- in-process: externs are kept live (numpy copies are frozen at seal
+  time so later producer-side mutation can't leak through).
+- on the wire: externs are flattened to ``(kind, dtype, shape, bytes)``
+  and rebuilt on the receiver (``kind == "jax"`` re-device_puts).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+try:
+    import cloudpickle
+except ImportError:  # vendored in most environments; stdlib fallback
+    cloudpickle = None
+
+
+def _jax_array_type():
+    import sys
+
+    jax = sys.modules.get("jax")
+    return jax.Array if jax is not None else None
+
+
+class _ExternPickler((cloudpickle.CloudPickler if cloudpickle is not None
+                      else pickle.Pickler)):
+    """Pickles everything by value (cloudpickle: lambdas, closures,
+    local classes) except array leaves, which become extern handles."""
+
+    def __init__(self, file, externs: List[Any]):
+        super().__init__(file, protocol=pickle.HIGHEST_PROTOCOL)
+        self._externs = externs
+
+    def persistent_id(self, obj):
+        jax_array = _jax_array_type()
+        if jax_array is not None and isinstance(obj, jax_array):
+            self._externs.append(("jax", obj))
+            return len(self._externs) - 1
+        if type(obj) is np.ndarray:
+            # Freeze a copy at seal time: the producer may mutate its
+            # array after put(); consumers must not see that.
+            frozen = obj.copy()
+            frozen.flags.writeable = False
+            self._externs.append(("np", frozen))
+            return len(self._externs) - 1
+        return None
+
+
+class _ExternUnpickler(pickle.Unpickler):
+    def __init__(self, file, externs: List[Tuple[str, Any]]):
+        super().__init__(file)
+        self._externs = externs
+
+    def persistent_load(self, pid):
+        kind, arr = self._externs[pid]
+        return arr
+
+
+class Serialized:
+    """A sealed value: payload bytes + live extern array table."""
+
+    __slots__ = ("payload", "externs")
+
+    def __init__(self, payload: bytes, externs: List[Tuple[str, Any]]):
+        self.payload = payload
+        self.externs = externs
+
+    @property
+    def size_bytes(self) -> int:
+        n = len(self.payload)
+        for _kind, arr in self.externs:
+            n += getattr(arr, "nbytes", 0)
+        return int(n)
+
+
+def serialize(value: Any) -> Serialized:
+    """Seal ``value`` for the object store.  Raises TypeError for
+    unserializable values (matches reference: you cannot put a lock)."""
+    buf = io.BytesIO()
+    externs: List[Any] = []
+    p = _ExternPickler(buf, externs)
+    try:
+        p.dump(value)
+    except (pickle.PicklingError, TypeError, AttributeError) as e:
+        raise TypeError(
+            f"value of type {type(value).__name__} cannot cross the "
+            f"task/object boundary (serialization failed: {e})") from e
+    return Serialized(buf.getvalue(), externs)
+
+
+def deserialize(sealed: Serialized) -> Any:
+    """Rebuild a fresh copy of the value.  Container structure is a new
+    copy per call; array leaves are shared (immutable / frozen)."""
+    return _ExternUnpickler(io.BytesIO(sealed.payload),
+                            sealed.externs).load()
+
+
+# ---------------------------------------------------------------------------
+# Wire format (process boundary)
+# ---------------------------------------------------------------------------
+
+def to_wire(sealed: Serialized) -> bytes:
+    """Flatten payload + externs into one bytes blob."""
+    flat = []
+    for kind, arr in sealed.externs:
+        host = np.asarray(arr)
+        flat.append((kind, str(host.dtype), host.shape,
+                     host.tobytes(order="C")))
+    return pickle.dumps((sealed.payload, flat),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def from_wire(data: bytes) -> Serialized:
+    payload, flat = pickle.loads(data)
+    externs: List[Tuple[str, Any]] = []
+    for kind, dtype, shape, raw in flat:
+        arr = np.frombuffer(raw, dtype=_parse_dtype(dtype)).reshape(shape)
+        if kind == "jax":
+            import jax
+
+            externs.append(("jax", jax.device_put(arr)))
+        else:
+            view = arr  # frombuffer is already read-only
+            externs.append(("np", view))
+    return Serialized(payload, externs)
+
+
+def _parse_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 & friends register with numpy via ml_dtypes.
+        import ml_dtypes  # noqa: F401
+
+        return np.dtype(name)
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot: value → wire bytes."""
+    return to_wire(serialize(value))
+
+
+def loads(data: bytes) -> Any:
+    """One-shot: wire bytes → value."""
+    return deserialize(from_wire(data))

@@ -1,0 +1,61 @@
+"""Worker-node process entry point.
+
+``python -m ray_tpu.cluster.worker_main --head HOST:PORT [...]``
+
+Boots a Runtime (with this node's resources), attaches it to the head,
+and serves until the head connection drops or the parent dies
+(reference: the raylet main loop, src/ray/raylet/main.cc — here the
+node agent and the worker runtime share one process, which is the
+right granularity for jax: one process == one jax client == one
+multi-controller SPMD participant).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True)
+    ap.add_argument("--num-cpus", type=float, default=None)
+    ap.add_argument("--resources", type=str, default="")
+    ap.add_argument("--name", type=str, default="")
+    args = ap.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu.core.node import connect_to_cluster
+
+    resources = json.loads(args.resources) if args.resources else None
+    rt = connect_to_cluster(
+        args.head, num_cpus=args.num_cpus, resources=resources,
+        node_name=args.name)
+    print(f"ray_tpu worker node {rt.node_id.hex()[:12]} "
+          f"@ {rt.address} (head {args.head})", flush=True)
+
+    try:
+        head_gone_since = None
+        while True:
+            time.sleep(1.0)
+            client = rt.cluster
+            if client is None or client._stopped.is_set():
+                return 0
+            # Exit when the head is gone for good (connection lost and
+            # not re-established within a grace window).
+            if client.head._sock is None:
+                head_gone_since = head_gone_since or time.monotonic()
+                if time.monotonic() - head_gone_since > 5.0:
+                    return 0
+            else:
+                head_gone_since = None
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,7 +104,8 @@ class ActorMethod:
         options = _build_options({"max_retries": 0, **decorated},
                                  self._overrides)
         return get_runtime().submit_actor_task(
-            self._handle._actor_id, self._method_name, args, kwargs, options)
+            self._handle._actor_id, self._method_name, args, kwargs,
+            options, klass=self._handle._klass)
 
     def options(self, **overrides) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, overrides)
@@ -142,6 +143,9 @@ class ActorHandle:
         core = self._runtime.actor_manager.get_core(self._actor_id)
         if core is not None:
             core.wait_ready(timeout)
+        elif self._runtime.cluster is not None:
+            self._runtime.cluster.wait_remote_actor_ready(
+                self._actor_id, timeout)
 
     @property
     def actor_id(self) -> ActorID:

@@ -94,11 +94,13 @@ class ObjectRef:
         return fut.__await__()
 
     def __reduce__(self):
-        # Serializing a ref ships the id + owner; the receiving runtime
-        # re-registers it (borrower protocol, simplified).
-        from .runtime import get_runtime
-
-        return (_deserialize_ref, (self._id, self._owner, self._call_site))
+        # Serializing a ref ships the id + owner address; the receiving
+        # runtime re-registers it and can fetch the value from the owner
+        # (borrower protocol, simplified: no distributed ref counts yet).
+        owner = self._owner
+        if not owner and self._runtime is not None:
+            owner = getattr(self._runtime, "address", "") or ""
+        return (_deserialize_ref, (self._id, owner, self._call_site))
 
 
 def _deserialize_ref(object_id, owner, call_site):

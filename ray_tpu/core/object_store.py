@@ -22,17 +22,45 @@ from typing import Any, Callable, Dict, List, Optional
 from .ids import ObjectID
 from ..exceptions import GetTimeoutError, ObjectFreedError
 
+_UNSET = object()
+
 
 class RayObject:
-    """A sealed object: exactly one of value / error is meaningful."""
+    """A sealed object: exactly one of sealed-value / error is meaningful.
 
-    __slots__ = ("value", "error", "size_bytes")
+    Values are sealed through the serialization boundary at put time
+    (cluster/serialization.py): each ``value`` access deserializes a
+    fresh copy of the container structure, so a consumer mutating a
+    ``get`` result can never alias the producer's copy or another
+    consumer's (reference plasma semantics).  Array leaves are shared —
+    jax.Arrays by reference (immutable), numpy as frozen read-only
+    copies.
+    """
 
-    def __init__(self, value: Any = None, error: Optional[BaseException] = None,
-                 size_bytes: int = 0):
-        self.value = value
+    __slots__ = ("sealed", "error", "size_bytes")
+
+    def __init__(self, value: Any = _UNSET, error: Optional[BaseException] = None,
+                 size_bytes: Optional[int] = None, sealed=None):
+        if sealed is not None:
+            self.sealed = sealed
+        elif value is not _UNSET:
+            from ..cluster.serialization import serialize
+
+            self.sealed = serialize(value)
+        else:
+            self.sealed = None
         self.error = error
+        if size_bytes is None:
+            size_bytes = self.sealed.size_bytes if self.sealed else 0
         self.size_bytes = size_bytes
+
+    @property
+    def value(self) -> Any:
+        if self.sealed is None:
+            return None
+        from ..cluster.serialization import deserialize
+
+        return deserialize(self.sealed)
 
     def is_error(self) -> bool:
         return self.error is not None

@@ -34,7 +34,7 @@ from .resources import ResourceSet, detect_node_resources
 from .runtime_context import RuntimeContext, TaskContext
 from .scheduler import LocalScheduler
 from .streaming import StreamingGeneratorManager
-from .task_manager import TaskManager, _sizeof
+from .task_manager import TaskManager
 from .task_spec import (STREAMING, FunctionDescriptor, TaskOptions, TaskSpec)
 from ..exceptions import TaskCancelledError, TaskError
 
@@ -78,6 +78,22 @@ class Runtime:
         self._put_counters: Dict[TaskID, int] = {}
         self._put_lock = threading.Lock()
         self._pg_counter = 0
+        # Cluster attachment (ray_tpu.cluster.client.ClusterClient);
+        # None = single-process mode.
+        self.cluster = None
+
+    @property
+    def address(self) -> str:
+        """This node's object-service address ("" in local mode)."""
+        return self.cluster.address if self.cluster is not None else ""
+
+    def attach_cluster(self, head_address: str, node_name: str = "",
+                       labels: Optional[Dict[str, str]] = None):
+        from ..cluster.client import ClusterClient
+
+        self.cluster = ClusterClient(self, head_address,
+                                     node_name=node_name, labels=labels)
+        return self.cluster
 
     # ------------------------------------------------------------------ ids
     def current_task_id(self) -> TaskID:
@@ -99,7 +115,7 @@ class Runtime:
         oid = self._next_put_id()
         self.reference_counter.add_owned_object(oid)
         self.object_store.put(
-            oid, RayObject(value=value, size_bytes=_sizeof(value)))
+            oid, RayObject(value=value))
         return ObjectRef(oid, self)
 
     def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]],
@@ -122,6 +138,10 @@ class Runtime:
                     "get() on a streaming generator — iterate it instead")
             if not isinstance(ref, ObjectRef):
                 raise TypeError(f"get() expects ObjectRefs, got {type(ref)}")
+            if self.cluster is not None:
+                # Borrowed ref owned by another node: pull + cache a
+                # local immutable copy before waiting.
+                self.cluster.ensure_local(ref)
             t = None if deadline is None else max(
                 0.0, deadline - time.monotonic())
             obj = self.object_store.wait_and_get(ref.object_id(), t)
@@ -207,7 +227,7 @@ class Runtime:
             except Exception as e:
                 self.task_manager.complete_error(spec, e, allow_retry=False)
         else:
-            self.scheduler.submit(spec)
+            self._dispatch(spec)
 
     def _register_and_submit(self, spec: TaskSpec):
         self.task_manager.register_pending(spec)
@@ -218,7 +238,20 @@ class Runtime:
         self.reference_counter.add_submitted_task_references(arg_ids)
         if spec.num_returns == STREAMING:
             self.streaming_manager.create_stream(spec.return_ids[0])
-        self.scheduler.submit(spec)
+        self._dispatch(spec)
+
+    def _dispatch(self, spec: TaskSpec):
+        """Route a plain task: local scheduler if this node can ever
+        satisfy it, otherwise cluster placement (hybrid-lite — the
+        reference prefers local until packed, cluster_task_manager.cc:159;
+        streaming tasks stay local, cross-process generator reporting
+        comes with the object-plane round)."""
+        if (self.cluster is not None
+                and spec.num_returns != STREAMING
+                and not self.node_resources.can_ever_fit(spec.resources)):
+            self.cluster.submit_remote_task(spec)
+        else:
+            self.scheduler.submit(spec)
 
     def _refs_for(self, spec: TaskSpec):
         if spec.num_returns == STREAMING:
@@ -352,7 +385,7 @@ class Runtime:
         item_id = ObjectID.for_return(spec.task_id, index + 1)
         self.reference_counter.add_owned_object(item_id)
         self.object_store.put(
-            item_id, RayObject(value=item, size_bytes=_sizeof(item)))
+            item_id, RayObject(value=item))
         self.streaming_manager.report_item(spec.return_ids[0], item_id)
 
     async def _consume_stream_async(self, spec: TaskSpec, agen):
@@ -398,7 +431,9 @@ class Runtime:
                      num_tpus: Optional[float] = None,
                      resources: Optional[Dict[str, float]] = None,
                      scheduling_strategy=None,
-                     get_if_exists: bool = False):
+                     get_if_exists: bool = False,
+                     _actor_id: Optional[ActorID] = None,
+                     _skip_cluster_routing: bool = False):
         from .actor import ActorHandle
 
         ns = namespace if namespace is not None else self.namespace
@@ -406,8 +441,14 @@ class Runtime:
             existing = self.actor_manager.get_named(name, ns)
             if existing is not None:
                 return self.actor_manager.get_handle(existing)
+            if self.cluster is not None and not _skip_cluster_routing:
+                found = self.cluster.lookup_named_actor(name, ns)
+                if found is not None:
+                    aid_bytes, found_klass, _node, _addr = found
+                    return ActorHandle(ActorID(aid_bytes),
+                                       found_klass, self)
 
-        actor_id = ActorID.of(self.job_id)
+        actor_id = _actor_id or ActorID.of(self.job_id)
         demand: Dict[str, float] = dict(resources or {})
         # Actors default to 1 CPU for *placement* but hold 0 while idle in
         # the reference; in-process we hold what was requested explicitly.
@@ -422,6 +463,21 @@ class Runtime:
                 demand, scheduling_strategy.placement_group_bundle_index)
 
         if demand and not self.node_resources.can_ever_fit(demand):
+            if self.cluster is not None and not _skip_cluster_routing:
+                # Doesn't fit here: place on a remote node via the head
+                # (reference: GCS actor scheduling,
+                # gcs_actor_scheduler.cc:49).
+                self.cluster.create_remote_actor(
+                    actor_id, klass, args, kwargs, {
+                        "name": name, "namespace": ns,
+                        "max_restarts": max_restarts,
+                        "max_task_retries": max_task_retries,
+                        "max_concurrency": max_concurrency,
+                        "max_pending_calls": max_pending_calls,
+                        "lifetime": lifetime,
+                        "resources": demand,
+                    }, demand)
+                return ActorHandle(actor_id, klass, self)
             raise ValueError(
                 f"actor {klass.__name__} demands {demand}, which can never "
                 f"be satisfied by node resources {self.node_resources.total}")
@@ -433,6 +489,17 @@ class Runtime:
             max_pending_calls=max_pending_calls, lifetime=lifetime,
             resources=demand)
         core = self.actor_manager.create(info)
+        if self.cluster is not None and name and not _skip_cluster_routing:
+            # Publish named actors cluster-wide (reference: GCS named
+            # actor registry).
+            from ..cluster.serialization import dumps as _dumps
+
+            self.cluster.head.call("register_actor", {
+                "actor_id": actor_id.binary(),
+                "node_id": self.cluster.node_id,
+                "address": self.cluster.address,
+                "name": name, "namespace": ns, "klass": _dumps(klass),
+            })
 
         creation_task_id = TaskID.for_task(actor_id)
         creation_spec = TaskSpec(
@@ -507,9 +574,13 @@ class Runtime:
         core.submit(spec)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
-                          args, kwargs, options: TaskOptions):
+                          args, kwargs, options: TaskOptions,
+                          klass: Optional[type] = None):
         core = self.actor_manager.get_core(actor_id)
         if core is None:
+            if self.cluster is not None:
+                return self._submit_remote_actor_task(
+                    actor_id, method_name, args, kwargs, options, klass)
             raise ValueError(f"no such actor {actor_id!r}")
         from ..exceptions import ActorDiedError
 
@@ -562,6 +633,44 @@ class Runtime:
                 raise
         return self._refs_for(spec)
 
+    def _submit_remote_actor_task(self, actor_id: ActorID,
+                                  method_name: str, args, kwargs,
+                                  options: TaskOptions,
+                                  klass: Optional[type]):
+        """Owner-side submission of a method call on an actor hosted by
+        another node (reference: actor_task_submitter.h:75 — per-actor
+        client queue + direct push; ordering is preserved by the
+        receiving node's inline submission of ``actor_call``)."""
+        location = self.cluster.locate_actor(actor_id)
+        if location is None:
+            raise ValueError(f"no such actor {actor_id!r}")
+        n = options.num_returns
+        if n == STREAMING:
+            raise NotImplementedError(
+                "streaming generators across nodes land with the "
+                "object-plane round; call the actor from its own node")
+        task_id = TaskID.for_task(actor_id)
+        return_ids = tuple(
+            ObjectID.for_return(task_id, i) for i in range(int(n)))
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, function=None,
+            descriptor=FunctionDescriptor(
+                getattr(klass, "__module__", "") or "", method_name,
+                getattr(klass, "__qualname__", "")),
+            args=tuple(args), kwargs=dict(kwargs), num_returns=n,
+            resources={}, max_retries=0,
+            retry_exceptions=options.retry_exceptions,
+            name=options.name, actor_id=actor_id, is_actor_task=True,
+            parent_task_id=self.current_task_id(), return_ids=return_ids)
+        self.task_manager.register_pending(spec)
+        arg_ids = [a.object_id() for a in spec.args
+                   if isinstance(a, ObjectRef)]
+        arg_ids += [v.object_id() for v in spec.kwargs.values()
+                    if isinstance(v, ObjectRef)]
+        self.reference_counter.add_submitted_task_references(arg_ids)
+        self.cluster.submit_remote_actor_task(spec, location)
+        return self._refs_for(spec)
+
     def _release_actor_resources(self, info):
         """Release exactly once, and only after the creation thread's
         acquire has happened."""
@@ -574,6 +683,9 @@ class Runtime:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         core = self.actor_manager.get_core(actor_id)
+        if core is None and self.cluster is not None:
+            self.cluster.kill_remote_actor(actor_id, no_restart)
+            return
         self.actor_manager.kill(actor_id, no_restart)
         if core is not None and core.info.state == ActorState.DEAD:
             self._release_actor_resources(core.info)
@@ -599,6 +711,12 @@ class Runtime:
         if self.is_shutdown:
             return
         self.is_shutdown = True
+        if self.cluster is not None:
+            try:
+                self.cluster.detach()
+            except Exception:
+                pass
+            self.cluster = None
         self.actor_manager.shutdown()
         self.scheduler.shutdown()
 

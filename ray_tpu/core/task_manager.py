@@ -45,7 +45,7 @@ class TaskManager:
             pass
         elif n == 1:
             store.put(spec.return_ids[0],
-                      RayObject(value=result, size_bytes=_sizeof(result)))
+                      RayObject(value=result))
         elif n == 0:
             pass
         else:
@@ -58,7 +58,7 @@ class TaskManager:
                 self.complete_error(spec, err, allow_retry=False)
                 return
             for oid, v in zip(spec.return_ids, values):
-                store.put(oid, RayObject(value=v, size_bytes=_sizeof(v)))
+                store.put(oid, RayObject(value=v))
         self._finish(spec)
 
     def complete_error(self, spec: TaskSpec, error: BaseException,

@@ -125,6 +125,16 @@ class TaskSpec:
     parent_task_id: Optional[TaskID] = None
     attempt_number: int = 0
     return_ids: Tuple[ObjectID, ...] = ()
+    # Cluster: nodes that already failed this task (spillback exclusion,
+    # reference: normal_task_submitter.cc:455 retry_at_raylet_address).
+    _excluded_nodes: Tuple[str, ...] = ()
+
+    def exclude_node(self, node_id: str):
+        if node_id not in self._excluded_nodes:
+            self._excluded_nodes = self._excluded_nodes + (node_id,)
+
+    def excluded_nodes(self) -> Tuple[str, ...]:
+        return self._excluded_nodes
 
     def repr_name(self) -> str:
         return self.name or self.descriptor.repr_name()

@@ -450,4 +450,31 @@ def _einsum_fallback(q, k, v, causal):
 def flash_attention_causal(q, k, v, positions=None):
     """Drop-in for models.llama.dot_attention (standard causal layout;
     packed/offset positions must use the dot path)."""
+    _check_default_positions(positions, q.shape[1], "flash_attention_causal")
     return flash_attention(q, k, v, causal=True)
+
+
+def _check_default_positions(positions, seq_len, name):
+    """The flash kernels assume the standard causal layout
+    positions == arange(seq).  Packed/offset positions would silently
+    attend wrongly, so reject them instead of ignoring the argument."""
+    if positions is None:
+        return
+    default = jnp.arange(seq_len, dtype=jnp.int32)
+    pos = jnp.asarray(positions)
+    if pos.ndim == 2:
+        pos = pos[0]
+    try:
+        import numpy as np
+
+        if pos.shape == default.shape and bool(np.all(
+                np.asarray(pos) == np.asarray(default))):
+            return
+    except jax.errors.TracerArrayConversionError:
+        # Under tracing we can't inspect values; trust the caller
+        # (llama.forward only routes default layouts here).
+        return
+    raise NotImplementedError(
+        f"{name} only supports the standard causal layout "
+        "(positions == arange(seq_len)); use the dot-attention path "
+        "for packed or offset positions")

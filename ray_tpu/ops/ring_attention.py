@@ -169,4 +169,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def ring_attention_causal(q, k, v, positions=None):
     """Drop-in for models.llama.dot_attention (contiguous positions)."""
+    from ray_tpu.ops.flash_attention import _check_default_positions
+
+    _check_default_positions(positions, q.shape[1], "ring_attention_causal")
     return ring_attention(q, k, v)

@@ -108,8 +108,13 @@ def save_pytree(state: Any, path: str):
 def load_pytree(path: str, template: Optional[Any] = None) -> Any:
     ocp = _try_orbax()
     path = os.path.abspath(path)
-    if ocp is not None and not os.path.exists(
-            os.path.join(path, "treedef.pkl")):
+    numpy_format = os.path.exists(os.path.join(path, "treedef.pkl"))
+    if not numpy_format:
+        if ocp is None:
+            raise RuntimeError(
+                f"checkpoint at {path} was saved with orbax "
+                "(no numpy-format treedef.pkl present); orbax is "
+                "required to restore it but is not importable here")
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(path, item=template)
         return restored
@@ -145,15 +150,22 @@ class CheckpointManager:
         self._checkpoints: List[Tuple[str, Dict[str, Any], int]] = []
         self._index = 0
 
-    def register(self, source_dir: str,
+    def register(self, source_dirs,
                  metrics: Dict[str, Any]) -> Checkpoint:
-        """Copy a worker-produced checkpoint dir into storage."""
+        """Copy worker-produced checkpoint dir(s) into storage.
+
+        ``source_dirs`` may be one path or a rank-ordered list of paths;
+        all merge into a single checkpoint directory (rank-sharded saves
+        write disjoint files; rank 0's common files win, copied last)."""
+        if isinstance(source_dirs, (str, os.PathLike)):
+            source_dirs = [source_dirs]
         with self._lock:
             idx = self._index
             self._index += 1
         dst = os.path.join(self.storage_path, f"checkpoint_{idx:06d}")
-        if os.path.abspath(source_dir) != dst:
-            shutil.copytree(source_dir, dst, dirs_exist_ok=True)
+        for src in reversed(list(source_dirs)):
+            if os.path.abspath(src) != dst:
+                shutil.copytree(src, dst, dirs_exist_ok=True)
         ckpt = Checkpoint(dst)
         ckpt.update_metadata({"metrics": _json_safe(metrics),
                               "index": idx,
@@ -164,11 +176,16 @@ class CheckpointManager:
         return ckpt
 
     def _score(self, entry):
+        """Totally-ordered score: scored entries always beat unscored
+        ones (tuple tag 1 vs 0), so an entry missing the score attribute
+        can never win best_checkpoint over a real score, and eviction
+        removes unscored entries oldest-first among themselves."""
         path, metrics, idx = entry
-        if self.score_attribute and self.score_attribute in metrics:
+        if (self.score_attribute
+                and self.score_attribute in metrics):
             v = metrics[self.score_attribute]
-            return v if self.score_order == "max" else -v
-        return idx  # recency
+            return (1, v if self.score_order == "max" else -v)
+        return (0, idx)  # recency among unscored
 
     def _evict_locked(self):
         if self.num_to_keep is None:

@@ -117,13 +117,23 @@ def get_dataset_shard(dataset_name: str = "train"):
     # ray_tpu.data.Dataset → streaming split; plain iterables → strided.
     if hasattr(ds, "streaming_split"):
         return ds.streaming_split(world)[rank]
-    return _strided_shard(ds, rank, world)
+    return _StridedShard(ds, rank, world)
 
 
-def _strided_shard(iterable, rank: int, world: int):
-    for i, item in enumerate(iterable):
-        if i % world == rank:
-            yield item
+class _StridedShard:
+    """Re-iterable per-rank view of a plain iterable: every ``__iter__``
+    restarts the strided walk, so multi-epoch loops work (reference
+    returns a re-iterable DataIterator, not a one-shot generator)."""
+
+    def __init__(self, iterable, rank: int, world: int):
+        self._iterable = iterable
+        self._rank = rank
+        self._world = world
+
+    def __iter__(self):
+        for i, item in enumerate(self._iterable):
+            if i % self._world == self._rank:
+                yield item
 
 
 def make_temp_checkpoint_dir() -> str:

@@ -82,19 +82,13 @@ class JaxTrainer:
                     collector, name, storage, self.datasets,
                     latest_ckpt.path if latest_ckpt else None)
                 ray_tpu.get(refs)
-                reports, ckpt_dirs = ray_tpu.get(collector.drain.remote())
-                for metrics, cdir in zip(reports, ckpt_dirs):
-                    all_metrics.append(metrics)
-                    if cdir:
-                        latest_ckpt = manager.register(cdir, metrics)
+                latest_ckpt = self._drain(
+                    collector, manager, all_metrics) or latest_ckpt
                 last_error = None
                 break
             except Exception as e:  # worker failure
-                reports, ckpt_dirs = ray_tpu.get(collector.drain.remote())
-                for metrics, cdir in zip(reports, ckpt_dirs):
-                    all_metrics.append(metrics)
-                    if cdir:
-                        latest_ckpt = manager.register(cdir, metrics)
+                latest_ckpt = self._drain(
+                    collector, manager, all_metrics) or latest_ckpt
                 last_error = e
                 attempts += 1
                 if max_failures >= 0 and attempts > max_failures:
@@ -109,6 +103,31 @@ class JaxTrainer:
                     pass
 
         final_ckpt = manager.best_checkpoint() or latest_ckpt
+        return self._finish(all_metrics, final_ckpt, last_error,
+                            max_failures, attempts, storage, manager)
+
+    @staticmethod
+    def _drain(collector, manager: CheckpointManager,
+               all_metrics: list) -> Optional[Checkpoint]:
+        """Pull reports + per-rank checkpoint dirs off the collector.
+        All ranks' dirs for one iteration merge into one checkpoint
+        (rank shards carry distinct files under fsdp-sharded saves)."""
+        import ray_tpu
+
+        reports, ckpt_dirs = ray_tpu.get(collector.drain.remote())
+        all_metrics.extend(reports)
+        report_by_iter = {m.get("iteration"): m for m in reports}
+        latest = None
+        for it in sorted(ckpt_dirs):
+            rank_dirs = ckpt_dirs[it]
+            ordered = [rank_dirs[r] for r in sorted(rank_dirs)]
+            metrics = report_by_iter.get(it, {"iteration": it})
+            latest = manager.register(ordered, metrics)
+        return latest
+
+    @staticmethod
+    def _finish(all_metrics, final_ckpt, last_error, max_failures,
+                attempts, storage, manager) -> Result:
         result = Result(
             metrics=all_metrics[-1] if all_metrics else {},
             checkpoint=final_ckpt,

@@ -23,25 +23,32 @@ from .session import TrainContext, _Session, _set_session
 
 @ray_tpu.remote
 class _ReportCollector:
-    """Aggregates per-rank reports; rank 0's metrics drive checkpoint
-    registration (reference: the trainable's queue consumption)."""
+    """Aggregates per-rank reports.  Rank 0's metrics drive the metric
+    stream, but checkpoint dirs are kept from EVERY rank, keyed by
+    (iteration, rank): with host-sharded (fsdp) state each rank holds a
+    distinct shard, and the trainer merges all ranks' dirs for an
+    iteration into one checkpoint (reference persists checkpoints
+    reported by any worker)."""
 
     def __init__(self):
         self.reports: List[Dict[str, Any]] = []
-        self.checkpoint_dirs: List[Optional[str]] = []
+        # {iteration: {rank: checkpoint_dir}}
+        self.checkpoint_dirs: Dict[int, Dict[int, str]] = {}
 
     def report(self, rank: int, iteration: int, metrics: Dict[str, Any],
                checkpoint_dir: Optional[str]):
         if rank == 0:
             self.reports.append(
                 {"iteration": iteration, **metrics})
-            self.checkpoint_dirs.append(checkpoint_dir)
+        if checkpoint_dir is not None:
+            self.checkpoint_dirs.setdefault(iteration, {})[rank] = (
+                checkpoint_dir)
         return True
 
     def drain(self):
         out = (self.reports, self.checkpoint_dirs)
         self.reports = []
-        self.checkpoint_dirs = []
+        self.checkpoint_dirs = {}
         return out
 
     def latest(self):

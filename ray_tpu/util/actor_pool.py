@@ -1,29 +1,41 @@
-"""Pool of actors for map-style workloads (reference:
-python/ray/util/actor_pool.py)."""
+"""Pool of actors for map-style workloads.
+
+API parity target: ``ray.util.ActorPool`` (submit / map /
+map_unordered / get_next / get_next_unordered / has_next / has_free /
+pop_idle / push).  Implementation is a sequence-numbered in-flight
+table: every submitted call gets a monotonically increasing ticket;
+ordered consumption walks tickets in order, unordered consumption
+takes whatever ``wait`` surfaces first.
+"""
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List, Optional, Tuple
+from collections import deque
+from typing import Any, Callable, Iterable, Optional
 
 
 class ActorPool:
-    def __init__(self, actors: List[Any]):
-        self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[Tuple[Callable, Any]] = []
+    def __init__(self, actors: Iterable[Any]):
+        self._free = deque(actors)
+        # ticket -> (object ref, actor running it)
+        self._inflight: dict = {}
+        self._ref_ticket: dict = {}
+        self._issue = 0    # next ticket to hand out
+        self._serve = 0    # next ticket get_next() returns
+        self._backlog: deque = deque()  # (fn, value) waiting for an actor
 
-    def submit(self, fn: Callable[[Any, Any], Any], value: Any):
-        if self._idle:
-            actor = self._idle.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
+    # ------------------------------------------------------------ submit
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """Run ``fn(actor, value)`` on a free actor, or queue it."""
+        if not self._free:
+            self._backlog.append((fn, value))
+            return
+        actor = self._free.popleft()
+        ref = fn(actor, value)
+        ticket = self._issue
+        self._issue += 1
+        self._inflight[ticket] = (ref, actor)
+        self._ref_ticket[ref] = ticket
 
     def map(self, fn: Callable, values: Iterable[Any]):
         for v in values:
@@ -37,52 +49,54 @@ class ActorPool:
         while self.has_next():
             yield self.get_next_unordered()
 
+    # ----------------------------------------------------------- results
     def has_next(self) -> bool:
-        return bool(self._future_to_actor) or bool(self._pending_submits)
+        return bool(self._inflight) or bool(self._backlog)
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in submission order."""
         from .. import get
 
         if not self.has_next():
             raise StopIteration("no pending results")
-        idx = self._next_return_index
-        self._next_return_index += 1
-        future = self._index_to_future.pop(idx)
-        result = get(future, timeout=timeout)
-        self._return_actor(future)
+        ticket = self._serve
+        self._serve += 1
+        ref, actor = self._inflight.pop(ticket)
+        self._ref_ticket.pop(ref, None)
+        result = get(ref, timeout=timeout)
+        self._recycle(actor)
         return result
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Whichever in-flight result completes first."""
         from .. import get, wait
 
         if not self.has_next():
             raise StopIteration("no pending results")
-        ready, _ = wait(list(self._future_to_actor), num_returns=1,
-                        timeout=timeout)
+        ready, _ = wait([ref for ref, _a in self._inflight.values()],
+                        num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
-        future = ready[0]
-        idx, _actor = self._future_to_actor[future]
-        self._index_to_future.pop(idx, None)
-        result = get(future)
-        self._return_actor(future)
+        ref = ready[0]
+        ticket = self._ref_ticket.pop(ref)
+        _ref, actor = self._inflight.pop(ticket)
+        result = get(ref)
+        self._recycle(actor)
         return result
 
-    def _return_actor(self, future):
-        _idx, actor = self._future_to_actor.pop(future)
-        self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
+    # ------------------------------------------------------------ actors
+    def _recycle(self, actor: Any) -> None:
+        """Actor finished a call: feed it the backlog or park it."""
+        self._free.append(actor)
+        if self._backlog:
+            fn, value = self._backlog.popleft()
             self.submit(fn, value)
 
     def has_free(self) -> bool:
-        return bool(self._idle)
+        return bool(self._free)
 
     def pop_idle(self) -> Optional[Any]:
-        return self._idle.pop() if self._idle else None
+        return self._free.pop() if self._free else None
 
-    def push(self, actor: Any):
-        self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
-            self.submit(fn, value)
+    def push(self, actor: Any) -> None:
+        self._recycle(actor)
