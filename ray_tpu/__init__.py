@@ -66,7 +66,9 @@ def init(address: Optional[str] = None, *,
         from .core.node import connect_to_cluster
 
         return connect_to_cluster(address, namespace=namespace or "",
-                                  runtime_env=runtime_env)
+                                  runtime_env=runtime_env,
+                                  num_cpus=num_cpus, num_tpus=num_tpus,
+                                  resources=resources)
     return _runtime_mod.init_runtime(
         num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
         namespace=namespace or "", runtime_env=runtime_env)

@@ -65,7 +65,7 @@ class ClusterClient:
             try:
                 self.head.call("heartbeat", {
                     "node_id": self.node_id,
-                    "available": dict(self.runtime.node_resources.available),
+                    "available": self.runtime.node_resources.available(),
                 }, timeout=5.0)
             except (ConnectionError, TimeoutError):
                 if self._stopped.is_set():

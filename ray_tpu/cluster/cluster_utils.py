@@ -22,6 +22,7 @@ class Cluster:
 
         self.head_address = start_head() if initialize_head else ""
         self._procs: List[subprocess.Popen] = []
+        self._connected = False
         atexit.register(self.shutdown)
 
     def add_node(self, *, num_cpus: float = 1.0,
@@ -35,8 +36,10 @@ class Cluster:
             node_name=name, env=env)
         self._procs.append(proc)
         if wait:
-            # +1: the driver itself registers as a node on connect.
-            alive_target = len(self._procs)
+            # Target = worker processes still running (killed nodes in
+            # self._procs must not count) + the driver node if connected.
+            live = sum(1 for p in self._procs if p.poll() is None)
+            alive_target = live + (1 if self._connected else 0)
             wait_for_nodes(self.head_address, alive_target, timeout=60.0)
         return proc
 
@@ -44,7 +47,9 @@ class Cluster:
         """Attach the current process as the driver node."""
         import ray_tpu
 
-        return ray_tpu.init(address=self.head_address, **kwargs)
+        rt = ray_tpu.init(address=self.head_address, **kwargs)
+        self._connected = True
+        return rt
 
     def kill_node(self, proc: subprocess.Popen, timeout: float = 5.0):
         """Hard-kill a worker node (chaos: reference RayletKiller,

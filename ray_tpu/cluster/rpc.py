@@ -8,8 +8,13 @@ fault-injection hook driven by ``RAY_TPU_TESTING_RPC_FAILURE`` — over
 plain TCP sockets (no gRPC dependency; the control plane is low-rate,
 the data plane's heavy bytes ride the same framed stream).
 
-Wire format: 8-byte big-endian length + pickled ``(kind, request_id,
-method, payload)`` where kind is "req" / "resp" / "err".
+Wire format: two length-prefixed pickles per message — an envelope
+``(kind, request_id, method)`` of plain strings (always deserializable)
+followed by the payload.  Separating the two means a payload that fails
+``pickle.loads`` (e.g. a user exception with a broken ``__reduce__``)
+can still be correlated to its request id and fail ONLY that call,
+instead of killing the connection's reader thread and hanging every
+pending call.  kind is "req" / "resp" / "err".
 """
 
 from __future__ import annotations
@@ -56,10 +61,23 @@ class _Chaos:
                     f"[chaos] injected rpc failure for {method!r}")
 
 
-def _send_msg(sock: socket.socket, obj: Any, lock: threading.Lock):
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+class DeserializationError(RuntimeError):
+    """A message payload failed ``pickle.loads`` on the receiver.
+
+    Deliberately NOT a ConnectionError: the connection is healthy and
+    the peer is alive — only this one payload is bad.  Subclassing
+    ConnectionError would trip the callers' node-death/retry paths and
+    cascade false node failures."""
+
+
+def _send_msg(sock: socket.socket, kind: str, req_id: str, method: str,
+              payload: Any, lock: threading.Lock):
+    env = pickle.dumps((kind, req_id, method),
+                       protocol=pickle.HIGHEST_PROTOCOL)
+    body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
-        sock.sendall(_LEN.pack(len(data)) + data)
+        sock.sendall(_LEN.pack(len(env)) + env +
+                     _LEN.pack(len(body)) + body)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -73,10 +91,20 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _recv_msg(sock: socket.socket) -> Any:
+def _recv_segment(sock: socket.socket) -> bytes:
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
-    return pickle.loads(_recv_exact(sock, length))
+    return _recv_exact(sock, length)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[str, str, str, bytes]:
+    """Returns (kind, req_id, method, raw_payload_bytes).  The payload
+    is NOT deserialized here: the caller decodes it after correlation so
+    a bad payload fails one call, not the connection."""
+    env = pickle.loads(_recv_segment(sock))
+    body = _recv_segment(sock)
+    kind, req_id, method = env
+    return kind, req_id, method, body
 
 
 class Deferred:
@@ -136,7 +164,16 @@ class RpcServer:
         wlock = threading.Lock()
         try:
             while not self._stopped.is_set():
-                kind, req_id, method, payload = _recv_msg(conn)
+                kind, req_id, method, raw = _recv_msg(conn)
+                try:
+                    payload = pickle.loads(raw)
+                except BaseException as e:  # noqa: BLE001
+                    self._reply_err(conn, wlock, req_id, method,
+                                    DeserializationError(
+                                        f"request payload for {method!r} "
+                                        f"failed to deserialize: "
+                                        f"{type(e).__name__}: {e}"))
+                    continue
                 if method in self.ordered:
                     # Inline submission phase; Deferred completion runs
                     # on its own thread.
@@ -149,11 +186,30 @@ class RpcServer:
                         daemon=True).start()
         except (ConnectionError, EOFError, OSError):
             pass
+        except BaseException:  # noqa: BLE001  malformed envelope: drop conn
+            pass
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
+
+    @staticmethod
+    def _sanitize_err(e: BaseException) -> BaseException:
+        """Only ship exceptions that round-trip; else stringify.  A bare
+        ``pickle.dumps`` probe is not enough — an exception can dump
+        fine and still explode in ``loads`` (default Exception reduce vs
+        a custom __init__ signature)."""
+        from ..exceptions import _picklable_cause
+
+        return _picklable_cause(e)
+
+    def _reply_err(self, conn, wlock, req_id, method, err: BaseException):
+        try:
+            _send_msg(conn, "err", req_id, method,
+                      self._sanitize_err(err), wlock)
+        except (ConnectionError, OSError):
+            pass
 
     def _handle_one(self, conn, wlock, req_id, method, payload,
                     inline: bool = False):
@@ -168,33 +224,28 @@ class RpcServer:
                     args=(conn, wlock, req_id, method, result.fn),
                     daemon=True).start()
                 return
-            msg = ("resp", req_id, method, result)
         except BaseException as e:  # noqa: BLE001
-            try:
-                pickle.dumps(e)
-                err: BaseException = e
-            except Exception:
-                err = RuntimeError(f"{type(e).__name__}: {e}")
-            msg = ("err", req_id, method, err)
+            self._reply_err(conn, wlock, req_id, method, e)
+            return
         try:
-            _send_msg(conn, msg, wlock)
+            _send_msg(conn, "resp", req_id, method, result, wlock)
         except (ConnectionError, OSError):
             pass
+        except BaseException as e:  # result itself unpicklable
+            self._reply_err(conn, wlock, req_id, method, e)
 
     def _finish_deferred(self, conn, wlock, req_id, method, fn):
         try:
-            msg = ("resp", req_id, method, fn())
+            result = fn()
         except BaseException as e:  # noqa: BLE001
-            try:
-                pickle.dumps(e)
-                err: BaseException = e
-            except Exception:
-                err = RuntimeError(f"{type(e).__name__}: {e}")
-            msg = ("err", req_id, method, err)
+            self._reply_err(conn, wlock, req_id, method, e)
+            return
         try:
-            _send_msg(conn, msg, wlock)
+            _send_msg(conn, "resp", req_id, method, result, wlock)
         except (ConnectionError, OSError):
             pass
+        except BaseException as e:  # result itself unpicklable
+            self._reply_err(conn, wlock, req_id, method, e)
 
     def shutdown(self):
         self._stopped.set()
@@ -242,13 +293,31 @@ class RpcClient:
     def _read_loop(self, sock: socket.socket):
         try:
             while True:
-                kind, req_id, _method, payload = _recv_msg(sock)
+                kind, req_id, method, raw = _recv_msg(sock)
                 with self._lock:
                     call = self._pending.pop(req_id, None)
-                if call is not None:
-                    call.finish(payload, is_error=(kind == "err"))
+                if call is None:
+                    continue
+                try:
+                    payload = pickle.loads(raw)
+                except BaseException as e:  # noqa: BLE001
+                    # Fail the one correlated call; the connection and
+                    # every other pending call stay healthy.
+                    call.finish(DeserializationError(
+                        f"response payload for {method!r} failed to "
+                        f"deserialize: {type(e).__name__}: {e}"),
+                        is_error=True)
+                    continue
+                call.finish(payload, is_error=(kind == "err"))
         except (ConnectionError, EOFError, OSError) as e:
             self._fail_all(e)
+        except BaseException as e:  # noqa: BLE001
+            # Envelope decode/unpack failure: the stream is unframed
+            # garbage from here on — connection-fatal, fail everything
+            # rather than leaving pending calls to hang on a dead reader.
+            self._fail_all(ConnectionError(
+                f"protocol error from {self.address}: "
+                f"{type(e).__name__}: {e}"))
 
     def _fail_all(self, exc: Exception):
         with self._lock:
@@ -276,12 +345,16 @@ class RpcClient:
                 raise ConnectionError(f"not connected to {self.address}")
             self._pending[req_id] = call
         try:
-            _send_msg(sock, ("req", req_id, method, payload), self._wlock)
+            _send_msg(sock, "req", req_id, method, payload, self._wlock)
         except (ConnectionError, OSError) as e:
             with self._lock:
                 self._pending.pop(req_id, None)
             raise ConnectionError(
                 f"send to {self.address} failed: {e}") from e
+        except BaseException:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise
         return call
 
     def close(self):

@@ -8,11 +8,24 @@ propagates through dependency chains (TaskError wrapping) and surfaces at
 
 from __future__ import annotations
 
+import pickle
 import traceback
 
 
 class RayTpuError(Exception):
     """Base for all framework errors."""
+
+
+def _picklable_cause(cause: BaseException) -> BaseException:
+    """Return ``cause`` if it survives a pickle round-trip, else a
+    stringified stand-in.  Errors cross the RPC boundary inside task
+    results; an unpicklable user exception must degrade gracefully
+    rather than kill the connection's reader."""
+    try:
+        pickle.loads(pickle.dumps(cause))
+        return cause
+    except Exception:
+        return RayTpuError(f"{type(cause).__name__}: {cause}")
 
 
 class TaskError(RayTpuError):
@@ -21,16 +34,27 @@ class TaskError(RayTpuError):
     Mirrors RayTaskError (python/ray/exceptions.py) including cause
     chaining: if a task fails because an *argument* holds a TaskError,
     the original error is propagated unwrapped.
+
+    Custom ``__init__`` signatures break the default ``Exception``
+    reduce (it replays ``cls(*args)`` with ``args`` = the message), so
+    every exception here with extra fields defines ``__reduce__``.
     """
 
     def __init__(self, function_name: str, cause: BaseException,
                  tb_str: str | None = None):
         self.function_name = function_name
-        self.cause = cause
         self.tb_str = tb_str or "".join(
             traceback.format_exception(type(cause), cause, cause.__traceback__)
         )
+        self.cause = cause
         super().__init__(f"task {function_name} failed: {cause!r}")
+
+    def __reduce__(self):
+        # Sanitize lazily: local (non-cluster) consumers keep the real
+        # cause object; only the wire copy degrades to a stand-in.
+        return (type(self),
+                (self.function_name, _picklable_cause(self.cause),
+                 self.tb_str))
 
     def __str__(self):
         return (f"{type(self.cause).__name__} in task {self.function_name}\n"
@@ -49,6 +73,9 @@ class ActorDiedError(ActorError):
         self.reason = reason
         super().__init__(reason)
 
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.reason))
+
 
 class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (restarting)."""
@@ -59,7 +86,11 @@ class ObjectLostError(RayTpuError):
 
     def __init__(self, object_ref=None, reason: str = "object lost"):
         self.object_ref = object_ref
+        self.reason = reason
         super().__init__(reason)
+
+    def __reduce__(self):
+        return (type(self), (self.object_ref, self.reason))
 
 
 class ObjectFreedError(ObjectLostError):
@@ -74,6 +105,9 @@ class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__("task was cancelled")
+
+    def __reduce__(self):
+        return (type(self), (self.task_id,))
 
 
 class PendingCallsLimitExceededError(RayTpuError):

@@ -293,6 +293,86 @@ class TestClusterFaultTolerance:
         assert out == ["done", "done"]
 
 
+class TestErrorSerialization:
+    def test_all_exception_types_pickle_roundtrip(self):
+        """Every framework exception must survive dumps+loads — a class
+        that dumps fine but explodes in loads kills the RPC reader
+        thread and hangs every pending call on the connection."""
+        import pickle
+
+        from ray_tpu import exceptions as exc
+
+        samples = [
+            exc.RayTpuError("boom"),
+            exc.TaskError("f", ValueError("inner")),
+            exc.TaskError("g", ValueError("x"), tb_str="tb"),
+            exc.ActorError("a"),
+            exc.ActorDiedError("actor-1", "killed"),
+            exc.ActorDiedError(),
+            exc.ActorUnavailableError("restarting"),
+            exc.ObjectLostError("ref-1", "all copies lost"),
+            exc.ObjectLostError(),
+            exc.ObjectFreedError("ref-2", "freed"),
+            exc.OwnerDiedError("ref-3", "owner gone"),
+            exc.TaskCancelledError("task-1"),
+            exc.TaskCancelledError(),
+            exc.PendingCallsLimitExceededError("full"),
+            exc.GetTimeoutError("timeout"),
+            exc.RuntimeEnvSetupError("env"),
+            exc.NodeDiedError("node"),
+            exc.OutOfMemoryError("oom"),
+        ]
+        for e in samples:
+            out = pickle.loads(pickle.dumps(e))
+            assert type(out) is type(e), type(e).__name__
+            assert str(out) == str(e), type(e).__name__
+
+    def test_task_error_unpicklable_cause_degrades(self):
+        import pickle
+        import threading
+
+        from ray_tpu.exceptions import TaskError
+
+        class Evil(Exception):
+            def __init__(self):
+                self.lock = threading.Lock()
+                super().__init__("evil")
+
+        e = TaskError("f", Evil())
+        out = pickle.loads(pickle.dumps(e))
+        assert "Evil" in str(out.cause)
+
+    def test_rpc_bad_payload_fails_only_that_call(self):
+        """A response payload that fails pickle.loads must fail the one
+        correlated call; the connection stays usable."""
+        from ray_tpu.cluster.rpc import (DeserializationError, RpcClient,
+                                         RpcServer)
+
+        class DumpsButNotLoads:
+            """Pickles fine, raises on unpickle."""
+
+            def __reduce__(self):
+                return (_explode, ())
+
+        server = RpcServer({
+            "bad": lambda p: DumpsButNotLoads(),
+            "echo": lambda p: p,
+        })
+        try:
+            client = RpcClient(server.address)
+            with pytest.raises(DeserializationError):
+                client.call("bad", None, timeout=10)
+            # Reader thread survived: a normal call still works.
+            assert client.call("echo", 42, timeout=10) == 42
+            client.close()
+        finally:
+            server.shutdown()
+
+
+def _explode():
+    raise TypeError("cannot reconstruct")
+
+
 class TestRpcChaos:
     def test_chaos_injection_drops_calls(self):
         from ray_tpu.cluster.rpc import RpcClient, RpcServer
