@@ -1,0 +1,63 @@
+"""One-off perf sweep on the real chip (not part of the package)."""
+import itertools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+
+PEAK = 197e12
+
+
+def run(tag, cfg, batch, seq, steps=6, warmup=2):
+    try:
+        state = llama.init_train_state(jax.random.key(0), cfg)
+        step = llama.make_train_step(cfg)
+        tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        b = {"tokens": tokens}
+        for _ in range(warmup):
+            state, m = step(state, b)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, b)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+        tps = batch * (seq - 1) * steps / dt
+        n = llama.param_count(jax.eval_shape(
+            lambda: llama.init_params(jax.random.key(0), cfg)))
+        mfu = tps * 6 * n / PEAK
+        print(f"{tag:55s} tps={tps:9.0f} mfu={mfu*100:5.2f}%", flush=True)
+        del state, step
+        return mfu
+    except Exception as e:
+        print(f"{tag:55s} FAIL {type(e).__name__}: {str(e)[:120]}",
+              flush=True)
+        return 0.0
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    base = dict(batch=16, seq=2048)
+    if which in ("all", "remat"):
+        run("baseline flash remat=full b16",
+            llama.LlamaConfig.llama_440m(), **base)
+        run("flash remat=dots b16",
+            llama.LlamaConfig.llama_440m(remat_policy="dots"), **base)
+        run("flash remat=False b16",
+            llama.LlamaConfig.llama_440m(remat=False), **base)
+    if which in ("all", "batch"):
+        run("flash remat=dots b32",
+            llama.LlamaConfig.llama_440m(remat_policy="dots"),
+            batch=32, seq=2048)
+        run("flash remat=full b32",
+            llama.LlamaConfig.llama_440m(), batch=32, seq=2048)
+    if which in ("all", "attn"):
+        run("dot-attn remat=dots b16",
+            llama.LlamaConfig.llama_440m(attention_impl="dot",
+                                         remat_policy="dots"), **base)
+        run("dot-attn remat=full b16",
+            llama.LlamaConfig.llama_440m(attention_impl="dot"), **base)

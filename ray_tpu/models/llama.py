@@ -54,6 +54,13 @@ class LlamaConfig:
     # ring attention over the "seq" mesh axis).
     attention_impl: str = "dot"
     remat: bool = True
+    # Rematerialization policy for the per-layer checkpoint wrapper:
+    # "full" recomputes everything in backward (min memory, ~2N extra
+    # flops/token); "dots" saves matmul/einsum outputs with no batch
+    # dims (XLA's dots_with_no_batch_dims_saveable — keeps the MXU work
+    # un-recomputed, recomputes only cheap elementwise); ignored when
+    # remat=False.
+    remat_policy: str = "full"
     # Tie input embedding and LM head (small models).
     tie_embeddings: bool = False
 
@@ -188,6 +195,20 @@ def param_count(params: PyTree) -> int:
 # Building blocks
 # ---------------------------------------------------------------------------
 
+def matmul(x: jax.Array, w: jax.Array, out_dtype: Any = None) -> jax.Array:
+    """bf16×bf16 matmul with float32 MXU accumulation.
+
+    Measured on v5e: letting the accumulation type default to the
+    operand dtype (bf16) drops XLA onto a ~4-5x slower path (26-42
+    TF/s vs 139 TF/s with preferred_element_type=f32).  Always
+    accumulate f32 and downcast explicitly.
+    """
+    out = jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out.astype(out_dtype or x.dtype)
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     dtype = x.dtype
     x = x.astype(jnp.float32)
@@ -236,7 +257,8 @@ def dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                                           None, :]
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v,
+                     preferred_element_type=jnp.float32).astype(v.dtype)
     return out.reshape(B, S, Hq, D)
 
 
@@ -265,24 +287,27 @@ def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
     dt = c.dtype
 
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(B, S, c.n_heads, c.head_dim)
-    k = (h @ layer["wk"].astype(dt)).reshape(B, S, c.n_kv_heads, c.head_dim)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, S, c.n_kv_heads, c.head_dim)
+    q = matmul(h, layer["wq"].astype(dt)).reshape(B, S, c.n_heads,
+                                                  c.head_dim)
+    k = matmul(h, layer["wk"].astype(dt)).reshape(B, S, c.n_kv_heads,
+                                                  c.head_dim)
+    v = matmul(h, layer["wv"].astype(dt)).reshape(B, S, c.n_kv_heads,
+                                                  c.head_dim)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
     q = with_logical_constraint(q, "batch", "seq", "heads", "head_dim")
     k = with_logical_constraint(k, "batch", "seq", "kv_heads", "head_dim")
     attn = attention_fn(q, k, v, positions)
     attn = attn.reshape(B, S, c.q_dim)
-    x = x + attn @ layer["wo"].astype(dt)
+    x = x + matmul(attn, layer["wo"].astype(dt))
     x = with_logical_constraint(x, "batch", "seq", None)
 
     h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
-    gate = h @ layer["w_gate"].astype(dt)
-    up = h @ layer["w_up"].astype(dt)
+    gate = matmul(h, layer["w_gate"].astype(dt))
+    up = matmul(h, layer["w_up"].astype(dt))
     ff = jax.nn.silu(gate) * up
     ff = with_logical_constraint(ff, "batch", "seq", "mlp")
-    x = x + ff @ layer["w_down"].astype(dt)
+    x = x + matmul(ff, layer["w_down"].astype(dt))
     return with_logical_constraint(x, "batch", "seq", None)
 
 
@@ -313,8 +338,12 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
                               positions=positions, config=c,
                               attention_fn=attention_fn)
     if c.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable)
+        policies = {
+            "full": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        }
+        block = jax.checkpoint(block, policy=policies[c.remat_policy])
 
     def scan_body(carry, layer_params):
         return block(carry, layer_params), None
@@ -326,7 +355,7 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
         head = params["embed_tokens"].astype(c.dtype).T
     else:
         head = params["lm_head"].astype(c.dtype)
-    logits = x @ head
+    logits = matmul(x, head)
     return with_logical_constraint(logits, "batch", "seq", "vocab")
 
 
