@@ -1,0 +1,164 @@
+"""Columnar block model.
+
+Reference: python/ray/data/block.py:59 defines ``Block = Union[pyarrow.Table,
+pandas.DataFrame]`` with a ``BlockAccessor`` (block.py:232) dispatching on the
+concrete type.  TPU-first redesign: the canonical block here is a plain
+``dict[str, np.ndarray]`` — the exact shape a jax train step consumes, so the
+path block → batch → ``jax.device_put`` is zero-conversion.  Arrow tables and
+pandas frames are converted *at the edge* (read / from_pandas) instead of
+being threaded through the whole engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+# A block is a dict of equal-length numpy arrays (first axis = rows).
+Block = Dict[str, np.ndarray]
+
+
+def _as_array(v: Any) -> np.ndarray:
+    a = np.asarray(v)
+    if a.dtype == object:
+        # Keep object arrays (ragged / str mixes) — numpy-native engine
+        # still supports them, they just can't feed the TPU directly.
+        return a
+    return a
+
+
+class BlockAccessor:
+    """Stateless helpers over the canonical block type.
+
+    Mirrors the role of reference ``BlockAccessor`` (data/block.py:232):
+    every structural operation the engine needs, in one place.
+    """
+
+    @staticmethod
+    def num_rows(block: Block) -> int:
+        if not block:
+            return 0
+        return len(next(iter(block.values())))
+
+    @staticmethod
+    def size_bytes(block: Block) -> int:
+        total = 0
+        for col in block.values():
+            if col.dtype == object:
+                total += sum(len(str(x)) for x in col) + col.nbytes
+            else:
+                total += col.nbytes
+        return total
+
+    @staticmethod
+    def schema(block: Block) -> Dict[str, np.dtype]:
+        return {k: v.dtype for k, v in block.items()}
+
+    @staticmethod
+    def slice(block: Block, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in block.items()}
+
+    @staticmethod
+    def take(block: Block, indices: np.ndarray) -> Block:
+        return {k: v[indices] for k, v in block.items()}
+
+    @staticmethod
+    def concat(blocks: Sequence[Block]) -> Block:
+        blocks = [b for b in blocks if BlockAccessor.num_rows(b)]
+        if not blocks:
+            return {}
+        keys = list(blocks[0].keys())
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+    @staticmethod
+    def from_rows(rows: Sequence[Any]) -> Block:
+        """Build a block from user 'row' objects.
+
+        Scalars / arrays become an ``"item"`` column (reference uses the
+        same convention for simple datasets, data/_internal/numpy ops);
+        dict rows become columns.
+        """
+        if not rows:
+            return {}
+        first = rows[0]
+        if isinstance(first, dict):
+            keys = list(first.keys())
+            out: Block = {}
+            for k in keys:
+                vals = [r[k] for r in rows]
+                out[k] = _stack(vals)
+            return out
+        return {"item": _stack(list(rows))}
+
+    @staticmethod
+    def to_rows(block: Block) -> List[Dict[str, Any]]:
+        n = BlockAccessor.num_rows(block)
+        keys = list(block.keys())
+        return [{k: block[k][i] for k in keys} for i in range(n)]
+
+    @staticmethod
+    def from_pandas(df) -> Block:
+        return {str(c): _as_array(df[c].to_numpy()) for c in df.columns}
+
+    @staticmethod
+    def to_pandas(block: Block):
+        import pandas as pd
+
+        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                             for k, v in block.items()})
+
+    @staticmethod
+    def from_arrow(table) -> Block:
+        out: Block = {}
+        for name in table.column_names:
+            col = table.column(name)
+            try:
+                out[name] = _as_array(col.to_numpy(zero_copy_only=False))
+            except Exception:
+                out[name] = np.array(col.to_pylist(), dtype=object)
+        return out
+
+    @staticmethod
+    def validate(block: Block) -> Block:
+        if not isinstance(block, dict):
+            raise TypeError(
+                f"a block must be a dict of numpy arrays, got {type(block)}"
+                " — map_batches fns must return dict[str, array-like]")
+        out = {k: _as_array(v) for k, v in block.items()}
+        lengths = {k: len(v) for k, v in out.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"ragged block columns: {lengths}")
+        return out
+
+
+def _stack(vals: List[Any]) -> np.ndarray:
+    first = np.asarray(vals[0])
+    if first.dtype != object and first.ndim > 0:
+        try:
+            return np.stack([np.asarray(v) for v in vals])
+        except ValueError:
+            pass  # ragged → object column
+    arr = np.empty(len(vals), dtype=object) if (
+        first.dtype == object or first.ndim > 0) else None
+    if arr is not None:
+        for i, v in enumerate(vals):
+            arr[i] = v
+        return arr
+    return np.asarray(vals)
+
+
+class BlockMetadata:
+    """Per-block bookkeeping carried alongside the ObjectRef
+    (reference: data/block.py BlockMetadata)."""
+
+    __slots__ = ("num_rows", "size_bytes")
+
+    def __init__(self, num_rows: int, size_bytes: int):
+        self.num_rows = num_rows
+        self.size_bytes = size_bytes
+
+    @staticmethod
+    def of(block: Block) -> "BlockMetadata":
+        return BlockMetadata(BlockAccessor.num_rows(block),
+                             BlockAccessor.size_bytes(block))

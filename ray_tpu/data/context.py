@@ -1,0 +1,42 @@
+"""Global data-engine tunables.
+
+Reference: python/ray/data/context.py:180 (``DataContext`` — target block
+size, concurrency caps, eager-free flags).  Kept deliberately small: the
+knobs the TPU input pipeline actually needs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DataContext:
+    # Rows per block the engine aims for when it has a choice (reads /
+    # repartition defaults).  Reference targets bytes; rows are the more
+    # natural unit when blocks feed fixed-shape jax batches.
+    target_block_rows: int = 4096
+    # Max concurrently running block tasks per map phase (backpressure
+    # cap; reference: execution/backpressure_policy/
+    # concurrency_cap_backpressure_policy.py).
+    max_concurrency: int = field(
+        default_factory=lambda: min(8, os.cpu_count() or 8))
+    # Completed-but-not-yet-consumed blocks the executor will hold while
+    # preserving order before it stops dispatching (reference:
+    # streaming_executor_state.py:533 backpressure-aware op choice).
+    output_buffer_blocks: int = 16
+    # Batches the iterator prefetches ahead of the consumer
+    # (reference: _internal/batcher.py + iter_batches prefetch_batches).
+    prefetch_batches: int = 2
+    # Seconds between executor wait() polls (also the cadence at which
+    # new work is dispatched when nothing completes).
+    wait_timeout_s: float = 0.05
+
+    _global: "DataContext" = None  # type: ignore[assignment]
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        if DataContext._global is None:
+            DataContext._global = DataContext()
+        return DataContext._global

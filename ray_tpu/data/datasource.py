@@ -1,0 +1,169 @@
+"""Read tasks and datasources.
+
+Reference: python/ray/data/read_api.py:335 (``read_datasource``) plans a
+``Read`` logical op whose physical form is a set of ``ReadTask`` closures,
+each producing one or more blocks when executed remotely
+(data/datasource/datasource.py).  Same shape here: a ``Datasource`` yields
+picklable zero-arg ``ReadTask``s; the streaming executor runs them as
+``ray_tpu`` tasks exactly like any other map stage.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .block import Block, BlockAccessor
+
+# A ReadTask is a zero-arg callable returning a list of blocks.
+ReadTask = Callable[[], List[Block]]
+
+
+class Datasource:
+    def read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimated_num_rows(self) -> Optional[int]:
+        return None
+
+
+class RangeDatasource(Datasource):
+    """``ray_tpu.data.range`` (reference: read_api.py range/range_tensor)."""
+
+    def __init__(self, n: int, column: str = "id"):
+        self.n = n
+        self.column = column
+
+    def estimated_num_rows(self):
+        return self.n
+
+    def read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        bounds = np.linspace(0, self.n, parallelism + 1).astype(np.int64)
+        col = self.column
+
+        def make(lo: int, hi: int) -> ReadTask:
+            return lambda: [{col: np.arange(lo, hi, dtype=np.int64)}]
+
+        return [make(int(lo), int(hi))
+                for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
+
+
+class ItemsDatasource(Datasource):
+    """``from_items`` — rows already in driver memory."""
+
+    def __init__(self, items: Sequence[Any]):
+        self.items = list(items)
+
+    def estimated_num_rows(self):
+        return len(self.items)
+
+    def read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self.items)
+        parallelism = max(1, min(parallelism, n or 1))
+        bounds = np.linspace(0, n, parallelism + 1).astype(np.int64)
+        tasks: List[ReadTask] = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            if hi <= lo:
+                continue
+            chunk = self.items[int(lo):int(hi)]
+            tasks.append(
+                lambda chunk=chunk: [BlockAccessor.from_rows(chunk)])
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """Wrap pre-built blocks (from_numpy / from_pandas / from_arrow)."""
+
+    def __init__(self, blocks: List[Block]):
+        self.blocks = [BlockAccessor.validate(b) for b in blocks]
+
+    def estimated_num_rows(self):
+        return sum(BlockAccessor.num_rows(b) for b in self.blocks)
+
+    def read_tasks(self, parallelism: int) -> List[ReadTask]:
+        return [lambda b=b: [b] for b in self.blocks]
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+class FileDatasource(Datasource):
+    """One read task per file (reference: file_based_datasource.py)."""
+
+    def __init__(self, paths, reader: Callable[[str], List[Block]]):
+        self.paths = _expand_paths(paths)
+        self.reader = reader
+
+    def read_tasks(self, parallelism: int) -> List[ReadTask]:
+        reader = self.reader
+        return [lambda p=p: reader(p) for p in self.paths]
+
+
+def _read_parquet_file(path: str, columns=None) -> List[Block]:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns)
+    return [BlockAccessor.from_arrow(table)]
+
+
+def _read_csv_file(path: str, **kw) -> List[Block]:
+    import pandas as pd
+
+    return [BlockAccessor.from_pandas(pd.read_csv(path, **kw))]
+
+
+def _read_json_file(path: str) -> List[Block]:
+    import json
+
+    rows = []
+    with open(path) as f:
+        text = f.read().strip()
+    if text.startswith("["):
+        rows = json.loads(text)
+    else:  # jsonl
+        rows = [json.loads(line) for line in text.splitlines() if line]
+    return [BlockAccessor.from_rows(rows)]
+
+
+def _read_numpy_file(path: str) -> List[Block]:
+    arr = np.load(path, allow_pickle=False)
+    if isinstance(arr, np.lib.npyio.NpzFile):
+        return [{k: arr[k] for k in arr.files}]
+    return [{"data": arr}]
+
+
+def parquet_datasource(paths, columns=None) -> FileDatasource:
+    return FileDatasource(
+        paths, lambda p: _read_parquet_file(p, columns=columns))
+
+
+def csv_datasource(paths, **kw) -> FileDatasource:
+    return FileDatasource(paths, lambda p: _read_csv_file(p, **kw))
+
+
+def json_datasource(paths) -> FileDatasource:
+    return FileDatasource(paths, _read_json_file)
+
+
+def numpy_datasource(paths) -> FileDatasource:
+    return FileDatasource(paths, _read_numpy_file)

@@ -1,0 +1,329 @@
+"""Logical plan, operator fusion, and the streaming executor.
+
+Reference shape (SURVEY.md §2.4): Dataset facade holds a lazy logical plan
+(data/_internal/logical/interfaces/logical_plan.py:10), an optimizer fuses
+adjacent map stages (logical/rules/operator_fusion.py), the planner lowers
+to physical operators, and a ``StreamingExecutor`` scheduling loop
+(execution/streaming_executor.py:47,219,269 +
+streaming_executor_state.py:395,533) dispatches block tasks with
+backpressure.
+
+TPU-first redesign: the executor is a *pull-based generator* rather than a
+push-loop thread — the consumer (batcher / device-prefetch iterator) pulls,
+and dispatch happens exactly as fast as consumption allows, which is the
+backpressure policy (bounded in-flight tasks + bounded ordered-output
+buffer).  Map chains are fused into a single ``ray_tpu`` task per input
+block, so a read→map_batches→filter pipeline costs one task per block.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .block import Block, BlockAccessor, BlockMetadata
+from .context import DataContext
+from .datasource import Datasource, ReadTask
+
+# A transform maps one block to zero-or-more blocks.
+Transform = Callable[[Block], List[Block]]
+
+
+# --------------------------------------------------------------------------
+# Logical ops
+# --------------------------------------------------------------------------
+class LogicalOp:
+    name = "op"
+
+    def fused_transform(self) -> Optional[Transform]:
+        """Return a per-block transform if this op is fusible into a map
+        chain, else None (barrier op)."""
+        return None
+
+
+class Read(LogicalOp):
+    name = "Read"
+
+    def __init__(self, source: Datasource, parallelism: int = -1):
+        self.source = source
+        self.parallelism = parallelism
+
+
+class MapBlocks(LogicalOp):
+    """Fusible per-block transform: Map / MapBatches / Filter / FlatMap
+    all normalize to this (reference: zero-copy map fusion rule)."""
+
+    def __init__(self, name: str, transform: Transform):
+        self.name = name
+        self.transform = transform
+
+    def fused_transform(self) -> Transform:
+        return self.transform
+
+
+class AllToAll(LogicalOp):
+    """Barrier op: needs every upstream block at once
+    (reference: _internal/planner/exchange/ — repartition, shuffle, sort)."""
+
+    def __init__(self, name: str,
+                 fn: Callable[[List[Block], DataContext], List[Block]]):
+        self.name = name
+        self.fn = fn
+
+
+class Limit(LogicalOp):
+    name = "Limit"
+
+    def __init__(self, n: int):
+        self.n = n
+
+
+# --------------------------------------------------------------------------
+# Per-op runtime stats (reference: _internal/stats.py → ds.stats())
+# --------------------------------------------------------------------------
+class OpStats:
+    def __init__(self, name: str):
+        self.name = name
+        self.num_tasks = 0
+        self.num_blocks = 0
+        self.num_rows = 0
+        self.wall_s = 0.0
+
+    def line(self) -> str:
+        return (f"{self.name}: {self.num_tasks} tasks, "
+                f"{self.num_blocks} blocks, {self.num_rows} rows, "
+                f"{self.wall_s:.3f}s wall")
+
+
+class PlanStats:
+    def __init__(self):
+        self.ops: List[OpStats] = []
+        self.start = time.perf_counter()
+        self.total_s = 0.0
+
+    def summary(self) -> str:
+        lines = [s.line() for s in self.ops]
+        lines.append(f"total: {self.total_s:.3f}s")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# Remote task bodies
+# --------------------------------------------------------------------------
+def _run_read(read_task: ReadTask, transforms: Sequence[Transform]
+              ) -> List[Block]:
+    blocks = read_task()
+    return _apply(blocks, transforms)
+
+
+def _run_map(block: Block, transforms: Sequence[Transform]) -> List[Block]:
+    return _apply([block], transforms)
+
+
+def _apply(blocks: List[Block], transforms: Sequence[Transform]
+           ) -> List[Block]:
+    for t in transforms:
+        nxt: List[Block] = []
+        for b in blocks:
+            nxt.extend(t(b))
+        blocks = nxt
+    return [b for b in blocks if BlockAccessor.num_rows(b) > 0]
+
+
+# --------------------------------------------------------------------------
+# Physical plan: alternating [inputs] -> map chain -> barrier -> map chain...
+# --------------------------------------------------------------------------
+class _MapPhase:
+    def __init__(self, names: List[str], transforms: List[Transform]):
+        self.names = names
+        self.transforms = transforms
+
+
+def compile_plan(ops: Sequence[LogicalOp]
+                 ) -> Tuple[Read, List[Any], Optional[int]]:
+    """Fuse the op chain into phases.  Returns (read, phases, limit) where
+    phases alternate _MapPhase / AllToAll; a trailing Limit is lifted into
+    a streaming row cap (reference: limit pushdown rule)."""
+    if not ops or not isinstance(ops[0], Read):
+        raise ValueError("plan must start with a Read op")
+    read = ops[0]
+    phases: List[Any] = []
+    cur_names: List[str] = []
+    cur_tfs: List[Transform] = []
+    limit: Optional[int] = None
+    for op in ops[1:]:
+        tf = op.fused_transform()
+        if tf is not None:
+            cur_names.append(op.name)
+            cur_tfs.append(tf)
+        elif isinstance(op, Limit):
+            # Only a limit with nothing after it can stream; a limit
+            # mid-plan becomes a truncating barrier.
+            if op is ops[-1]:
+                limit = op.n
+            else:
+                n = op.n
+                phases.append(_MapPhase(cur_names, cur_tfs))
+                cur_names, cur_tfs = [], []
+                phases.append(AllToAll(
+                    "Limit", lambda blocks, ctx, n=n: _truncate(blocks, n)))
+        elif isinstance(op, AllToAll):
+            phases.append(_MapPhase(cur_names, cur_tfs))
+            cur_names, cur_tfs = [], []
+            phases.append(op)
+        else:
+            raise TypeError(f"unknown logical op {op!r}")
+    phases.append(_MapPhase(cur_names, cur_tfs))
+    return read, phases, limit
+
+
+def _truncate(blocks: List[Block], n: int) -> List[Block]:
+    out: List[Block] = []
+    remaining = n
+    for b in blocks:
+        rows = BlockAccessor.num_rows(b)
+        if rows <= remaining:
+            out.append(b)
+            remaining -= rows
+        else:
+            out.append(BlockAccessor.slice(b, 0, remaining))
+            remaining = 0
+        if remaining == 0:
+            break
+    return out
+
+
+# --------------------------------------------------------------------------
+# Streaming executor
+# --------------------------------------------------------------------------
+def execute_streaming(ops: Sequence[LogicalOp],
+                      ctx: Optional[DataContext] = None,
+                      stats: Optional[PlanStats] = None
+                      ) -> Iterator[Block]:
+    """Run the plan, yielding output blocks in order as they are produced.
+
+    Backpressure: at most ``ctx.max_concurrency`` tasks in flight and at
+    most ``ctx.output_buffer_blocks`` completed blocks buffered; when the
+    consumer stops pulling, dispatch stops (reference:
+    streaming_executor_state.py:533 select_operator_to_run).
+    """
+    import ray_tpu
+
+    ctx = ctx or DataContext.get_current()
+    read, phases, limit = compile_plan(ops)
+    read_tasks = read.source.read_tasks(
+        read.parallelism if read.parallelism > 0 else
+        _default_parallelism(read, ctx))
+
+    # First map phase fuses with the read (reference fuses Read+Map).
+    first = phases[0]
+    source: Iterator[Block] = _stream_phase(
+        [("read", rt) for rt in read_tasks], first, ctx, stats,
+        name="Read+" + "+".join(first.names) if first.names else "Read")
+    i = 1
+    while i < len(phases):
+        barrier: AllToAll = phases[i]
+        map_phase: _MapPhase = phases[i + 1]
+        blocks = list(source)  # materialize at the barrier
+        t0 = time.perf_counter()
+        shuffled = barrier.fn(blocks, ctx)
+        if stats is not None:
+            s = OpStats(barrier.name)
+            s.num_tasks = 1
+            s.num_blocks = len(shuffled)
+            s.num_rows = sum(BlockAccessor.num_rows(b) for b in shuffled)
+            s.wall_s = time.perf_counter() - t0
+            stats.ops.append(s)
+        source = _stream_phase(
+            [("block", b) for b in shuffled], map_phase, ctx, stats,
+            name="+".join(map_phase.names) or "identity")
+        i += 2
+
+    rows_out = 0
+    for block in source:
+        if limit is not None:
+            rows = BlockAccessor.num_rows(block)
+            if rows_out + rows >= limit:
+                yield BlockAccessor.slice(block, 0, limit - rows_out)
+                source.close()
+                break
+            rows_out += rows
+        yield block
+    if stats is not None:
+        stats.total_s = time.perf_counter() - stats.start
+
+
+def _default_parallelism(read: Read, ctx: DataContext) -> int:
+    n = read.source.estimated_num_rows()
+    if n is None:
+        return ctx.max_concurrency
+    return max(1, min(ctx.max_concurrency * 2,
+                      -(-n // ctx.target_block_rows)))
+
+
+def _stream_phase(items: List[Tuple[str, Any]], phase: _MapPhase,
+                  ctx: DataContext, stats: Optional[PlanStats],
+                  name: str) -> Iterator[Block]:
+    """Stream one fused map phase over its inputs as ray_tpu tasks."""
+    import ray_tpu
+
+    op_stats = OpStats(name)
+    if stats is not None:
+        stats.ops.append(op_stats)
+
+    transforms = phase.transforms
+    if not transforms and all(kind == "block" for kind, _ in items):
+        # Identity phase over in-memory blocks: no tasks needed.
+        def passthrough():
+            for _, b in items:
+                op_stats.num_blocks += 1
+                op_stats.num_rows += BlockAccessor.num_rows(b)
+                yield b
+        return passthrough()
+
+    remote_read = ray_tpu.remote(_run_read)
+    remote_map = ray_tpu.remote(_run_map)
+
+    def gen() -> Iterator[Block]:
+        t_start = time.perf_counter()
+        in_flight: Dict[Any, int] = {}   # ref -> seq
+        done: Dict[int, List[Block]] = {}  # seq -> blocks awaiting yield
+        next_dispatch = 0
+        next_yield = 0
+        try:
+            while next_yield < len(items):
+                while (next_dispatch < len(items)
+                       and len(in_flight) < ctx.max_concurrency
+                       and len(done) < ctx.output_buffer_blocks):
+                    kind, payload = items[next_dispatch]
+                    if kind == "read":
+                        ref = remote_read.remote(payload, transforms)
+                    else:
+                        ref = remote_map.remote(payload, transforms)
+                    in_flight[ref] = next_dispatch
+                    next_dispatch += 1
+                    op_stats.num_tasks += 1
+                if in_flight:
+                    ready, _ = ray_tpu.wait(
+                        list(in_flight), num_returns=1,
+                        timeout=ctx.wait_timeout_s)
+                    for ref in ready:
+                        done[in_flight.pop(ref)] = ray_tpu.get(ref)
+                while next_yield in done:
+                    for block in done.pop(next_yield):
+                        op_stats.num_blocks += 1
+                        op_stats.num_rows += BlockAccessor.num_rows(block)
+                        yield block
+                    next_yield += 1
+        finally:
+            op_stats.wall_s = time.perf_counter() - t_start
+            for ref in in_flight:
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:
+                    pass
+
+    return gen()
