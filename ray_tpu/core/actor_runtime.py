@@ -51,6 +51,10 @@ class _ActorCore:
         self.instance: Any = None
         self._creation_done = threading.Event()
         self._creation_error: Optional[BaseException] = None
+        # Method calls queued but not yet started (decremented at dequeue);
+        # the creation spec rides the same queue but must not count against
+        # max_pending_calls.
+        self._pending_calls = 0
         # Set by Runtime.create_actor; lets kill paths resolve a
         # still-pending creation ref.
         self.creation_spec = None
@@ -104,12 +108,19 @@ class _ActorCore:
             if self._stopped.is_set():
                 raise self._dead_error()
             if not bypass_limit and self.info.max_pending_calls > 0 and (
-                    self._queue.qsize() >= self.info.max_pending_calls):
+                    self._pending_calls >= self.info.max_pending_calls):
                 raise PendingCallsLimitExceededError(
                     f"actor {self.info.display_name()} has "
-                    f"{self._queue.qsize()} pending calls "
+                    f"{self._pending_calls} pending calls "
                     f"(max_pending_calls={self.info.max_pending_calls})")
+            if not spec.is_actor_creation:
+                self._pending_calls += 1
             self._queue.put(spec)
+
+    def _call_started(self, spec: TaskSpec):
+        if not spec.is_actor_creation:
+            with self._submit_lock:
+                self._pending_calls -= 1
 
     # -- execution loops -----------------------------------------------------
     def _sync_main(self):
@@ -146,6 +157,7 @@ class _ActorCore:
             self.create_instance()
             self._runtime.finish_actor_creation(self, spec)
             return
+        self._call_started(spec)
         if self.info.state == ActorState.DEAD:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)
@@ -158,6 +170,7 @@ class _ActorCore:
             self.create_instance()
             self._runtime.finish_actor_creation(self, spec)
             return
+        self._call_started(spec)
         if self.info.state == ActorState.DEAD:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)

@@ -32,10 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
-# 1024x1024 measured fastest on v5e at seq 2048 (23.3 TF/s vs 15.9 at
-# 512x512 — bigger blocks amortize grid-step overhead and DMA setup;
-# 2048-wide blocks exceed VMEM and fail to compile).
-DEFAULT_BLOCK = 1024
+DEFAULT_BLOCK = 512
 NEG_INF = -1e30
 
 
@@ -77,71 +74,55 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     if causal:
-        # Run blocks on or below the diagonal only; the iota/where mask
-        # is generated only for blocks the diagonal actually crosses —
-        # fully-below-diagonal blocks skip all that VPU work.
+        # Run blocks on or below the diagonal only.
         should_run = ki * block_k <= qi * block_q + block_q - 1
-        on_diag = ki * block_k + block_k - 1 > qi * block_q
         last_k = jnp.minimum(nk - 1,
                              (qi * block_q + block_q - 1) // block_k)
     else:
         should_run = True
-        on_diag = False
         last_k = nk - 1
 
-    def _step(masked: bool):
+    @pl.when(should_run)
+    def _compute():
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if masked:
+        if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        m_prev = m_scr[:, :]
+        m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = alpha * l_scr[:, :] + jnp.sum(p, axis=1, keepdims=True)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
-        l_scr[:] = l_new
-
-    if causal:
-        @pl.when(should_run & jnp.logical_not(on_diag))
-        def _below():
-            _step(masked=False)
-
-        @pl.when(on_diag)
-        def _diag():
-            _step(masked=True)
-    else:
-        _step(masked=False)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     @pl.when(ki == last_k)
     def _finalize():
-        l = l_scr[:, :]
+        l = l_scr[:, :1]
         # Fully-masked rows (possible in the non-causal ring steps)
         # produce l == 0; emit zeros and lse == NEG_INF so downstream
         # merging ignores them.
         l_safe = jnp.where(l > 0.0, l, 1.0)
         o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse = jnp.where(l > 0.0,
-                        m_scr[:, :] + jnp.log(jnp.maximum(l, 1e-37)),
+        lse = jnp.where(l_scr[:] > 0.0,
+                        m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-37)),
                         NEG_INF)
         lse_ref[0, 0, :, :] = lse
 
 
 def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
     """q: (B, Hq, Sq, D) pre-scaled; k/v: (B, Hkv, Sk, D).
-    Returns o (B, Hq, Sq, D), lse (B, Hq, Sq, 1) f32 (trailing-1 layout
-    — the lane-replicated (…, 128) layout used previously cost ~256 MB
-    of HBM write+read per 440M layer stack for pure bookkeeping)."""
+    Returns o (B, Hq, Sq, D), lse (B, Hq, Sq, LANES) f32."""
     B, Hq, Sq, D = q.shape
     _, Hkv, Sk, _ = k.shape
     group = Hq // Hkv
@@ -174,15 +155,15 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), o_map),
-            pl.BlockSpec((1, 1, bq, 1), o_map),
+            pl.BlockSpec((1, 1, bq, LANES), o_map),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, Hq, Sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, Sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -198,7 +179,7 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, block_q, block_k, nk, causal, scale):
+               dq_scr, *, block_q, block_k, nk, causal):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -208,24 +189,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     if causal:
         should_run = ki * block_k <= qi * block_q + block_q - 1
-        on_diag = ki * block_k + block_k - 1 > qi * block_q
         last_k = jnp.minimum(nk - 1,
                              (qi * block_q + block_q - 1) // block_k)
     else:
         should_run = True
-        on_diag = False
         last_k = nk - 1
 
-    def _step(masked: bool):
+    @pl.when(should_run)
+    def _compute():
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        lse = lse_ref[0, 0, :, :]
-        delta = delta_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if masked:
+        if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -239,20 +219,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(should_run & jnp.logical_not(on_diag))
-        def _below():
-            _step(masked=False)
-
-        @pl.when(on_diag)
-        def _diag():
-            _step(masked=True)
-    else:
-        _step(masked=False)
-
     @pl.when(ki == last_k)
     def _finalize():
-        dq_ref[0, 0, :, :] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -269,21 +238,20 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # Need q rows at or below this kv block's diagonal.
         should_run = qi * block_q + block_q - 1 >= ki * block_k
-        on_diag = ki * block_k + block_k - 1 > qi * block_q
     else:
         should_run = True
-        on_diag = False
 
-    def _step(masked: bool):
+    @pl.when(should_run)
+    def _compute():
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        lse = lse_ref[0, 0, :, :]
-        delta = delta_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if masked:
+        if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -301,17 +269,6 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(should_run & jnp.logical_not(on_diag))
-        def _below():
-            _step(masked=False)
-
-        @pl.when(on_diag)
-        def _diag():
-            _step(masked=True)
-    else:
-        _step(masked=False)
-
     @pl.when(qi == nq - 1)
     def _finalize():
         dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
@@ -319,19 +276,17 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
-              interpret, scale=1.0, out_dtype=None):
-    """All inputs (B, Hq, S, D) (k/v pre-expanded to q heads); lse is
-    (B, Hq, S, 1) f32.  Returns (dq, dk, dv) at q-head granularity in
-    ``out_dtype`` (default: input dtype); ``scale`` is folded into dq
-    inside the kernel."""
+              interpret):
+    """All inputs (B, Hq, S, D) (k/v pre-expanded to q heads); returns
+    (dq, dk, dv) at q-head granularity, un-scaled."""
     B, Hq, Sq, D = q.shape
     Sk = k.shape[2]
-    out_dtype = out_dtype or q.dtype
     bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
     nq, nk = Sq // bq, Sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (B, Hq, Sq, 1)
+                    axis=-1)  # (B, Hq, Sq)
+    delta = jnp.broadcast_to(delta[..., None], (B, Hq, Sq, LANES))
 
     def q_map(b, h, qi, ki):
         return (b, h, qi, 0)
@@ -343,18 +298,18 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_q=bq, block_k=bk, nk=nk,
-                          causal=causal, scale=scale),
+                          causal=causal),
         grid=(B, Hq, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), q_map),
             pl.BlockSpec((1, 1, bk, D), k_map_q),
             pl.BlockSpec((1, 1, bk, D), k_map_q),
             pl.BlockSpec((1, 1, bq, D), q_map),
-            pl.BlockSpec((1, 1, bq, 1), q_map),
-            pl.BlockSpec((1, 1, bq, 1), q_map),
+            pl.BlockSpec((1, 1, bq, LANES), q_map),
+            pl.BlockSpec((1, 1, bq, LANES), q_map),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), q_map),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
@@ -381,16 +336,16 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bk, D), kv_map),
             pl.BlockSpec((1, 1, bk, D), kv_map),
             pl.BlockSpec((1, 1, bq, D), q_map_kv),
-            pl.BlockSpec((1, 1, bq, 1), q_map_kv),
-            pl.BlockSpec((1, 1, bq, 1), q_map_kv),
+            pl.BlockSpec((1, 1, bq, LANES), q_map_kv),
+            pl.BlockSpec((1, 1, bq, LANES), q_map_kv),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), kv_map),
             pl.BlockSpec((1, 1, bk, D), kv_map),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Hq, Sk, D), out_dtype),
-            jax.ShapeDtypeStruct((B, Hq, Sk, D), out_dtype),
+            jax.ShapeDtypeStruct((B, Hq, Sk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, Sk, D), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
@@ -440,17 +395,12 @@ def _flash_bwd(causal, block_q, block_k, res, g):
     do = jnp.transpose(g, (0, 2, 1, 3))
     k_full = jnp.repeat(kt, group, axis=1)
     v_full = jnp.repeat(vt, group, axis=1)
-    # qt was pre-scaled; the kernel folds ``scale`` into dq so the
-    # gradient matches the original (unscaled) q.
     dq, dk, dv = _bwd_impl(qt, k_full, v_full, o, lse, do,
                            causal=causal, block_q=block_q,
-                           block_k=block_k, interpret=_use_interpret(),
-                           scale=scale)
-    if group > 1:
-        dk = dk.reshape(B, Hkv, group, -1, D).sum(
-            axis=2, dtype=jnp.float32).astype(kt.dtype)
-        dv = dv.reshape(B, Hkv, group, -1, D).sum(
-            axis=2, dtype=jnp.float32).astype(vt.dtype)
+                           block_k=block_k, interpret=_use_interpret())
+    dq = dq * scale  # qt was pre-scaled; undo for d(original q)
+    dk = dk.reshape(B, Hkv, group, -1, D).sum(axis=2)
+    dv = dv.reshape(B, Hkv, group, -1, D).sum(axis=2)
     dq = jnp.transpose(dq, (0, 2, 1, 3)).astype(qt.dtype)
     dk = jnp.transpose(dk, (0, 2, 1, 3)).astype(kt.dtype)
     dv = jnp.transpose(dv, (0, 2, 1, 3)).astype(vt.dtype)

@@ -29,7 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .flash_attention import (NEG_INF, _bwd_impl, _fwd,
+from .flash_attention import (LANES, NEG_INF, _bwd_impl, _fwd,
                               _use_interpret, flash_attention)
 
 PPERM_AXIS_DOC = "seq"
@@ -37,12 +37,12 @@ PPERM_AXIS_DOC = "seq"
 
 def _merge(o_acc, lse_acc, o_c, lse_c):
     """Merge two normalized partial attention results.
-    o: (B,H,S,D) f32; lse: (B,H,S,1) f32 (trailing-1 layout)."""
+    o: (B,H,S,D) f32; lse: (B,H,S,LANES) f32 (lane-replicated)."""
     m = jnp.maximum(lse_acc, lse_c)
     a = jnp.exp(lse_acc - m)
     b = jnp.exp(lse_c - m)
     denom = a + b
-    o = (o_acc * a + o_c * b) / denom
+    o = (o_acc * a[..., :1] + o_c * b[..., :1]) / denom[..., :1]
     return o, m + jnp.log(denom)
 
 
@@ -112,7 +112,7 @@ def _ring_bwd(axis_name, axis_size, res, g):
         dq_c, dk_c, dv_c = _bwd_impl(
             qt, k_full, v_full, o.astype(qt.dtype), lse, do,
             causal=(step == 0), block_q=None, block_k=None,
-            interpret=interp, out_dtype=jnp.float32)
+            interpret=interp)
         dk_c = dk_c.reshape(B, Hkv, group, S, D).sum(axis=2)
         dv_c = dv_c.reshape(B, Hkv, group, S, D).sum(axis=2)
         if step == 0:
