@@ -1,63 +1,87 @@
-"""One-off perf sweep on the real chip (not part of the package)."""
-import itertools
+"""One-off perf sweep on the real chip (not part of the package).
+
+Each config runs in its own subprocess: HBM buffers and jit caches from
+one run otherwise leak into the next (a 440M state + adam moments is
+~7 GB, so run N+1 compiles against a half-full chip and dies), and one
+compile failure must not poison the rest of the sweep.
+"""
+import json
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-from ray_tpu.models import llama
-
 PEAK = 197e12
 
+CASES = {
+    "flash-full-b16": dict(kw={}, batch=16),
+    "flash-dots-b16": dict(kw={"remat_policy": "dots"},
+                           batch=16),
+    "flash-dotssave-b16": dict(kw={"remat_policy": "dots_saveable"},
+                               batch=16),
+    "flash-noremat-b8": dict(kw={"remat": False}, batch=8),
+    "flash-noremat-b16": dict(kw={"remat": False}, batch=16),
+    "flash-full-b32": dict(kw={}, batch=32),
+    "flash-full-b8": dict(kw={}, batch=8),
+    "flash-full-b24": dict(kw={}, batch=24),
+    "dot-full-b16": dict(kw={"attention_impl": "dot"},
+                         batch=16),
+}
 
-def run(tag, cfg, batch, seq, steps=6, warmup=2):
-    try:
-        state = llama.init_train_state(jax.random.key(0), cfg)
-        step = llama.make_train_step(cfg)
-        tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
-                                    cfg.vocab_size, dtype=jnp.int32)
-        b = {"tokens": tokens}
-        for _ in range(warmup):
-            state, m = step(state, b)
-        float(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = step(state, b)
-        float(m["loss"])
-        dt = time.perf_counter() - t0
-        tps = batch * (seq - 1) * steps / dt
-        n = llama.param_count(jax.eval_shape(
-            lambda: llama.init_params(jax.random.key(0), cfg)))
-        mfu = tps * 6 * n / PEAK
-        print(f"{tag:55s} tps={tps:9.0f} mfu={mfu*100:5.2f}%", flush=True)
-        del state, step
-        return mfu
-    except Exception as e:
-        print(f"{tag:55s} FAIL {type(e).__name__}: {str(e)[:120]}",
-              flush=True)
-        return 0.0
+
+def run_one(tag: str) -> float:
+    """Child-process entry: run one config, print one JSON line."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    case = CASES[tag]
+    cfg = llama.LlamaConfig.llama_440m(**case["kw"])
+    batch, seq, steps, warmup = case["batch"], 2048, 6, 2
+    state = llama.init_train_state(jax.random.key(0), cfg)
+    step = llama.make_train_step(cfg)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    b = {"tokens": tokens}
+    for _ in range(warmup):
+        state, m = step(state, b)
+    float(m["loss"])  # host readback = real sync on the axon platform
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, b)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    tps = batch * (seq - 1) * steps / dt
+    n = llama.param_count(jax.eval_shape(
+        lambda: llama.init_params(jax.random.key(0), cfg)))
+    print(json.dumps({"tag": tag, "tps": round(tps, 1),
+                      "mfu": round(tps * 6 * n / PEAK, 4)}))
+    return tps
+
+
+def main():
+    tags = sys.argv[1:] or list(CASES)
+    for tag in tags:
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--one", tag],
+                capture_output=True, text=True, timeout=1200)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"tag": tag, "error": "timeout (1200s)"}),
+                  flush=True)
+            continue
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("{")]
+        if proc.returncode == 0 and line:
+            print(line[-1], flush=True)
+        else:
+            err = (proc.stderr or "").strip().splitlines()
+            msg = err[-1][:140] if err else f"rc={proc.returncode}"
+            print(json.dumps({"tag": tag, "error": msg}), flush=True)
 
 
 if __name__ == "__main__":
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    base = dict(batch=16, seq=2048)
-    if which in ("all", "remat"):
-        run("baseline flash remat=full b16",
-            llama.LlamaConfig.llama_440m(), **base)
-        run("flash remat=dots b16",
-            llama.LlamaConfig.llama_440m(remat_policy="dots"), **base)
-        run("flash remat=False b16",
-            llama.LlamaConfig.llama_440m(remat=False), **base)
-    if which in ("all", "batch"):
-        run("flash remat=dots b32",
-            llama.LlamaConfig.llama_440m(remat_policy="dots"),
-            batch=32, seq=2048)
-        run("flash remat=full b32",
-            llama.LlamaConfig.llama_440m(), batch=32, seq=2048)
-    if which in ("all", "attn"):
-        run("dot-attn remat=dots b16",
-            llama.LlamaConfig.llama_440m(attention_impl="dot",
-                                         remat_policy="dots"), **base)
-        run("dot-attn remat=full b16",
-            llama.LlamaConfig.llama_440m(attention_impl="dot"), **base)
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        run_one(sys.argv[2])
+    else:
+        main()

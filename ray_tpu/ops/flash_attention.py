@@ -32,7 +32,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
-DEFAULT_BLOCK = 512
+# 1024 measured end-to-end on the 440M train bench (v5e, chained steps
+# with host readback): 22.5k tok/s vs 18.9k at 512 and 14.9k at 256 —
+# fewer grid steps amortize per-step sequencing overhead.  2048-wide
+# blocks fail to compile (VMEM).  (An earlier 1024 change was reverted
+# in 0982f3d because it was justified by dispatch-only microbenchmarks;
+# this one is justified by the full train step.)
+DEFAULT_BLOCK = 1024
 NEG_INF = -1e30
 
 
@@ -82,14 +88,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         should_run = True
         last_k = nk - 1
 
-    @pl.when(should_run)
-    def _compute():
+    def _compute(masked):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if masked:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -105,6 +110,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # The iota/where mask only matters for blocks the diagonal
+        # actually crosses; fully-below-diagonal blocks skip that VPU
+        # work entirely.
+        on_diag = ki * block_k + block_k - 1 > qi * block_q
+
+        @pl.when(should_run & jnp.logical_not(on_diag))
+        def _below():
+            _compute(masked=False)
+
+        @pl.when(should_run & on_diag)
+        def _diag():
+            _compute(masked=True)
+    else:
+        @pl.when(should_run)
+        def _full():
+            _compute(masked=False)
 
     @pl.when(ki == last_k)
     def _finalize():
@@ -195,8 +218,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         should_run = True
         last_k = nk - 1
 
-    @pl.when(should_run)
-    def _compute():
+    def _compute(masked):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -205,7 +227,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0, 0, :, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if masked:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -218,6 +240,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if causal:
+        on_diag = ki * block_k + block_k - 1 > qi * block_q
+
+        @pl.when(should_run & jnp.logical_not(on_diag))
+        def _below():
+            _compute(masked=False)
+
+        @pl.when(should_run & on_diag)
+        def _diag():
+            _compute(masked=True)
+    else:
+        @pl.when(should_run)
+        def _full():
+            _compute(masked=False)
 
     @pl.when(ki == last_k)
     def _finalize():
@@ -241,8 +278,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         should_run = True
 
-    @pl.when(should_run)
-    def _compute():
+    def _compute(masked):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -251,7 +287,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, :, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if masked:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -268,6 +304,21 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if causal:
+        on_diag = ki * block_k + block_k - 1 > qi * block_q
+
+        @pl.when(should_run & jnp.logical_not(on_diag))
+        def _below():
+            _compute(masked=False)
+
+        @pl.when(should_run & on_diag)
+        def _diag():
+            _compute(masked=True)
+    else:
+        @pl.when(should_run)
+        def _full():
+            _compute(masked=False)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -424,7 +475,23 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     Sk = k.shape[1]
     if Hq % k.shape[2]:
         raise ValueError(f"Hq={Hq} not a multiple of Hkv={k.shape[2]}")
-    if not _use_interpret() and not _supported(Sq, Sk, D):
+    if not _supported(Sq, Sk, D):
+        if causal and Sq == Sk:
+            # Pad the sequence up to a tileable length and slice the
+            # result.  Exact for causal self-attention: valid query rows
+            # (< Sq) can never attend to padded key columns (>= Sq)
+            # because col > row is masked; padded query rows are garbage
+            # but discarded by the slice.  Taken under interpret mode
+            # too, so CPU tests cover the same pad+slice path TPUs run.
+            s_pad = -Sq % LANES
+            if _supported(Sq + s_pad, Sk + s_pad, D):
+                pad = ((0, 0), (0, s_pad), (0, 0), (0, 0))
+                out = _flash(jnp.pad(q, pad), jnp.pad(k, pad),
+                             jnp.pad(v, pad), causal, block_q, block_k)
+                return out[:, :Sq]
+        if _use_interpret():
+            # Interpret mode tiles any shape; no fallback needed.
+            return _flash(q, k, v, causal, block_q, block_k)
         return _einsum_fallback(q, k, v, causal)
     return _flash(q, k, v, causal, block_q, block_k)
 
