@@ -58,24 +58,41 @@ def main():
         cfg = llama.LlamaConfig.debug()
         batch, seq, steps, warmup = 8, 64, 5, 1
 
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+
     state = llama.init_train_state(jax.random.key(0), cfg)
     step = llama.make_train_step(cfg)
-    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
-                                cfg.vocab_size, dtype=jnp.int32)
-    batch_data = {"tokens": tokens}
 
+    # Train through the real input plane: a ray_tpu.data pipeline
+    # streams token blocks through the executor, batches them, and
+    # device_puts each batch one step ahead of the consumer.
+    ray_tpu.init(num_tpus=0)
+    rng = np.random.default_rng(0)
+    n_rows = (warmup + steps) * batch
+    rows = rng.integers(0, cfg.vocab_size,
+                        (n_rows, seq)).astype(np.int32)
+    ds = rd.from_blocks(
+        [{"tokens": rows[i:i + batch]}
+         for i in range(0, n_rows, batch)])
+
+    it = ds.iter_batches(batch_size=batch, drop_last=True,
+                         prefetch_batches=2, device_put=True)
     for _ in range(warmup):
-        state, metrics = step(state, batch_data)
+        state, metrics = step(state, next(it))
     float(metrics["loss"])  # host transfer = real sync (axon's
     # block_until_ready returns before execution completes)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = step(state, batch_data)
+        state, metrics = step(state, next(it))
     # Steps chain through `state`, so fetching the last loss waits for
     # the whole sequence.
     float(metrics["loss"])
     dt = time.perf_counter() - t0
+    ray_tpu.shutdown()
 
     tokens_per_step = batch * (seq - 1)
     tps = tokens_per_step * steps / dt

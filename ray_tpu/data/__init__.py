@@ -1,0 +1,25 @@
+"""ray_tpu.data — streaming data engine feeding TPU training.
+
+Reference: python/ray/data (81.3k LoC).  This is the TPU-first MVP of
+the same shape: lazy Dataset plan → fused map phases → streaming
+executor over ray_tpu tasks with backpressure → exact-size numpy
+batches with device_put prefetch; ``streaming_split`` provides the
+per-worker shards ray_tpu.train consumes (reference:
+train/_internal/data_config.py).
+"""
+
+from .block import Block, BlockAccessor, BlockMetadata
+from .context import DataContext
+from .dataset import (DataIterator, Dataset, from_arrow, from_blocks,
+                      from_items, from_numpy, from_pandas, range,
+                      read_csv, read_datasource, read_json, read_numpy,
+                      read_parquet)
+from .datasource import Datasource, FileDatasource, ReadTask
+
+__all__ = [
+    "Block", "BlockAccessor", "BlockMetadata", "DataContext",
+    "DataIterator", "Dataset", "Datasource", "FileDatasource",
+    "ReadTask", "from_arrow", "from_blocks", "from_items", "from_numpy",
+    "from_pandas", "range", "read_csv", "read_datasource", "read_json",
+    "read_numpy", "read_parquet",
+]

@@ -65,9 +65,15 @@ class BlockAccessor:
 
     @staticmethod
     def concat(blocks: Sequence[Block]) -> Block:
+        """Concatenate blocks.  Blocks are immutable by contract
+        (transform fns must return new arrays, never mutate inputs):
+        single-block concat and slice() return aliases/views, so an
+        in-place mutation downstream would corrupt upstream blocks."""
         blocks = [b for b in blocks if BlockAccessor.num_rows(b)]
         if not blocks:
             return {}
+        if len(blocks) == 1:  # no copy for the common single-block case
+            return blocks[0]
         keys = list(blocks[0].keys())
         return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
 

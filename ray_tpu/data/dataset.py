@@ -1,0 +1,497 @@
+"""Dataset facade: lazy logical plan + consumption APIs.
+
+Reference: python/ray/data/dataset.py:146 (``Dataset`` — lazy plan,
+``iter_batches`` :3935, ``materialize`` :4897) and
+``streaming_split`` → output_splitter (used by
+train/_internal/data_config.py for per-worker shards).
+
+TPU-first notes: batches are dict[str, np.ndarray] — exactly what a jit
+train step takes; ``iter_batches(device_put=True)`` overlaps host→HBM
+transfer of batch N+1 with the consumer's step N (the reference's
+prefetching batcher + GPU pinning, block_batching/).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import itertools
+import threading
+from collections import deque
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Union)
+
+import numpy as np
+
+from .block import Block, BlockAccessor, BlockMetadata
+from .context import DataContext
+from .datasource import (BlocksDatasource, Datasource, ItemsDatasource,
+                         RangeDatasource, csv_datasource, json_datasource,
+                         numpy_datasource, parquet_datasource)
+from .executor import (AllToAll, Limit, LogicalOp, MapBlocks, PlanStats,
+                       Read, execute_streaming)
+
+
+class Dataset:
+    """Lazy, immutable pipeline of blocks.  Every transform returns a new
+    Dataset sharing the prefix of the plan (reference dataset.py:146)."""
+
+    def __init__(self, ops: List[LogicalOp]):
+        self._ops = ops
+        self._last_stats: Optional[PlanStats] = None
+
+    # -- transforms ---------------------------------------------------------
+    def _with(self, op: LogicalOp) -> "Dataset":
+        return Dataset(self._ops + [op])
+
+    def map_batches(self, fn: Callable[[Block], Block], *,
+                    batch_size: Optional[int] = None) -> "Dataset":
+        """Apply ``fn`` to batches (reference dataset.map_batches).
+        With ``batch_size=None`` the fn sees whole blocks (zero-copy);
+        otherwise blocks are re-chunked to exactly ``batch_size`` rows
+        inside the task."""
+        if batch_size is None:
+            def tf(block: Block) -> List[Block]:
+                return [BlockAccessor.validate(fn(block))]
+        else:
+            def tf(block: Block) -> List[Block]:
+                out = []
+                n = BlockAccessor.num_rows(block)
+                for lo in builtins.range(0, n, batch_size):
+                    piece = BlockAccessor.slice(block, lo,
+                                                min(lo + batch_size, n))
+                    out.append(BlockAccessor.validate(fn(piece)))
+                return out
+        return self._with(MapBlocks("MapBatches", tf))
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
+            ) -> "Dataset":
+        def tf(block: Block) -> List[Block]:
+            rows = [fn(r) for r in BlockAccessor.to_rows(block)]
+            return [BlockAccessor.from_rows(rows)]
+        return self._with(MapBlocks("Map", tf))
+
+    def flat_map(self, fn: Callable[[Dict[str, Any]], Sequence[Dict]]
+                 ) -> "Dataset":
+        def tf(block: Block) -> List[Block]:
+            rows: List[Dict[str, Any]] = []
+            for r in BlockAccessor.to_rows(block):
+                rows.extend(fn(r))
+            return [BlockAccessor.from_rows(rows)] if rows else []
+        return self._with(MapBlocks("FlatMap", tf))
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        def tf(block: Block) -> List[Block]:
+            keep = np.fromiter(
+                (bool(fn(r)) for r in BlockAccessor.to_rows(block)),
+                dtype=bool, count=BlockAccessor.num_rows(block))
+            return [BlockAccessor.take(block, np.nonzero(keep)[0])]
+        return self._with(MapBlocks("Filter", tf))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(Limit(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def fn(blocks: List[Block], ctx: DataContext) -> List[Block]:
+            whole = BlockAccessor.concat(blocks)
+            rows = BlockAccessor.num_rows(whole)
+            if rows == 0:
+                return []
+            bounds = np.linspace(0, rows, num_blocks + 1).astype(np.int64)
+            return [BlockAccessor.slice(whole, int(lo), int(hi))
+                    for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
+        return self._with(AllToAll("Repartition", fn))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global shuffle (barrier).  Reference: push-based shuffle
+        (push_based_shuffle_task_scheduler.py:590); single-host MVP does
+        a driver-side permutation, preserving the blocks' row count
+        distribution."""
+        def fn(blocks: List[Block], ctx: DataContext) -> List[Block]:
+            whole = BlockAccessor.concat(blocks)
+            rows = BlockAccessor.num_rows(whole)
+            if rows == 0:
+                return []
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(rows)
+            shuffled = BlockAccessor.take(whole, perm)
+            sizes = [BlockAccessor.num_rows(b) for b in blocks]
+            out, lo = [], 0
+            for s in sizes:
+                out.append(BlockAccessor.slice(shuffled, lo, lo + s))
+                lo += s
+            return out
+        return self._with(AllToAll("RandomShuffle", fn))
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        def fn(blocks: List[Block], ctx: DataContext) -> List[Block]:
+            whole = BlockAccessor.concat(blocks)
+            if BlockAccessor.num_rows(whole) == 0:
+                return []
+            order = np.argsort(whole[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            return [BlockAccessor.take(whole, order)]
+        return self._with(AllToAll("Sort", fn))
+
+    # -- execution ----------------------------------------------------------
+    def iter_blocks(self) -> Iterator[Block]:
+        self._last_stats = PlanStats()
+        return execute_streaming(self._ops, stats=self._last_stats)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     batch_format: str = "numpy",
+                     prefetch_batches: Optional[int] = None,
+                     device_put: bool = False) -> Iterator[Any]:
+        """Stream exact-size batches (reference dataset.py:3935 +
+        _internal/batcher.py).  ``device_put=True`` moves each batch to
+        the default jax device one batch ahead of the consumer."""
+        ctx = DataContext.get_current()
+        depth = (ctx.prefetch_batches if prefetch_batches is None
+                 else prefetch_batches)
+        return _assemble_batches(
+            self.iter_blocks(), batch_size=batch_size,
+            drop_last=drop_last, batch_format=batch_format,
+            prefetch=depth, device_put=device_put)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor.to_rows(block)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        return list(itertools.islice(self.limit(n).iter_rows(), n))
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(BlockAccessor.num_rows(b) for b in self.iter_blocks())
+
+    def schema(self) -> Optional[Dict[str, np.dtype]]:
+        for block in self.iter_blocks():
+            return BlockAccessor.schema(block)
+        return None
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result re-reads from memory
+        (reference dataset.py:4897)."""
+        blocks = list(self.iter_blocks())
+        return Dataset([Read(BlocksDatasource(blocks))])
+
+    def stats(self) -> str:
+        if self._last_stats is None:
+            return "(dataset not executed yet)"
+        return self._last_stats.summary()
+
+    # -- splitting (Train integration) --------------------------------------
+    def streaming_split(self, n: int, *, equal: bool = True
+                        ) -> List["DataIterator"]:
+        """N per-consumer iterators over ONE shared execution
+        (reference: Dataset.streaming_split → output_splitter op, the
+        API train/_internal/data_config.py shards datasets with).
+        ``equal=True`` slices every block into n row-balanced pieces
+        (shards stay within ±1 row of each other, keeping a lockstep
+        training gang in sync); ``equal=False`` deals whole blocks
+        round-robin.  Consumers advance epochs in lockstep.
+        """
+        router = _SplitRouter(self, n, equal=equal)
+        return [DataIterator(router, i) for i in builtins.range(n)]
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Materializing split into n row-balanced datasets."""
+        blocks = list(self.iter_blocks())
+        whole = BlockAccessor.concat(blocks)
+        rows = BlockAccessor.num_rows(whole)
+        bounds = np.linspace(0, rows, n + 1).astype(np.int64)
+        return [Dataset([Read(BlocksDatasource(
+            [BlockAccessor.slice(whole, int(lo), int(hi))]))])
+                for lo, hi in zip(bounds[:-1], bounds[1:])]
+
+    def __repr__(self):
+        names = [getattr(op, "name", type(op).__name__)
+                 for op in self._ops]
+        return f"Dataset({' -> '.join(names)})"
+
+
+class _SplitRouter:
+    """Routes blocks of one shared streaming execution to n consumers,
+    round-robin by block index.  Epoch-aware: a consumer that finishes
+    epoch e and starts epoch e+1 blocks until every consumer has
+    finished epoch e, then the plan re-executes (reference
+    DataIterators are re-iterable; training loops advance epochs in
+    lockstep)."""
+
+    _END = object()
+
+    def __init__(self, ds: Dataset, n: int, equal: bool = True):
+        self._n = n
+        self._equal = equal
+        self._cond = threading.Condition()
+        self._queues: List[deque] = [deque() for _ in builtins.range(n)]
+        self._source: Optional[Iterator[Block]] = None
+        self._ds = ds
+        self._next = 0
+        self._done = False
+        self._finished: set = set()
+        self._epoch = 0
+
+    def _deal(self, block: Block):
+        if not self._equal:
+            self._queues[self._next].append(block)
+            self._next = (self._next + 1) % self._n
+            return
+        # Row-balanced: slice the block into n contiguous pieces,
+        # rotating which shard gets the (possibly longer) first piece
+        # so remainders even out across blocks.
+        rows = BlockAccessor.num_rows(block)
+        bounds = np.linspace(0, rows, self._n + 1).astype(np.int64)
+        for j in builtins.range(self._n):
+            lo, hi = int(bounds[j]), int(bounds[j + 1])
+            if hi > lo:
+                shard = (j + self._next) % self._n
+                self._queues[shard].append(
+                    BlockAccessor.slice(block, lo, hi))
+        self._next = (self._next + 1) % self._n
+
+    def next_block(self, shard: int, epoch: int) -> Any:
+        """Next block for ``shard`` in ``epoch``, or ``_END`` at the end
+        of that shard's epoch."""
+        with self._cond:
+            while epoch > self._epoch:
+                # This consumer is ahead; wait for laggards to finish
+                # the current epoch.
+                self._cond.wait(timeout=1.0)
+            if epoch < self._epoch:
+                # The epoch this iterator belongs to is over.
+                return self._END
+            while not self._queues[shard]:
+                if self._done:
+                    if shard not in self._finished:
+                        self._finished.add(shard)
+                        if len(self._finished) == self._n:
+                            # Everyone finished: rearm for next epoch.
+                            self._source = None
+                            self._done = False
+                            self._finished = set()
+                            self._next = 0
+                            self._epoch += 1
+                            self._cond.notify_all()
+                    return self._END
+                if self._source is None:
+                    self._source = self._ds.iter_blocks()
+                try:
+                    block = next(self._source)
+                except StopIteration:
+                    self._done = True
+                    continue
+                self._deal(block)
+                self._cond.notify_all()
+            return self._queues[shard].popleft()
+
+
+class DataIterator:
+    """Per-worker view of a streaming_split (reference:
+    data/iterator.py DataIterator)."""
+
+    def __init__(self, router: _SplitRouter, shard: int):
+        self._router = router
+        self._shard = shard
+        self._epoch = 0
+
+    def iter_blocks(self) -> Iterator[Block]:
+        epoch = self._epoch
+        self._epoch += 1
+        while True:
+            block = self._router.next_block(self._shard, epoch)
+            if block is _SplitRouter._END:
+                return
+            yield block
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     device_put: bool = False) -> Iterator[Any]:
+        return _assemble_batches(
+            self.iter_blocks(), batch_size=batch_size,
+            drop_last=drop_last, batch_format=batch_format,
+            prefetch=prefetch_batches, device_put=device_put)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor.to_rows(block)
+
+
+# --------------------------------------------------------------------------
+# Batching / prefetch plumbing
+# --------------------------------------------------------------------------
+def _assemble_batches(blocks: Iterator[Block], *, batch_size: int,
+                      drop_last: bool, batch_format: str,
+                      prefetch: int, device_put: bool) -> Iterator[Any]:
+    """Batcher → optional device_put → optional prefetch thread →
+    format-on-consumer.  Formatting (e.g. pandas DataFrame build) runs
+    on the caller's thread, never the prefetch daemon: pandas' lazy
+    native init on a short-lived thread corrupts later pyarrow calls
+    on other fresh threads (segfault observed under the test suite)."""
+    if device_put and batch_format != "numpy":
+        raise ValueError("device_put requires batch_format='numpy'")
+    it = _batch_iterator(blocks, batch_size, drop_last)
+    if device_put:
+        it = _device_put_iter(it)
+    if prefetch > 0:
+        it = _prefetch_iter(it, prefetch)
+    if batch_format == "numpy":
+        return it
+    return (_format_batch(b, batch_format) for b in it)
+
+
+def _batch_iterator(blocks: Iterator[Block], batch_size: int,
+                    drop_last: bool) -> Iterator[Block]:
+    """Re-chunk a block stream into exact-size numpy batches
+    (reference: _internal/batcher.py).  Batches are numpy views into
+    the merged buffer (an offset walks the block; only the sub-batch
+    tail is ever copied into the next merge), so a single huge block
+    costs O(rows), not O(rows²/batch_size)."""
+    merged: Block = {}
+    offset = 0
+    for block in blocks:
+        if not merged or offset >= BlockAccessor.num_rows(merged):
+            merged, offset = block, 0
+        else:
+            tail = BlockAccessor.slice(merged, offset,
+                                       BlockAccessor.num_rows(merged))
+            merged, offset = BlockAccessor.concat([tail, block]), 0
+        while BlockAccessor.num_rows(merged) - offset >= batch_size:
+            yield BlockAccessor.slice(merged, offset,
+                                      offset + batch_size)
+            offset += batch_size
+    leftover = (BlockAccessor.num_rows(merged) - offset
+                if merged else 0)
+    if leftover > 0 and not drop_last:
+        yield BlockAccessor.slice(merged, offset, offset + leftover)
+
+
+def _format_batch(batch: Block, batch_format: str) -> Any:
+    if batch_format == "numpy":
+        return batch
+    if batch_format == "pandas":
+        return BlockAccessor.to_pandas(batch)
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def _device_put_iter(batches: Iterator[Block]) -> Iterator[Any]:
+    """Move batches to the default jax device, one ahead of the consumer
+    (host→HBM transfer overlaps the consumer's current step)."""
+    import jax
+
+    pending = None
+    for batch in batches:
+        nxt = jax.device_put(batch)
+        if pending is not None:
+            yield pending
+        pending = nxt
+    if pending is not None:
+        yield pending
+
+
+def _prefetch_iter(it: Iterator[Any], depth: int) -> Iterator[Any]:
+    """Run the upstream iterator in a daemon thread with a bounded
+    queue (reference: block_batching prefetcher).  An abandoned
+    consumer (break / GC) stops the pump via the stop flag, so no
+    thread stays blocked holding device batches."""
+    import queue as _queue
+
+    q: "_queue.Queue[Any]" = _queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def pump():
+        try:
+            for item in it:
+                if not put(item):
+                    return
+            put(_END)
+        except BaseException as e:  # noqa: BLE001 — surface to consumer
+            put(e)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except _queue.Empty:
+            pass
+
+
+# --------------------------------------------------------------------------
+# Read API (reference: read_api.py)
+# --------------------------------------------------------------------------
+def read_datasource(source: Datasource, *, parallelism: int = -1
+                    ) -> Dataset:
+    return Dataset([Read(source, parallelism)])
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def from_items(items: Sequence[Any]) -> Dataset:
+    return read_datasource(ItemsDatasource(items))
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    return read_datasource(BlocksDatasource(blocks))
+
+
+def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]]) -> Dataset:
+    if isinstance(arrays, dict):
+        return from_blocks([arrays])
+    return from_blocks([{"data": np.asarray(arrays)}])
+
+
+def from_pandas(df) -> Dataset:
+    return from_blocks([BlockAccessor.from_pandas(df)])
+
+
+def from_arrow(table) -> Dataset:
+    return from_blocks([BlockAccessor.from_arrow(table)])
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = -1) -> Dataset:
+    return read_datasource(parquet_datasource(paths, columns=columns),
+                           parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return read_datasource(csv_datasource(paths, **kw),
+                           parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(json_datasource(paths),
+                           parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(numpy_datasource(paths),
+                           parallelism=parallelism)

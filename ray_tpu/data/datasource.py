@@ -120,9 +120,20 @@ class FileDatasource(Datasource):
 
 
 def _read_parquet_file(path: str, columns=None) -> List[Block]:
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
-    table = pq.read_table(path, columns=columns)
+    # Plain Python file read + BufferReader, NOT pq.read_table(path):
+    # both the ParquetDataset machinery and arrow's LocalFileSystem
+    # open_input_file segfault when first exercised from a worker
+    # thread in a process with many native libs loaded (observed
+    # reproducibly under the full test suite; fine in isolation).
+    # Reading bytes ourselves keeps arrow's filesystem layer out of
+    # worker threads entirely.
+    with open(path, "rb") as f:
+        buf = f.read()
+    table = pq.ParquetFile(pa.BufferReader(buf)).read(
+        columns=columns, use_threads=False)
     return [BlockAccessor.from_arrow(table)]
 
 

@@ -68,6 +68,27 @@ def _supported(sq: int, sk: int, d: int) -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
+def _causal_dispatch(compute, causal, should_run, qi, ki,
+                     block_q, block_k):
+    """Run ``compute(masked=...)`` under pl.when: causal kernels mask
+    only blocks the diagonal crosses (fully-below-diagonal blocks skip
+    the iota/where VPU work)."""
+    if causal:
+        on_diag = ki * block_k + block_k - 1 > qi * block_q
+
+        @pl.when(should_run & jnp.logical_not(on_diag))
+        def _below():
+            compute(masked=False)
+
+        @pl.when(should_run & on_diag)
+        def _diag():
+            compute(masked=True)
+    else:
+        @pl.when(should_run)
+        def _full():
+            compute(masked=False)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, block_q, block_k, nk, causal):
     qi = pl.program_id(2)
@@ -111,23 +132,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        # The iota/where mask only matters for blocks the diagonal
-        # actually crosses; fully-below-diagonal blocks skip that VPU
-        # work entirely.
-        on_diag = ki * block_k + block_k - 1 > qi * block_q
-
-        @pl.when(should_run & jnp.logical_not(on_diag))
-        def _below():
-            _compute(masked=False)
-
-        @pl.when(should_run & on_diag)
-        def _diag():
-            _compute(masked=True)
-    else:
-        @pl.when(should_run)
-        def _full():
-            _compute(masked=False)
+    _causal_dispatch(_compute, causal, should_run, qi, ki,
+                     block_q, block_k)
 
     @pl.when(ki == last_k)
     def _finalize():
@@ -241,20 +247,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        on_diag = ki * block_k + block_k - 1 > qi * block_q
-
-        @pl.when(should_run & jnp.logical_not(on_diag))
-        def _below():
-            _compute(masked=False)
-
-        @pl.when(should_run & on_diag)
-        def _diag():
-            _compute(masked=True)
-    else:
-        @pl.when(should_run)
-        def _full():
-            _compute(masked=False)
+    _causal_dispatch(_compute, causal, should_run, qi, ki,
+                     block_q, block_k)
 
     @pl.when(ki == last_k)
     def _finalize():
@@ -305,20 +299,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        on_diag = ki * block_k + block_k - 1 > qi * block_q
-
-        @pl.when(should_run & jnp.logical_not(on_diag))
-        def _below():
-            _compute(masked=False)
-
-        @pl.when(should_run & on_diag)
-        def _diag():
-            _compute(masked=True)
-    else:
-        @pl.when(should_run)
-        def _full():
-            _compute(masked=False)
+    _causal_dispatch(_compute, causal, should_run, qi, ki,
+                     block_q, block_k)
 
     @pl.when(qi == nq - 1)
     def _finalize():

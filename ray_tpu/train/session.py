@@ -116,8 +116,32 @@ def get_dataset_shard(dataset_name: str = "train"):
     world = s.context.get_world_size()
     # ray_tpu.data.Dataset → streaming split; plain iterables → strided.
     if hasattr(ds, "streaming_split"):
-        return ds.streaming_split(world)[rank]
+        # One shared split per dataset NAME (not per object: two names
+        # bound to the same Dataset need independent executions, or
+        # each would see only a fraction of the rows): each worker
+        # creating its own split would re-execute the whole plan N
+        # times.
+        with _split_lock:
+            key = (dataset_name, id(ds))
+            splits = _split_cache.get(key)
+            if splits is None or len(splits) != world:
+                splits = ds.streaming_split(world)
+                _split_cache[key] = splits
+        return splits[rank]
     return _StridedShard(ds, rank, world)
+
+
+_split_lock = threading.Lock()
+_split_cache: Dict[int, Any] = {}
+
+
+def reset_dataset_shards():
+    """Drop cached streaming splits.  The trainer calls this at the
+    start of every run attempt: a router abandoned mid-epoch by a
+    crashed run would otherwise deadlock the retry (its epoch counter
+    never advances), and evicting per run bounds the cache."""
+    with _split_lock:
+        _split_cache.clear()
 
 
 class _StridedShard:

@@ -70,6 +70,9 @@ class JaxTrainer:
         all_metrics: list = []
 
         while True:
+            from .session import reset_dataset_shards
+
+            reset_dataset_shards()
             collector = _ReportCollector.remote()
             group = WorkerGroup(
                 self.scaling_config.num_workers,
@@ -128,11 +131,18 @@ class JaxTrainer:
     @staticmethod
     def _finish(all_metrics, final_ckpt, last_error, max_failures,
                 attempts, storage, manager) -> Result:
+        try:
+            import pandas as pd
+
+            metrics_df = pd.DataFrame(all_metrics)
+        except ImportError:  # pandas is optional everywhere else too
+            metrics_df = None
         result = Result(
             metrics=all_metrics[-1] if all_metrics else {},
             checkpoint=final_ckpt,
             error=last_error,
-            path=storage)
+            path=storage,
+            metrics_dataframe=metrics_df)
         result._best_checkpoints = manager.list_checkpoints()
         if last_error is not None and max_failures >= 0:
             raise TrainingFailedError(

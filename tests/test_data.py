@@ -1,0 +1,197 @@
+"""ray_tpu.data: blocks, read API, transforms, streaming executor,
+batching, splits (reference test strategy: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.block import BlockAccessor
+
+
+def test_range_count_schema(ray_start_regular):
+    ds = rd.range(1000)
+    assert ds.count() == 1000
+    assert ds.schema() == {"id": np.dtype(np.int64)}
+
+
+def test_from_items_rows(ray_start_regular):
+    ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    rows = ds.take_all()
+    assert [r["a"] for r in rows] == [1, 2]
+    assert [r["b"] for r in rows] == ["x", "y"]
+
+
+def test_map_batches_and_order(ray_start_regular):
+    ds = rd.range(100, parallelism=5).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    rows = ds.take_all()
+    assert [r["id"] for r in rows] == list(range(100))  # order preserved
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+
+
+def test_map_filter_flat_map(ray_start_regular):
+    ds = (rd.range(20)
+          .filter(lambda r: r["id"] % 2 == 0)
+          .map(lambda r: {"x": int(r["id"]) * 10})
+          .flat_map(lambda r: [r, r]))
+    xs = [r["x"] for r in ds.take_all()]
+    assert xs == sorted([i * 10 for i in range(0, 20, 2)] * 2)
+
+
+def test_limit_streams(ray_start_regular):
+    ds = rd.range(10_000, parallelism=16).limit(25)
+    assert [r["id"] for r in ds.take_all()] == list(range(25))
+
+
+def test_take(ray_start_regular):
+    assert len(rd.range(100).take(7)) == 7
+
+
+def test_iter_batches_exact_sizes(ray_start_regular):
+    ds = rd.range(1000, parallelism=7)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=128)]
+    assert sizes == [128] * 7 + [104]
+    sizes = [len(b["id"]) for b in
+             ds.iter_batches(batch_size=128, drop_last=True)]
+    assert sizes == [128] * 7
+
+
+def test_iter_batches_pandas_format(ray_start_regular):
+    batches = list(rd.range(10).iter_batches(batch_size=5,
+                                             batch_format="pandas"))
+    import pandas as pd
+
+    assert isinstance(batches[0], pd.DataFrame)
+    assert list(batches[0]["id"]) == list(range(5))
+
+
+def test_repartition(ray_start_regular):
+    ds = rd.range(100, parallelism=3).repartition(10)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 10
+    assert all(BlockAccessor.num_rows(b) == 10 for b in blocks)
+    assert np.concatenate([b["id"] for b in blocks]).tolist() == \
+        list(range(100))
+
+
+def test_random_shuffle_seeded(ray_start_regular):
+    a = [r["id"] for r in rd.range(100).random_shuffle(seed=7).take_all()]
+    b = [r["id"] for r in rd.range(100).random_shuffle(seed=7).take_all()]
+    c = [r["id"] for r in rd.range(100).random_shuffle(seed=8).take_all()]
+    assert a == b
+    assert a != c
+    assert sorted(a) == list(range(100))
+
+
+def test_sort(ray_start_regular):
+    ds = rd.from_items([{"k": v} for v in [3, 1, 2]]).sort("k")
+    assert [r["k"] for r in ds.take_all()] == [1, 2, 3]
+    ds = rd.from_items([{"k": v} for v in [3, 1, 2]]).sort(
+        "k", descending=True)
+    assert [r["k"] for r in ds.take_all()] == [3, 2, 1]
+
+
+def test_materialize_and_stats(ray_start_regular):
+    ds = rd.range(50).map_batches(lambda b: b).materialize()
+    assert ds.count() == 50
+    assert "Read" in ds.stats()
+
+
+def test_read_parquet_roundtrip(ray_start_regular, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for i in (0, 1):
+        t = pa.table({"x": np.arange(i * 10, i * 10 + 10),
+                      "y": np.arange(10, dtype=np.float32) * 0.5})
+        pq.write_table(t, tmp_path / f"part-{i}.parquet")
+    ds = rd.read_parquet(str(tmp_path))
+    rows = ds.take_all()
+    assert len(rows) == 20
+    assert sorted(r["x"] for r in rows) == list(range(20))
+    # column pruning
+    ds2 = rd.read_parquet(str(tmp_path), columns=["x"])
+    assert set(ds2.schema()) == {"x"}
+
+
+def test_read_csv_json(ray_start_regular, tmp_path):
+    (tmp_path / "f.csv").write_text("a,b\n1,x\n2,y\n")
+    ds = rd.read_csv(str(tmp_path / "f.csv"))
+    assert [r["a"] for r in ds.take_all()] == [1, 2]
+
+    (tmp_path / "f.jsonl").write_text('{"v": 1}\n{"v": 2}\n')
+    ds = rd.read_json(str(tmp_path / "f.jsonl"))
+    assert [r["v"] for r in ds.take_all()] == [1, 2]
+
+
+def test_from_numpy_pandas(ray_start_regular):
+    ds = rd.from_numpy(np.arange(5))
+    assert [r["data"] for r in ds.take_all()] == list(range(5))
+    import pandas as pd
+
+    ds = rd.from_pandas(pd.DataFrame({"c": [1, 2, 3]}))
+    assert ds.count() == 3
+
+
+def test_streaming_split_disjoint_complete(ray_start_regular):
+    ds = rd.range(100, parallelism=10)
+    it0, it1 = ds.streaming_split(2)
+    # Interleaved consumption (the trainer pattern).
+    rows0, rows1 = [], []
+    g0 = it0.iter_rows()
+    g1 = it1.iter_rows()
+    done0 = done1 = False
+    while not (done0 and done1):
+        if not done0:
+            try:
+                rows0.append(next(g0)["id"])
+            except StopIteration:
+                done0 = True
+        if not done1:
+            try:
+                rows1.append(next(g1)["id"])
+            except StopIteration:
+                done1 = True
+    assert rows0 and rows1
+    assert sorted(rows0 + rows1) == list(range(100))
+    assert not (set(rows0) & set(rows1))
+
+
+def test_streaming_split_multi_epoch(ray_start_regular):
+    ds = rd.range(20, parallelism=2)
+    shards = ds.streaming_split(2)
+    for _epoch in (0, 1):
+        seen = []
+        for sh in shards:
+            seen.extend(r["id"] for r in sh.iter_rows())
+        assert sorted(seen) == list(range(20))
+
+
+def test_device_put_batches(ray_start_regular):
+    import jax
+
+    batches = list(rd.range(32).iter_batches(batch_size=16,
+                                             device_put=True))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jax.Array)
+
+
+def test_map_batches_rebatch_inside_task(ray_start_regular):
+    calls = []
+
+    def fn(b):
+        calls.append(len(b["id"]))
+        return b
+
+    ds = rd.range(100, parallelism=1).map_batches(fn, batch_size=30)
+    assert ds.count() == 100
+
+
+def test_executor_error_propagates(ray_start_regular):
+    def boom(b):
+        raise ValueError("bad batch")
+
+    ds = rd.range(10).map_batches(boom)
+    with pytest.raises(Exception, match="bad batch"):
+        ds.take_all()

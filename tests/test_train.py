@@ -1,0 +1,93 @@
+"""JaxTrainer E2E: dataset-fed training across a worker gang
+(reference: train/tests/test_data_parallel_trainer.py shape).
+
+Only rank 0's metrics reach the Result (reference semantics), so
+cross-rank assertions go through files under tmp_path.
+"""
+
+import json
+import os
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu import train as rt_train
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+def test_trainer_trains_from_dataset(ray_start_regular, tmp_path):
+    ds = rd.range(64, parallelism=4)
+    out_dir = tmp_path / "seen"
+    out_dir.mkdir()
+
+    def loop(config):
+        ctx = rt_train.get_context()
+        shard = rt_train.get_dataset_shard("train")
+        seen = []
+        for batch in shard.iter_batches(batch_size=8):
+            seen.extend(int(x) for x in batch["id"])
+        rank = ctx.get_world_rank()
+        with open(os.path.join(config["out"], f"rank{rank}.json"),
+                  "w") as f:
+            json.dump(seen, f)
+        rt_train.report({"n": len(seen)})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"out": str(out_dir)},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path / "results")),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["n"] > 0  # rank 0 saw data
+    seen = []
+    for rank in (0, 1):
+        with open(out_dir / f"rank{rank}.json") as f:
+            part = json.load(f)
+        assert part, f"rank {rank} saw no rows"
+        seen.extend(part)
+    # Disjoint shards covering every row exactly once.
+    assert sorted(seen) == list(range(64))
+
+
+def test_trainer_dataset_multi_epoch(ray_start_regular, tmp_path):
+    ds = rd.range(32, parallelism=2)
+    out_dir = tmp_path / "seen"
+    out_dir.mkdir()
+
+    def loop(config):
+        ctx = rt_train.get_context()
+        shard = rt_train.get_dataset_shard("train")
+        per_epoch = []
+        for _epoch in (0, 1):
+            rows = 0
+            for batch in shard.iter_batches(batch_size=4):
+                rows += len(batch["id"])
+            per_epoch.append(rows)
+        rank = ctx.get_world_rank()
+        with open(os.path.join(config["out"], f"rank{rank}.json"),
+                  "w") as f:
+            json.dump(per_epoch, f)
+        rt_train.report({"per_epoch": per_epoch})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"out": str(out_dir)},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path / "results")),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    totals = [0, 0]
+    for rank in (0, 1):
+        with open(out_dir / f"rank{rank}.json") as f:
+            per_epoch = json.load(f)
+        assert len(per_epoch) == 2
+        for e, rows in enumerate(per_epoch):
+            totals[e] += rows
+    # Each epoch's shards cover all 32 rows across the two workers.
+    assert totals == [32, 32]
