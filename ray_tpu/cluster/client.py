@@ -45,6 +45,8 @@ class ClusterClient:
         self._actor_locations: Dict[Any, Tuple[str, str]] = {}
         self._loc_lock = threading.Lock()
         self._stopped = threading.Event()
+        # (expiry, demand) of the last failed spill placement.
+        self._spill_noroom = (0.0, {})
 
         self.server = NodeServer(runtime, self)
         self.address = self.server.address
@@ -77,26 +79,85 @@ class ClusterClient:
                 traceback.print_exc()
 
     # ------------------------------------------------------------- tasks
+    def placement_params(self, spec) -> dict:
+        """Derive head-placement parameters from the spec's scheduling
+        strategy (reference: util/scheduling_strategies.py consumed by
+        scheduling/policy/*)."""
+        from ..core.task_spec import (NodeAffinitySchedulingStrategy,
+                                      NodeLabelSchedulingStrategy,
+                                      SpreadSchedulingStrategy)
+
+        params: dict = {}
+        strat = spec.scheduling_strategy
+        if isinstance(strat, SpreadSchedulingStrategy):
+            params["strategy"] = "spread"
+        elif isinstance(strat, NodeAffinitySchedulingStrategy):
+            params["affinity_node_id"] = strat.node_id
+            params["affinity_soft"] = strat.soft
+        elif isinstance(strat, NodeLabelSchedulingStrategy):
+            params["label_hard"] = dict(strat.hard)
+            params["label_soft"] = dict(strat.soft)
+        return params
+
+    def try_spill_task(self, spec) -> bool:
+        """Offer a task that fits locally-but-not-now to a peer with
+        CURRENT headroom (reference hybrid policy: prefer local until
+        packed, then spill — cluster_task_manager.cc:159).  Returns
+        False (caller queues locally) when no peer has room.
+
+        A no-headroom answer is cached for one heartbeat so a driver
+        submitting thousands of small tasks while saturated doesn't pay
+        a head round-trip per ``.remote()``.  The cache remembers which
+        demand failed: a strictly smaller demand still gets its own
+        attempt (a peer may fit it even if the big one didn't)."""
+        now = time.monotonic()
+        until, failed = self._spill_noroom
+        demand = dict(spec.resources or {})
+        if now < until and all(demand.get(k, 0) >= v
+                               for k, v in failed.items()):
+            return False
+        params = self.placement_params(spec)
+        params["available_only"] = True
+        exclude = set(spec.excluded_nodes()) | {self.node_id}
+        try:
+            resp = self.head.call("place", {
+                "resources": demand,
+                "exclude": list(exclude), **params}, timeout=2.0)
+        except Exception:
+            self._spill_noroom = (now + _HEARTBEAT_S, demand)
+            return False
+        if not resp.get("ok"):
+            self._spill_noroom = (now + _HEARTBEAT_S, demand)
+            return False
+        self._push_to(spec, resp["node_id"], resp["address"])
+        return True
+
     def submit_remote_task(self, spec) -> None:
         """Owner-side push of a plain task to a remote node.  Completion
         (success, user error, node death) seals the owner's return refs
         via the local TaskManager, so retries and ref semantics are
         identical to local execution."""
-        from ..exceptions import NodeDiedError, TaskError
+        from ..exceptions import TaskError
 
         try:
             placed = self._place(spec.resources,
-                                 exclude=spec.excluded_nodes())
+                                 exclude=spec.excluded_nodes(),
+                                 **self.placement_params(spec))
         except Exception as e:
             self.runtime.task_manager.complete_error(
                 spec, TaskError(spec.repr_name(), e), allow_retry=False)
             return
         node_id, address = placed
+        self._push_to(spec, node_id, address)
+
+    def _push_to(self, spec, node_id: str, address: str) -> None:
+        from ..exceptions import NodeDiedError
         bundle = dumps({
             "function": spec.function,
             "args": spec.args, "kwargs": spec.kwargs,
             "num_returns": spec.num_returns,
             "name": spec.name,
+            "resources": dict(spec.resources or {}),
         })
 
         def on_done(result, is_error):
@@ -125,10 +186,10 @@ class ClusterClient:
             self.runtime.task_manager.complete_error(
                 spec, NodeDiedError(f"push to {node_id[:8]} failed: {e}"))
 
-    def _place(self, resources, exclude=()) -> Tuple[str, str]:
+    def _place(self, resources, exclude=(), **params) -> Tuple[str, str]:
         resp = self.head.call("place", {
             "resources": dict(resources or {}),
-            "exclude": list(exclude)}, timeout=30.0)
+            "exclude": list(exclude), **params}, timeout=30.0)
         if not resp.get("ok"):
             raise RuntimeError(resp.get("error", "placement failed"))
         return resp["node_id"], resp["address"]
@@ -369,10 +430,15 @@ class NodeServer:
 
         bundle = loads(wire)
         self.client.ensure_args_local(bundle["args"], bundle["kwargs"])
+        # resources carries the sender's full resolved demand (CPU
+        # included), so num_cpus=0 avoids re-adding the default CPU:1.
         opts = TaskOptions(num_returns=bundle["num_returns"],
-                           max_retries=0, name=bundle.get("name"))
+                           max_retries=0, name=bundle.get("name"),
+                           num_cpus=0,
+                           resources=dict(bundle.get("resources") or {}))
         refs = self.runtime.submit_task(
-            bundle["function"], bundle["args"], bundle["kwargs"], opts)
+            bundle["function"], bundle["args"], bundle["kwargs"], opts,
+            local_only=True)
         return self._collect(refs, bundle["num_returns"])
 
     def _create_actor(self, wire):

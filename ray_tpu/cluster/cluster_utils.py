@@ -28,12 +28,13 @@ class Cluster:
     def add_node(self, *, num_cpus: float = 1.0,
                  resources: Optional[Dict[str, float]] = None,
                  name: str = "", wait: bool = True,
+                 labels: Optional[Dict[str, str]] = None,
                  env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
         from ..core.node import start_worker_process, wait_for_nodes
 
         proc = start_worker_process(
             self.head_address, num_cpus=num_cpus, resources=resources,
-            node_name=name, env=env)
+            node_name=name, labels=labels, env=env)
         self._procs.append(proc)
         if wait:
             # Target = worker processes still running (killed nodes in

@@ -25,9 +25,13 @@ from .rpc import RpcServer
 _DEAD_AFTER_S = 10.0  # heartbeats missed before a node is declared dead
 
 
+_RESERVATION_TTL_S = 2.5  # ≥ 2 heartbeats: by then the placed task is
+# either reflected in the node's reported availability or it never ran
+
+
 class NodeEntry:
     __slots__ = ("node_id", "address", "total", "available",
-                 "last_heartbeat", "alive", "labels")
+                 "last_heartbeat", "alive", "labels", "reserved")
 
     def __init__(self, node_id: str, address: str,
                  total: Dict[str, float], labels: Dict[str, str]):
@@ -38,6 +42,24 @@ class NodeEntry:
         self.last_heartbeat = time.monotonic()
         self.alive = True
         self.labels = labels
+        # Placement debits not yet visible in a heartbeat:
+        # [(expiry, demand)].  Heartbeats report ground truth but lag;
+        # without this, two rapid placements both see the same
+        # availability and oversubscribe a node.
+        self.reserved: List[Tuple[float, Dict[str, float]]] = []
+
+    def effective_available(self) -> Dict[str, float]:
+        now = time.monotonic()
+        self.reserved = [(t, d) for t, d in self.reserved if t > now]
+        out = dict(self.available)
+        for _t, demand in self.reserved:
+            for k, v in demand.items():
+                out[k] = out.get(k, 0.0) - v
+        return out
+
+    def reserve(self, demand: Dict[str, float]):
+        self.reserved.append(
+            (time.monotonic() + _RESERVATION_TTL_S, dict(demand)))
 
 
 class HeadServer:
@@ -50,6 +72,7 @@ class HeadServer:
         self._kv: Dict[Tuple[str, str], Any] = {}
         # pg_id -> {bundles: [...], nodes: [node_id per bundle]}
         self._pgs: Dict[str, Dict[str, Any]] = {}
+        self._spread_rr = 0
         self._server = RpcServer({
             "register_node": self._register_node,
             "heartbeat": self._heartbeat,
@@ -146,30 +169,89 @@ class HeadServer:
 
     # ---------------------------------------------------------- placement
     def _place(self, p):
-        """Pick a node whose TOTAL resources fit the demand; prefer the
-        one with the most available (hybrid-lite: the caller already
-        preferred itself if it fit locally)."""
+        """Cluster scheduling policy (reference:
+        raylet/scheduling/policy/* — hybrid, spread, node-affinity,
+        node-label).  Parameters:
+
+        - ``resources``: the demand.
+        - ``strategy``: "default" (max current headroom) or "spread"
+          (round-robin over fitting nodes).
+        - ``available_only``: only nodes whose CURRENT (heartbeat −
+          reservations) availability fits qualify — used by callers
+          spilling load off a saturated node, where queueing on a busy
+          peer would be worse than queueing locally.
+        - ``affinity_node_id`` / ``affinity_soft``: NodeAffinity; hard
+          affinity fails if the node is dead or misses the demand.
+        - ``label_hard`` / ``label_soft``: NodeLabel filters.
+        Placements debit a TTL'd reservation so rapid successive calls
+        don't oversubscribe one node between heartbeats."""
         demand: Dict[str, float] = p["resources"]
         exclude = set(p.get("exclude", ()))
+        strategy = p.get("strategy", "default")
+        available_only = p.get("available_only", False)
+        affinity = p.get("affinity_node_id")
         with self._lock:
+            if affinity is not None:
+                e = self._nodes.get(affinity)
+                if (e is not None and e.alive
+                        and e.node_id not in exclude
+                        and all(e.total.get(k, 0) >= v
+                                for k, v in demand.items())):
+                    e.reserve(demand)
+                    return {"ok": True, "node_id": e.node_id,
+                            "address": e.address}
+                if not p.get("affinity_soft", False):
+                    return {"ok": False,
+                            "error": f"node affinity target "
+                                     f"{str(affinity)[:8]} is dead, "
+                                     f"excluded, or cannot fit {demand}"}
+                # Soft affinity: fall through to the default choice.
             candidates = [
                 e for e in self._nodes.values()
                 if e.alive and e.node_id not in exclude
                 and all(e.total.get(k, 0) >= v for k, v in demand.items())
             ]
+            hard = p.get("label_hard") or {}
+            if hard:
+                candidates = [
+                    e for e in candidates
+                    if all(e.labels.get(k) == v for k, v in hard.items())]
+            soft = p.get("label_soft") or {}
+            if soft:
+                preferred = [
+                    e for e in candidates
+                    if all(e.labels.get(k) == v for k, v in soft.items())]
+                if preferred:
+                    candidates = preferred
+            # One effective-availability snapshot per candidate, shared
+            # by the filter and the headroom ranking below.
+            avail = {e.node_id: e.effective_available()
+                     for e in candidates}
+            if available_only:
+                candidates = [
+                    e for e in candidates
+                    if all(avail[e.node_id].get(k, 0) >= v
+                           for k, v in demand.items())]
             if not candidates:
-                return {"ok": False,
+                return {"ok": False, "available_only": available_only,
                         "error": f"no node can fit {demand} "
-                                 f"(nodes: {[ (e.node_id[:8], e.total) for e in self._nodes.values()]})"}
+                                 f"(available_only={available_only}, "
+                                 f"nodes: {[(e.node_id[:8], e.total) for e in self._nodes.values()]})"}
 
-            def headroom(e: NodeEntry) -> float:
-                return min((e.available.get(k, 0) - v
-                            for k, v in demand.items()), default=0)
+            if strategy == "spread":
+                # Round-robin over the fitting nodes in stable order
+                # (reference: spread_scheduling_policy).
+                candidates.sort(key=lambda e: e.node_id)
+                best = candidates[self._spread_rr % len(candidates)]
+                self._spread_rr += 1
+            else:
+                def headroom(e: NodeEntry) -> float:
+                    a = avail[e.node_id]
+                    return min((a.get(k, 0) - v
+                                for k, v in demand.items()), default=0)
 
-            best = max(candidates, key=headroom)
-            # Optimistic debit until the next heartbeat refreshes truth.
-            for k, v in demand.items():
-                best.available[k] = best.available.get(k, 0) - v
+                best = max(candidates, key=headroom)
+            best.reserve(demand)
         return {"ok": True, "node_id": best.node_id,
                 "address": best.address}
 

@@ -24,15 +24,17 @@ def main(argv=None) -> int:
     ap.add_argument("--num-cpus", type=float, default=None)
     ap.add_argument("--resources", type=str, default="")
     ap.add_argument("--name", type=str, default="")
+    ap.add_argument("--labels", type=str, default="")
     args = ap.parse_args(argv)
 
     import ray_tpu
     from ray_tpu.core.node import connect_to_cluster
 
     resources = json.loads(args.resources) if args.resources else None
+    labels = json.loads(args.labels) if args.labels else None
     rt = connect_to_cluster(
         args.head, num_cpus=args.num_cpus, resources=resources,
-        node_name=args.name)
+        node_name=args.name, labels=labels)
     print(f"ray_tpu worker node {rt.node_id.hex()[:12]} "
           f"@ {rt.address} (head {args.head})", flush=True)
 

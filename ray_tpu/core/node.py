@@ -73,6 +73,7 @@ def start_worker_process(head_address: str, *,
                          num_cpus: Optional[float] = None,
                          resources: Optional[Dict[str, float]] = None,
                          node_name: str = "",
+                         labels: Optional[Dict[str, str]] = None,
                          env: Optional[Dict[str, str]] = None,
                          force_cpu_platform: bool = True
                          ) -> subprocess.Popen:
@@ -88,6 +89,8 @@ def start_worker_process(head_address: str, *,
         cmd += ["--resources", json.dumps(resources)]
     if node_name:
         cmd += ["--name", node_name]
+    if labels:
+        cmd += ["--labels", json.dumps(labels)]
     child_env = dict(os.environ)
     if force_cpu_platform:
         child_env.setdefault("JAX_PLATFORMS", "cpu")

@@ -31,6 +31,11 @@ class ResourceSet:
     def can_ever_fit(self, demand: Dict[str, float]) -> bool:
         return all(self._total.get(k, 0.0) >= v for k, v in demand.items())
 
+    def fits_now(self, demand: Dict[str, float]) -> bool:
+        with self._cond:
+            return all(self._available.get(k, 0.0) >= v - 1e-9
+                       for k, v in demand.items())
+
     def try_acquire(self, demand: Dict[str, float]) -> bool:
         with self._cond:
             if all(self._available.get(k, 0.0) >= v - 1e-9

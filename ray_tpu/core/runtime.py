@@ -35,7 +35,8 @@ from .runtime_context import RuntimeContext, TaskContext
 from .scheduler import LocalScheduler
 from .streaming import StreamingGeneratorManager
 from .task_manager import TaskManager
-from .task_spec import (STREAMING, FunctionDescriptor, TaskOptions, TaskSpec)
+from .task_spec import (STREAMING, FunctionDescriptor, TaskOptions,
+                        TaskSpec, normalize_strategy)
 from ..exceptions import TaskCancelledError, TaskError
 
 _global_lock = threading.Lock()
@@ -188,16 +189,23 @@ class Runtime:
             resources=options.resource_demand(),
             max_retries=options.max_retries,
             retry_exceptions=options.retry_exceptions,
-            scheduling_strategy=options.scheduling_strategy,
+            scheduling_strategy=normalize_strategy(
+                options.scheduling_strategy),
             name=options.name,
             parent_task_id=parent,
             return_ids=return_ids,
         )
 
-    def submit_task(self, function, args, kwargs, options: TaskOptions):
+    def submit_task(self, function, args, kwargs, options: TaskOptions,
+                    local_only: bool = False):
+        """``local_only``: run on this node's scheduler unconditionally —
+        used by the node server for tasks PUSHED here by a peer's
+        placement decision, which must not re-enter cluster routing
+        (a pushed hard-affinity task re-spilled elsewhere would violate
+        its placement; a spill bounce could ping-pong)."""
         spec = self.make_task_spec(function, args, kwargs, options)
         self._apply_pg_strategy(spec)
-        self._register_and_submit(spec)
+        self._register_and_submit(spec, local_only=local_only)
         return self._refs_for(spec)
 
     def resubmit_task(self, spec: TaskSpec):
@@ -229,7 +237,8 @@ class Runtime:
         else:
             self._dispatch(spec)
 
-    def _register_and_submit(self, spec: TaskSpec):
+    def _register_and_submit(self, spec: TaskSpec,
+                             local_only: bool = False):
         self.task_manager.register_pending(spec)
         arg_ids = [a.object_id() for a in spec.args
                    if isinstance(a, ObjectRef)]
@@ -238,20 +247,55 @@ class Runtime:
         self.reference_counter.add_submitted_task_references(arg_ids)
         if spec.num_returns == STREAMING:
             self.streaming_manager.create_stream(spec.return_ids[0])
-        self._dispatch(spec)
+        if local_only:
+            self.scheduler.submit(spec)
+        else:
+            self._dispatch(spec)
 
     def _dispatch(self, spec: TaskSpec):
-        """Route a plain task: local scheduler if this node can ever
-        satisfy it, otherwise cluster placement (hybrid-lite — the
-        reference prefers local until packed, cluster_task_manager.cc:159;
-        streaming tasks stay local, cross-process generator reporting
-        comes with the object-plane round)."""
-        if (self.cluster is not None
-                and spec.num_returns != STREAMING
-                and not self.node_resources.can_ever_fit(spec.resources)):
-            self.cluster.submit_remote_task(spec)
-        else:
+        """Route a plain task (reference hybrid policy: prefer local
+        until packed, then spill — cluster_task_manager.cc:159, policies
+        under raylet/scheduling/policy/).
+
+        - No cluster / streaming task → local scheduler (cross-process
+          generator reporting comes with the object-plane round).
+        - Spread / NodeAffinity / NodeLabel strategies → cluster
+          placement (the head implements the policy; affinity to this
+          node comes straight back to us).
+        - Default: local when it can run here now; a task this node
+          could never fit goes to the head unconditionally; a task that
+          fits here *eventually* is first offered to a peer with
+          current headroom and queues locally only if none has any.
+        """
+        from .task_spec import (NodeAffinitySchedulingStrategy,
+                                NodeLabelSchedulingStrategy,
+                                SpreadSchedulingStrategy)
+
+        if self.cluster is None or spec.num_returns == STREAMING:
             self.scheduler.submit(spec)
+            return
+        strat = spec.scheduling_strategy
+        if (isinstance(strat, NodeAffinitySchedulingStrategy)
+                and strat.node_id == self.node_id.hex()
+                and self.node_resources.can_ever_fit(spec.resources)):
+            self.scheduler.submit(spec)
+            return
+        if isinstance(strat, (SpreadSchedulingStrategy,
+                              NodeAffinitySchedulingStrategy,
+                              NodeLabelSchedulingStrategy)):
+            self.cluster.submit_remote_task(spec)
+            return
+        if not self.node_resources.can_ever_fit(spec.resources):
+            self.cluster.submit_remote_task(spec)
+            return
+        # Saturated = no free resources now OR a backlog already queued
+        # (fits_now alone misses a submission burst whose tasks haven't
+        # been picked up by the dispatch thread yet).
+        saturated = (not self.node_resources.fits_now(spec.resources)
+                     or self.scheduler.backlog() > 0)
+        if saturated and self.cluster.try_spill_task(spec):
+            return
+        self.scheduler.submit(spec)
 
     def _refs_for(self, spec: TaskSpec):
         if spec.num_returns == STREAMING:

@@ -74,6 +74,13 @@ class LocalScheduler:
         self._dispatcher.start()
 
     # -- submission ----------------------------------------------------------
+    def backlog(self) -> int:
+        """Tasks queued but not yet running (resources not acquired) —
+        the cluster dispatcher consults this: available-resource checks
+        alone don't see a submission burst still sitting in the queue."""
+        with self._lock:
+            return len(self._ready)
+
     def submit(self, spec: TaskSpec):
         entry = _Entry(spec, dict(spec.resources))
         if not self._resources.can_ever_fit(entry.demand):

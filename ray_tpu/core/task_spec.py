@@ -74,6 +74,22 @@ SchedulingStrategy = Union[
     NodeLabelSchedulingStrategy, str, None,
 ]
 
+
+def normalize_strategy(strategy: SchedulingStrategy) -> SchedulingStrategy:
+    """Map the string spellings the reference API accepts
+    ("SPREAD"/"DEFAULT", util/scheduling_strategies.py) onto the
+    dataclass forms the dispatchers match on."""
+    if isinstance(strategy, str):
+        name = strategy.upper()
+        if name == "SPREAD":
+            return SpreadSchedulingStrategy()
+        if name == "DEFAULT":
+            return None
+        raise ValueError(
+            f"unknown scheduling_strategy string {strategy!r} "
+            "(expected 'DEFAULT' or 'SPREAD')")
+    return strategy
+
 STREAMING = "streaming"
 
 

@@ -390,3 +390,82 @@ class TestRpcChaos:
         finally:
             del os.environ["RAY_TPU_TESTING_RPC_FAILURE"]
             server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Cluster scheduling policies (reference:
+# raylet/scheduling/cluster_task_manager.h:42 hybrid spill +
+# scheduling/policy/* spread / node-affinity / node-label)
+# ---------------------------------------------------------------------------
+
+@ray_tpu.remote
+def _where(delay: float = 0.0):
+    if delay:
+        time.sleep(delay)
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+class TestClusterScheduling:
+    def test_spill_when_saturated(self, cluster):
+        """Plain CPU tasks must spread beyond the driver once it is
+        saturated (round-2 verdict: N nodes gave ~0 speedup because
+        tasks went remote only when they could NEVER fit locally)."""
+        refs = [_where.remote(0.5) for _ in range(6)]
+        nodes = set(ray_tpu.get(refs, timeout=60))
+        assert len(nodes) >= 2, nodes
+
+    def test_spread_strategy(self, cluster):
+        from ray_tpu import SpreadSchedulingStrategy
+
+        alive = sum(1 for n in ray_tpu.nodes() if n["Alive"])
+        refs = [
+            _where.options(
+                scheduling_strategy=SpreadSchedulingStrategy()).remote()
+            for _ in range(2 * alive)
+        ]
+        nodes = ray_tpu.get(refs, timeout=60)
+        # Round-robin: every alive (CPU-fitting) node gets work.
+        assert len(set(nodes)) == alive, (nodes, alive)
+
+    def test_node_affinity_hard(self, cluster):
+        from ray_tpu import NodeAffinitySchedulingStrategy
+
+        target = next(n["NodeID"] for n in ray_tpu.nodes()
+                      if n["Alive"] and "worker1" in n["Resources"])
+        refs = [
+            _where.options(scheduling_strategy=(
+                NodeAffinitySchedulingStrategy(node_id=target))).remote()
+            for _ in range(3)
+        ]
+        assert set(ray_tpu.get(refs, timeout=60)) == {target}
+
+    def test_node_affinity_hard_to_missing_node_fails(self, cluster):
+        from ray_tpu import NodeAffinitySchedulingStrategy
+
+        ref = _where.options(scheduling_strategy=(
+            NodeAffinitySchedulingStrategy(node_id="f" * 32))).remote()
+        with pytest.raises(Exception, match="affinity"):
+            ray_tpu.get(ref, timeout=60)
+
+    def test_node_affinity_soft_falls_back(self, cluster):
+        from ray_tpu import NodeAffinitySchedulingStrategy
+
+        ref = _where.options(scheduling_strategy=(
+            NodeAffinitySchedulingStrategy(node_id="f" * 32,
+                                           soft=True))).remote()
+        assert ray_tpu.get(ref, timeout=60)  # ran somewhere
+
+    def test_node_label_strategy(self, cluster):
+        from ray_tpu import NodeLabelSchedulingStrategy
+
+        cluster.add_node(num_cpus=1, resources={"zlab": 1}, name="wz",
+                         labels={"zone": "z9"})
+        target = next(n["NodeID"] for n in ray_tpu.nodes()
+                      if n["Alive"] and "zlab" in n["Resources"])
+        refs = [
+            _where.options(scheduling_strategy=(
+                NodeLabelSchedulingStrategy(
+                    hard={"zone": "z9"}))).remote()
+            for _ in range(2)
+        ]
+        assert set(ray_tpu.get(refs, timeout=60)) == {target}
