@@ -36,6 +36,41 @@ def _peak_bf16_flops(device_kind: str):
     return None
 
 
+def _serve_bench(n_requests: int = 32) -> dict:
+    """Continuous-batched 125M decode: concurrent requests through the
+    serve handle; returns req/s, p50 TTFT, decode tok/s."""
+    import numpy as np
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMServer
+
+    handle = serve.run(serve.deployment(LLMServer).bind(
+        model_preset="llama_125m", max_slots=16, max_len=256,
+        prefill_buckets=(32,), decode_chunk=16))
+    try:
+        rng = np.random.default_rng(0)
+
+        def req():
+            return {"prompt": rng.integers(1, 32000, 24).tolist(),
+                    "max_new_tokens": 32}
+
+        handle.generate.remote(req()).result(timeout=600)  # compile
+        t0 = time.perf_counter()
+        outs = [r.result(timeout=600) for r in
+                [handle.generate.remote(req())
+                 for _ in range(n_requests)]]
+        dt = time.perf_counter() - t0
+    finally:
+        serve.shutdown()
+    ttfts = sorted(o["ttft_ms"] for o in outs)
+    return {
+        "serve_req_per_s": round(n_requests / dt, 2),
+        "serve_p50_ttft_ms": round(ttfts[len(ttfts) // 2], 1),
+        "serve_decode_tok_per_s": round(
+            sum(len(o["tokens"]) for o in outs) / dt, 1),
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -111,6 +146,17 @@ def main():
     }
     if mfu_denom and on_tpu:
         extra["mfu"] = round(tps * flops_per_tok / mfu_denom, 4)
+
+    if on_tpu:
+        # Serve north-star (BASELINE.md): req/s + p50 TTFT from the
+        # continuous-batched decode deployment, on the same chip after
+        # the train state is freed.  Failures must not cost the train
+        # metric.
+        del state
+        try:
+            extra.update(_serve_bench())
+        except Exception as e:  # noqa: BLE001
+            extra["serve_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

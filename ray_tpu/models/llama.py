@@ -281,14 +281,14 @@ def _get_attention_fn(impl: str) -> Callable:
     raise ValueError(f"unknown attention_impl {impl!r}")
 
 
-def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
-                  sin: jax.Array, cos: jax.Array, positions: jax.Array,
-                  config: LlamaConfig,
-                  attention_fn: Callable) -> jax.Array:
+def _qkv_rope(x: jax.Array, layer: Dict[str, jax.Array], sin, cos,
+              config: LlamaConfig):
+    """Shared by the training forward and the KV-cache decode path —
+    the conventions here (f32 MXU accumulation via matmul, bf16 rope)
+    must stay identical across both."""
     c = config
-    B, S, E = x.shape
+    B, S, _ = x.shape
     dt = c.dtype
-
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
     q = matmul(h, layer["wq"].astype(dt)).reshape(B, S, c.n_heads,
                                                   c.head_dim)
@@ -300,11 +300,19 @@ def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
     k = apply_rope(k, sin, cos)
     q = with_logical_constraint(q, "batch", "seq", "heads", "head_dim")
     k = with_logical_constraint(k, "batch", "seq", "kv_heads", "head_dim")
-    attn = attention_fn(q, k, v, positions)
-    attn = attn.reshape(B, S, c.q_dim)
-    x = x + matmul(attn, layer["wo"].astype(dt))
-    x = with_logical_constraint(x, "batch", "seq", None)
+    return q, k, v
 
+
+def _attn_out_mlp(x: jax.Array, attn: jax.Array,
+                  layer: Dict[str, jax.Array],
+                  config: LlamaConfig) -> jax.Array:
+    """Output projection + MLP half of the block (shared, see
+    _qkv_rope).  Constraints are no-ops outside a mesh."""
+    c = config
+    B, S, _ = x.shape
+    dt = c.dtype
+    x = x + matmul(attn.reshape(B, S, c.q_dim), layer["wo"].astype(dt))
+    x = with_logical_constraint(x, "batch", "seq", None)
     h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
     gate = matmul(h, layer["w_gate"].astype(dt))
     up = matmul(h, layer["w_up"].astype(dt))
@@ -312,6 +320,15 @@ def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
     ff = with_logical_constraint(ff, "batch", "seq", "mlp")
     x = x + matmul(ff, layer["w_down"].astype(dt))
     return with_logical_constraint(x, "batch", "seq", None)
+
+
+def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
+                  sin: jax.Array, cos: jax.Array, positions: jax.Array,
+                  config: LlamaConfig,
+                  attention_fn: Callable) -> jax.Array:
+    q, k, v = _qkv_rope(x, layer, sin, cos, config)
+    attn = attention_fn(q, k, v, positions)
+    return _attn_out_mlp(x, attn, layer, config)
 
 
 # ---------------------------------------------------------------------------
@@ -446,3 +463,90 @@ def make_train_step(config: LlamaConfig, optimizer=None,
                            "step": new_state["step"]}
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode (serving path)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
+                  dtype: Any = None) -> Dict[str, jax.Array]:
+    """Slot-structured KV cache for continuous batching: (L, B, S, Hkv,
+    D) per tensor.  The serve replica owns one cache and admits
+    requests into free batch slots (reference has no TPU decode loop to
+    mirror; design follows the fixed-shape constraint of jit: cache
+    shape and batch are static, per-slot positions are data)."""
+    c = config
+    dt = dtype or c.dtype
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cache_attend(q, ck, cv, q_positions, scale):
+    """q: (B, T, Hq, D); ck/cv: (B, S, Hkv, D); q_positions: (B, T).
+    Causal against absolute cache positions: key j visible to query at
+    position p iff j <= p."""
+    B, T, Hq, D = q.shape
+    S = ck.shape[1]
+    Hkv = ck.shape[2]
+    group = Hq // Hkv
+    qg = q.reshape(B, T, Hkv, group, D)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck,
+                        preferred_element_type=jnp.float32) * scale
+    key_pos = jnp.arange(S, dtype=jnp.int32)
+    mask = key_pos[None, None, None, None, :] <= \
+        q_positions[:, None, None, :, None]
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv,
+                     preferred_element_type=jnp.float32).astype(cv.dtype)
+    return out.reshape(B, T, Hq, D)
+
+
+def forward_with_cache(params: PyTree, tokens: jax.Array,
+                       positions: jax.Array, cache: Dict[str, jax.Array],
+                       config: LlamaConfig
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run T new tokens per slot against the cache.
+
+    tokens: (B, T) int32; positions: (B, T) absolute positions (a
+    slot's current length .. +T-1).  Writes the new K/V into the cache
+    at those positions and returns (logits (B, T, V), new_cache).
+    T=prompt_bucket for prefill, T=1 for decode — each T compiles
+    once."""
+    c = config
+    B, T = tokens.shape
+    dt = c.dtype
+    x = params["embed_tokens"].astype(dt)[tokens]
+    sin, cos = rope_table(positions, c.head_dim, c.rope_theta)
+    scale = c.head_dim ** -0.5
+
+    def body(x, layer_and_cache):
+        layer, ck_l, cv_l = layer_and_cache
+        q, k, v = _qkv_rope(x, layer, sin, cos, c)
+
+        # Scatter the T new K/V rows into each slot's cache at its own
+        # positions (per-slot write offsets = data, shapes static).
+        def write(cache_bslice, rows, pos0):
+            return jax.lax.dynamic_update_slice(
+                cache_bslice, rows, (pos0, jnp.int32(0), jnp.int32(0)))
+
+        pos0 = positions[:, 0]
+        ck_l = jax.vmap(write)(ck_l, k.astype(ck_l.dtype), pos0)
+        cv_l = jax.vmap(write)(cv_l, v.astype(cv_l.dtype), pos0)
+
+        attn = _cache_attend(q, ck_l, cv_l, positions, scale)
+        x = _attn_out_mlp(x, attn, layer, c)
+        return x, (ck_l, cv_l)
+
+    def scan_body(x, inputs):
+        x, new_cache = body(x, inputs)
+        return x, new_cache
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    head = (params["embed_tokens"].astype(dt).T if c.tie_embeddings
+            else params["lm_head"].astype(dt))
+    logits = matmul(x, head)
+    return logits, {"k": new_k, "v": new_v}

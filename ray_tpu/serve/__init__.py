@@ -1,0 +1,168 @@
+"""ray_tpu.serve — model serving on the ray_tpu runtime.
+
+Reference: python/ray/serve (74.4k LoC).  MVP of the same shape:
+``@serve.deployment`` → ``serve.run`` starts a controller actor that
+creates replica actors; ``DeploymentHandle`` routes with
+power-of-two-choices; ``@serve.batch`` coalesces requests inside a
+replica; an optional stdlib HTTP proxy serves ``POST /<name>``;
+``ray_tpu.serve.llm`` adds a continuous-batched TPU decode deployment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .batching import batch
+from .handle import DeploymentHandle, DeploymentResponse
+
+_CONTROLLER_NAME = "serve_controller"
+
+
+class Application:
+    def __init__(self, deployment: "Deployment", init_args: Tuple,
+                 init_kwargs: Dict[str, Any]):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+
+class Deployment:
+    """Result of ``@serve.deployment`` (reference: serve/api.py:246)."""
+
+    def __init__(self, callable_def, name: str,
+                 config: Optional[Dict[str, Any]] = None):
+        self._callable = callable_def
+        self.name = name
+        self._config = config or {}
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                user_config: Any = None,
+                ray_actor_options: Optional[dict] = None) -> "Deployment":
+        cfg = dict(self._config)
+        for k, v in (("num_replicas", num_replicas),
+                     ("max_ongoing_requests", max_ongoing_requests),
+                     ("user_config", user_config),
+                     ("ray_actor_options", ray_actor_options)):
+            if v is not None:
+                cfg[k] = v
+        return Deployment(self._callable, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "deployments are not called directly — use "
+            "serve.run(D.bind(...)) and handle.remote(...)")
+
+
+def deployment(_callable=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 100,
+               user_config: Any = None,
+               ray_actor_options: Optional[dict] = None):
+    """``@serve.deployment`` decorator (reference: serve/api.py:246)."""
+
+    def deco(cd):
+        return Deployment(cd, name or cd.__name__, {
+            "num_replicas": num_replicas,
+            "max_ongoing_requests": max_ongoing_requests,
+            "user_config": user_config,
+            "ray_actor_options": ray_actor_options,
+        })
+
+    if _callable is not None:
+        return deco(_callable)
+    return deco
+
+
+# --------------------------------------------------------------------------
+# Control-plane client
+# --------------------------------------------------------------------------
+def _get_controller(create: bool = True):
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(_CONTROLLER_NAME)
+    except Exception:
+        if not create:
+            raise
+    from .controller import ServeController
+
+    return ray_tpu.remote(ServeController).options(
+        name=_CONTROLLER_NAME, lifetime="detached").remote()
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        http_port: Optional[int] = None) -> DeploymentHandle:
+    """Deploy an application; returns its handle
+    (reference: serve.run, api.py:492)."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    dep = app.deployment if name is None else \
+        app.deployment.options(name=name)
+    controller = _get_controller()
+    ray_tpu.get(controller.deploy.remote(
+        dep.name, dep._callable, app.init_args, app.init_kwargs,
+        dep._config))
+    if dep._config.get("user_config") is not None:
+        ray_tpu.get(controller.reconfigure.remote(
+            dep.name, dep._config["user_config"]))
+    handle = get_deployment_handle(dep.name)
+    if http_port is not None:
+        from . import http_proxy
+
+        handles = dict(http_proxy.proxy_handles() or {})
+        handles[dep.name] = handle
+        port = http_proxy.start_proxy(handles, port=http_port)
+        handle.http_port = port
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    import ray_tpu
+
+    controller = _get_controller(create=False)
+    replicas = ray_tpu.get(controller.get_replicas.remote(name))
+    return DeploymentHandle(name, replicas)
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    controller = _get_controller(create=False)
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(name: str):
+    import ray_tpu
+
+    controller = _get_controller(create=False)
+    return ray_tpu.get(controller.delete.remote(name))
+
+
+def shutdown():
+    import ray_tpu
+
+    from . import http_proxy
+
+    http_proxy.stop_proxy()
+    try:
+        controller = _get_controller(create=False)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+
+
+__all__ = [
+    "Application", "Deployment", "DeploymentHandle",
+    "DeploymentResponse", "batch", "delete", "deployment",
+    "get_deployment_handle", "run", "shutdown", "status",
+]

@@ -1,0 +1,97 @@
+"""Dynamic request batching.
+
+Reference: serve/batching.py:80,597 — ``@serve.batch`` queues single
+calls inside the replica and invokes the wrapped function with a list
+once ``max_batch_size`` is reached or ``batch_wait_timeout_s`` expires.
+Runs on the replica's asyncio loop (async actors), so waiting requests
+don't block the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self._pending: List[tuple] = []  # (arg, future)
+        self._flush_task: Optional[asyncio.Task] = None
+
+    async def submit(self, instance, arg):
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((arg, fut))
+        if len(self._pending) >= self.max_batch_size:
+            await self._flush(instance)
+        elif self._flush_task is None or self._flush_task.done():
+            self._flush_task = loop.create_task(
+                self._flush_after_timeout(instance))
+        return await fut
+
+    async def _flush_after_timeout(self, instance):
+        await asyncio.sleep(self.timeout_s)
+        await self._flush(instance)
+
+    async def _flush(self, instance):
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        args = [a for a, _f in batch]
+        futs = [f for _a, f in batch]
+        try:
+            if instance is not None:
+                results = await self.fn(instance, args)
+            else:
+                results = await self.fn(args)
+            if len(results) != len(args):
+                raise RuntimeError(
+                    f"@serve.batch function returned {len(results)} "
+                    f"results for a batch of {len(args)}")
+            for f, r in zip(futs, results):
+                if not f.done():
+                    f.set_result(r)
+        except BaseException as e:  # noqa: BLE001 — fail each waiter
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch`` — the wrapped coroutine receives a LIST of the
+    single-call arguments and must return a list of equal length."""
+
+    def deco(fn: Callable):
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async function")
+        queues: dict = {}  # instance id -> _BatchQueue
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:          # bound method: (self, arg)
+                instance, arg = args
+                key = id(instance)
+            elif len(args) == 1:        # free function: (arg,)
+                instance, arg = None, args[0]
+                key = 0
+            else:
+                raise TypeError(
+                    "@serve.batch methods take exactly one argument")
+            q = queues.get(key)
+            if q is None:
+                q = queues[key] = _BatchQueue(
+                    fn, max_batch_size, batch_wait_timeout_s)
+            return await q.submit(instance, arg)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _func is not None:
+        return deco(_func)
+    return deco

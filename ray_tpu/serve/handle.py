@@ -1,0 +1,111 @@
+"""Deployment handles + the power-of-two-choices router.
+
+Reference: serve/_private/handle.py:619 (``DeploymentHandle``) →
+router.py:334/:559 (``AsyncioRouter.assign_request``) →
+replica_scheduler/pow_2_scheduler.py:52 (power-of-two-choices over
+replica queue lengths).  The reference probes replicas over RPC; here
+the handle tracks its own outstanding count per replica (what the
+reference uses as its first-tier signal) — with single-digit
+millisecond actor calls, client-local counts converge on the same
+balance without probe round-trips.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class DeploymentResponse:
+    """Future-like result of ``handle.remote()`` (reference:
+    handle.py:326)."""
+
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._settle()
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, replicas: List[Any],
+                 method_name: str = ""):
+        self.deployment_name = deployment_name
+        self._replicas = list(replicas)
+        self._method = method_name
+        self._lock = threading.Lock()
+        self._outstanding: Dict[int, int] = {
+            i: 0 for i in range(len(self._replicas))}
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self) -> int:
+        """Power-of-two-choices on outstanding counts."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self._outstanding[a] <= self._outstanding[b] \
+                    else b
+            self._outstanding[idx] += 1
+            return idx
+
+    def _release(self, idx: int):
+        with self._lock:
+            self._outstanding[idx] -= 1
+
+    # -- calls -------------------------------------------------------------
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        idx = self._pick()
+        actor = self._replicas[idx]
+        ref = actor.handle_request.remote(self._method, args, kwargs)
+        resp = DeploymentResponse(ref, on_done=lambda: self._release(idx))
+        # Release the slot when the result lands even if .result() is
+        # never called (completion callback keeps counts truthful).
+        ref._on_completed(lambda _o: resp._settle())
+        return resp
+
+    def options(self, *, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self._replicas,
+                             method_name if method_name is not None
+                             else self._method)
+        # Share the outstanding-count table so balance is global across
+        # method-scoped views of the same handle.
+        h._outstanding = self._outstanding
+        h._lock = self._lock
+        return h
+
+    @property
+    def method(self):
+        class _MethodProxy:
+            def __init__(proxy, handle):
+                proxy._handle = handle
+
+            def __getattr__(proxy, name):
+                return proxy._handle.options(method_name=name)
+
+        return _MethodProxy(self)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)

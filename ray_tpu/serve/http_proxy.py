@@ -1,0 +1,82 @@
+"""HTTP ingress proxy.
+
+Reference: serve/_private/proxy.py:538,759 — ASGI proxy actors route
+HTTP to deployment handles.  TPU-first MVP: a stdlib
+ThreadingHTTPServer in the driver process (no asgi/uvicorn
+dependencies); ``POST /<deployment>`` with a JSON body calls the
+deployment and returns the JSON-encoded result.  Each request thread
+blocks on its own DeploymentResponse, so concurrency = server threads.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+
+class _Proxy:
+    def __init__(self, host: str, port: int, handles: Dict[str, object]):
+        self.handles = handles
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[0]
+                handle = proxy.handles.get(name)
+                if handle is None:
+                    self.send_error(404, f"no deployment {name!r}")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    payload = json.loads(raw) if raw else None
+                    result = handle.remote(payload).result(timeout=60.0)
+                    body = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001 — 500 w/ message
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+_proxy: Optional[_Proxy] = None
+
+
+def start_proxy(handles: Dict[str, object], host: str = "127.0.0.1",
+                port: int = 0) -> int:
+    global _proxy
+    stop_proxy()
+    _proxy = _Proxy(host, port, handles)
+    return _proxy.port
+
+
+def proxy_handles() -> Optional[Dict[str, object]]:
+    return _proxy.handles if _proxy else None
+
+
+def stop_proxy():
+    global _proxy
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None

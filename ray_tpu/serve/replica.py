@@ -1,0 +1,69 @@
+"""Replica actor: hosts one copy of the user's deployment callable.
+
+Reference: serve/_private/replica.py:750,807,998 — ``ReplicaActor``
+wraps the user class/function in a ``UserCallableWrapper`` running on
+an asyncio loop; requests arrive as actor calls.  Same shape here: the
+replica is an async ray_tpu actor (the actor runtime gives async
+classes an asyncio loop + high max_concurrency), so ``@serve.batch``
+methods can queue and flush batches while other requests await.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class Replica:
+    """User-code host.  Created by the controller via
+    ``ray_tpu.remote(Replica).options(...)``."""
+
+    def __init__(self, deployment_name: str, callable_def,
+                 init_args: Tuple, init_kwargs: Dict[str, Any]):
+        self._deployment = deployment_name
+        if inspect.isclass(callable_def):
+            self._instance = callable_def(*init_args, **init_kwargs)
+        else:
+            if init_args or init_kwargs:
+                raise TypeError(
+                    "function deployments take no init args")
+            self._instance = callable_def
+        self._num_ongoing = 0
+
+    async def handle_request(self, method: str, args: Tuple,
+                             kwargs: Dict[str, Any]):
+        self._num_ongoing += 1
+        try:
+            if method:
+                fn = getattr(self._instance, method)
+            else:
+                fn = self._instance  # __call__ or plain function
+            out = fn(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            return out
+        finally:
+            self._num_ongoing -= 1
+
+    async def num_ongoing_requests(self) -> int:
+        """Queue-length probe (reference: pow-2 scheduler probes
+        replicas for their ongoing count, pow_2_scheduler.py:52)."""
+        return self._num_ongoing
+
+    async def reconfigure(self, user_config):
+        """Reference: lightweight config updates without restart
+        (deployment_state.py version diffing)."""
+        fn = getattr(self._instance, "reconfigure", None)
+        if fn is not None:
+            out = fn(user_config)
+            if inspect.isawaitable(out):
+                await out
+
+    async def health_check(self) -> bool:
+        fn = getattr(self._instance, "check_health", None)
+        if fn is None:
+            return True
+        out = fn()
+        if inspect.isawaitable(out):
+            out = await out
+        return bool(out) if out is not None else True
