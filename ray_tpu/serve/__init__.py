@@ -112,10 +112,18 @@ def run(app: Application, *, name: Optional[str] = None,
         ray_tpu.get(controller.reconfigure.remote(
             dep.name, dep._config["user_config"]))
     handle = get_deployment_handle(dep.name)
-    if http_port is not None:
-        from . import http_proxy
+    from . import http_proxy
 
-        handles = dict(http_proxy.proxy_handles() or {})
+    live = http_proxy.proxy_handles()
+    if live is not None:
+        # A redeploy replaced the replicas; refresh the running
+        # proxy's handle in place so HTTP traffic follows.  (Handles
+        # users kept from before a redeploy must be re-fetched with
+        # get_deployment_handle — reference handles refresh via
+        # long-poll, not implemented here.)
+        live[dep.name] = handle
+    if http_port is not None:
+        handles = dict(live or {})
         handles[dep.name] = handle
         port = http_proxy.start_proxy(handles, port=http_port)
         handle.http_port = port

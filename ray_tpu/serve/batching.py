@@ -35,10 +35,21 @@ class _BatchQueue:
         return await fut
 
     async def _flush_after_timeout(self, instance):
-        await asyncio.sleep(self.timeout_s)
+        try:
+            await asyncio.sleep(self.timeout_s)
+        except asyncio.CancelledError:
+            return
         await self._flush(instance)
 
     async def _flush(self, instance):
+        # A size-triggered flush must cancel the pending timer, or the
+        # stale timer fires early into the NEXT batch's coalescing
+        # window and collapses batch sizes under steady load.
+        task = self._flush_task
+        self._flush_task = None
+        if task is not None and task is not asyncio.current_task() \
+                and not task.done():
+            task.cancel()
         if not self._pending:
             return
         batch, self._pending = self._pending, []

@@ -25,8 +25,14 @@ class ServeController:
 
         from .replica import Replica
 
-        existing = self._deployments.get(name)
+        existing = self._deployments.pop(name, None)
         version = (existing["version"] + 1) if existing else 1
+        if existing:
+            # Old replicas go down BEFORE new ones come up: a rolling
+            # overlap deadlocks when replicas hold exclusive resources
+            # (e.g. the one TPU) that the new version needs to
+            # initialize.  Brief downtime is the MVP trade.
+            self._stop_replicas(existing["replicas"])
         num = max(1, int(config.get("num_replicas", 1)))
         ray_actor_options = config.get("ray_actor_options") or {}
         replicas = []
@@ -44,12 +50,6 @@ class ServeController:
         # deployment transitions HEALTHY).
         for r in replicas:
             ray_tpu.get(r.health_check.remote())
-        if existing:
-            for r in existing["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
         self._deployments[name] = {
             "config": dict(config), "replicas": replicas,
             "version": version,
@@ -81,16 +81,27 @@ class ServeController:
             ray_tpu.get(r.reconfigure.remote(user_config))
         self._deployments[name]["config"]["user_config"] = user_config
 
-    def delete(self, name: str):
+    @staticmethod
+    def _stop_replicas(replicas):
         import ray_tpu
 
+        for r in replicas:
+            # Give user code a shutdown hook first: an actor kill stops
+            # the actor's threads but not background threads the user
+            # callable started (e.g. LLMServer's scheduler).
+            try:
+                ray_tpu.get(r.shutdown_user.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def delete(self, name: str):
         d = self._deployments.pop(name, None)
         if d:
-            for r in d["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
+            self._stop_replicas(d["replicas"])
         return d is not None
 
     def shutdown(self):

@@ -29,15 +29,14 @@ class DeploymentResponse:
     def result(self, timeout: Optional[float] = None):
         import ray_tpu
 
-        try:
-            return ray_tpu.get(self._ref, timeout=timeout)
-        finally:
-            self._settle()
+        return ray_tpu.get(self._ref, timeout=timeout)
 
     def _settle(self):
-        if not self._done:
-            self._done = True
-            self._on_done()
+        # Called exactly once, from the ref's completion callback —
+        # result() must NOT settle (a timed-out result() would release
+        # the routing slot while the request still runs).
+        self._done = True
+        self._on_done()
 
     @property
     def ref(self):

@@ -148,6 +148,9 @@ class LLMServer:
         {"tokens": [...], "ttft_ms": float}."""
         import asyncio
 
+        if self._stop.is_set():
+            raise RuntimeError("LLMServer is stopped (prior device "
+                               "failure or shutdown)")
         prompt = request["prompt"]
         if not prompt:
             raise ValueError("empty prompt")
@@ -157,6 +160,10 @@ class LLMServer:
                 f"bucket {max(self.buckets)}")
         req = _Request(prompt, int(request.get("max_new_tokens", 32)))
         self._queue.put(req)
+        if self._stop.is_set() and not req.event.is_set():
+            # Raced _fatal's queue drain: fail this request ourselves.
+            req.error = RuntimeError("LLMServer stopped")
+            req.event.set()
         loop = asyncio.get_event_loop()
         await loop.run_in_executor(None, req.event.wait)
         if req.error is not None:
@@ -249,7 +256,6 @@ class LLMServer:
             req.event.set()
 
     def _loop(self):
-        jnp = self._jnp
         while not self._stop.is_set():
             try:
                 self._step()
@@ -293,6 +299,13 @@ class LLMServer:
                         or self.slot_len[slot] >= self.max_len - 1):
                     self._finish(slot)
                     break
+
+    def shutdown(self):
+        """Stop the scheduler thread and fail any waiters (the
+        replica's actor thread is separate from this thread, so actor
+        kill alone would leak it; the serve controller calls this
+        before killing the replica)."""
+        self._fatal(RuntimeError("LLMServer shut down"))
 
     def __del__(self):
         self._stop.set()

@@ -59,6 +59,15 @@ class Replica:
             if inspect.isawaitable(out):
                 await out
 
+    async def shutdown_user(self):
+        """Invoke the user callable's ``shutdown`` hook, if any (the
+        controller calls this before killing the replica actor)."""
+        fn = getattr(self._instance, "shutdown", None)
+        if fn is not None:
+            out = fn()
+            if inspect.isawaitable(out):
+                await out
+
     async def health_check(self) -> bool:
         fn = getattr(self._instance, "check_health", None)
         if fn is None:

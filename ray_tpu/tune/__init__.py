@@ -1,0 +1,249 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Reference: python/ray/tune (57.3k LoC) — Tuner (tune/tuner.py:44) →
+TuneController event loop (tune/execution/tune_controller.py:68,666)
+over trial actors; searchers + schedulers.  MVP of the same shape:
+``Tuner(fn, param_space, TuneConfig(...)).fit()`` runs trials as
+ray_tpu actors with bounded concurrency, a basic variant generator
+(grid/random) and ASHA early stopping; ``tune.report`` streams
+metrics; results come back as a ``ResultGrid``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .schedulers import CONTINUE, STOP, ASHAScheduler, FIFOScheduler
+from .search import (choice, generate_variants, grid_search, loguniform,
+                     randint, uniform)
+
+
+# --------------------------------------------------------------- session
+class _TrialSession(threading.local):
+    def __init__(self):
+        self.runner = None
+
+
+_session = _TrialSession()
+
+
+def report(metrics: Dict[str, Any]):
+    """Report one iteration's metrics from inside a trainable
+    (reference: tune.report).  Raises ``_StopTrial`` when the scheduler
+    has decided against this trial — the trainable unwinds."""
+    runner = _session.runner
+    if runner is None:
+        raise RuntimeError("tune.report() outside a trial")
+    runner._record(dict(metrics))
+
+
+class _StopTrial(Exception):
+    pass
+
+
+class _TrialRunner:
+    """Actor hosting one trial.  ``run`` executes the trainable on one
+    actor thread while ``poll``/``request_stop`` service the controller
+    on others (threaded actor, reference: tune trial actors)."""
+
+    def __init__(self, fn, config):
+        self._fn = fn
+        self._config = dict(config)
+        self._results: List[Dict[str, Any]] = []
+        self._cursor = 0
+        self._stop = False
+        self._lock = threading.Lock()
+
+    def run(self):
+        _session.runner = self
+        try:
+            self._fn(dict(self._config))
+            return {"status": "TERMINATED"}
+        except _StopTrial:
+            return {"status": "STOPPED"}
+        finally:
+            _session.runner = None
+
+    def _record(self, metrics: Dict[str, Any]):
+        with self._lock:
+            metrics.setdefault("training_iteration",
+                               len(self._results) + 1)
+            self._results.append(metrics)
+            if self._stop:
+                raise _StopTrial()
+
+    def poll(self):
+        with self._lock:
+            new = self._results[self._cursor:]
+            self._cursor = len(self._results)
+            return new
+
+    def request_stop(self):
+        with self._lock:
+            self._stop = True
+
+    def all_results(self):
+        with self._lock:
+            return list(self._results)
+
+
+# ---------------------------------------------------------------- config
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int = 0
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]          # last reported
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    status: str = "TERMINATED"
+    error: Optional[str] = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric, mode):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given to rank results")
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return (max if mode == "max" else min)(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {**r.metrics, **{f"config/{k}": v
+                             for k, v in r.config.items()},
+             "trial_id": r.trial_id, "status": r.status}
+            for r in self._results])
+
+
+# ----------------------------------------------------------------- tuner
+class Tuner:
+    """Reference: tune/tuner.py:44 + tune_controller.py:666 — the
+    controller loop launches trial actors up to the concurrency cap,
+    polls their reports, consults the scheduler, and early-stops."""
+
+    def __init__(self, trainable: Callable[[Dict[str, Any]], Any], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None):
+        if not callable(trainable):
+            raise TypeError("trainable must be a function taking config")
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._cfg = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cfg = self._cfg
+        scheduler = cfg.scheduler or FIFOScheduler()
+        if isinstance(scheduler, ASHAScheduler) and not scheduler.metric:
+            scheduler.metric = cfg.metric or ""
+            scheduler.mode = cfg.mode
+
+        configs = list(generate_variants(
+            self._param_space, cfg.num_samples, seed=cfg.seed))
+        pending = list(enumerate(configs))
+        running: Dict[str, Dict[str, Any]] = {}
+        done: List[TrialResult] = []
+        Runner = ray_tpu.remote(_TrialRunner)
+
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                idx, config = pending.pop(0)
+                trial_id = f"trial_{idx:05d}"
+                actor = Runner.options(max_concurrency=3).remote(
+                    self._trainable, config)
+                running[trial_id] = {
+                    "actor": actor, "config": config,
+                    "done_ref": actor.run.remote(),
+                    "history": [], "stopped": False,
+                }
+            # Poll running trials for fresh reports.
+            for trial_id, t in list(running.items()):
+                for m in ray_tpu.get(t["actor"].poll.remote()):
+                    t["history"].append(m)
+                    metric_name = scheduler_metric(scheduler, cfg)
+                    if metric_name and metric_name in m and \
+                            not t["stopped"]:
+                        decision = scheduler.on_result(
+                            trial_id, m["training_iteration"],
+                            m[metric_name])
+                        if decision == STOP:
+                            t["stopped"] = True
+                            t["actor"].request_stop.remote()
+                if not t["stopped"] and hasattr(scheduler, "reevaluate"):
+                    if scheduler.reevaluate(trial_id) == STOP:
+                        t["stopped"] = True
+                        t["actor"].request_stop.remote()
+                ready, _ = ray_tpu.wait([t["done_ref"]], num_returns=1,
+                                        timeout=0)
+                if ready:
+                    status, error = "TERMINATED", None
+                    try:
+                        status = ray_tpu.get(t["done_ref"])["status"]
+                    except Exception as e:  # noqa: BLE001
+                        status, error = "ERROR", f"{type(e).__name__}: {e}"
+                    history = t["history"]
+                    try:
+                        history = ray_tpu.get(
+                            t["actor"].all_results.remote())
+                    except Exception:
+                        pass
+                    done.append(TrialResult(
+                        trial_id=trial_id, config=t["config"],
+                        metrics=history[-1] if history else {},
+                        metrics_history=history, status=status,
+                        error=error))
+                    try:
+                        ray_tpu.kill(t["actor"])
+                    except Exception:
+                        pass
+                    del running[trial_id]
+            time.sleep(0.02)
+        done.sort(key=lambda r: r.trial_id)
+        return ResultGrid(done, cfg.metric, cfg.mode)
+
+
+def scheduler_metric(scheduler, cfg: TuneConfig) -> Optional[str]:
+    return getattr(scheduler, "metric", None) or cfg.metric
+
+
+__all__ = [
+    "ASHAScheduler", "FIFOScheduler", "ResultGrid", "TrialResult",
+    "TuneConfig", "Tuner", "choice", "grid_search", "loguniform",
+    "randint", "report", "uniform",
+]

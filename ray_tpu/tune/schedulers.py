@@ -1,0 +1,80 @@
+"""Trial schedulers.
+
+Reference: tune/schedulers/async_hyperband.py (ASHA) — asynchronous
+successive halving: rungs at iteration milestones r, r*eta, r*eta²,…;
+at each rung a trial continues only if its metric is in the top 1/eta
+of results recorded at that rung so far.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, *, metric: str = "", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.eta = reduction_factor
+        # rung milestone -> recorded metric values (sign-normalized so
+        # bigger is always better internally)
+        self._rungs: Dict[int, List[float]] = {}
+        r = grace_period
+        while r < max_t:
+            self._rungs[r] = []
+            r *= self.eta
+
+    def _norm(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def _cutoff(self, rung: List[float]):
+        if len(rung) < self.eta:
+            return None
+        return rung[max(0, len(rung) // self.eta - 1)]
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        if iteration >= self.max_t:
+            return STOP
+        rung_iter = iteration if iteration in self._rungs else None
+        if rung_iter is None:
+            return CONTINUE
+        rung = self._rungs[rung_iter]
+        v = self._norm(metric_value)
+        rung.append(v)
+        rung.sort(reverse=True)
+        self._trial_rung = getattr(self, "_trial_rung", {})
+        self._trial_rung[trial_id] = (rung_iter, v)
+        cutoff = self._cutoff(rung)
+        if cutoff is not None and v < cutoff:
+            return STOP
+        return CONTINUE
+
+    def reevaluate(self, trial_id: str) -> str:
+        """Asynchronous ASHA with per-arrival-only decisions never stops
+        a trial that reaches each rung first (common when trials run in
+        lockstep).  Re-checking a trial's last rung after later, better
+        arrivals restores the top-1/eta semantics."""
+        rec = getattr(self, "_trial_rung", {}).get(trial_id)
+        if rec is None:
+            return CONTINUE
+        rung_iter, v = rec
+        cutoff = self._cutoff(self._rungs[rung_iter])
+        if cutoff is not None and v < cutoff:
+            return STOP
+        return CONTINUE

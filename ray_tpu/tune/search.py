@@ -1,0 +1,102 @@
+"""Search spaces + basic variant generation.
+
+Reference: tune/search/sample.py (domain DSL), basic_variant.py
+(grid/random generator), variant_generator.py.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Iterator, List, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            lo, hi = math.log(self.lower), math.log(self.upper)
+            return math.exp(rng.uniform(lo, hi))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class GridSearch:
+    """Marker for exhaustive expansion (reference: tune.grid_search)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: int = 0) -> Iterator[Dict[str, Any]]:
+    """Cartesian product of grid_search entries × num_samples draws of
+    the stochastic domains (reference basic_variant.py semantics: each
+    grid combination is repeated num_samples times)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, GridSearch)]
+
+    def combos(i: int) -> Iterator[Dict[str, Any]]:
+        if i == len(grid_keys):
+            yield {}
+            return
+        k = grid_keys[i]
+        for v in param_space[k].values:
+            for rest in combos(i + 1):
+                yield {k: v, **rest}
+
+    for _ in range(max(1, num_samples)):
+        for grid_combo in combos(0):
+            config = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    config[k] = grid_combo[k]
+                elif isinstance(v, Domain):
+                    config[k] = v.sample(rng)
+                else:
+                    config[k] = v
+            yield config

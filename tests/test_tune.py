@@ -1,0 +1,143 @@
+"""ray_tpu.tune: variant generation, controller loop, ASHA
+(reference test strategy: tune/tests/)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+
+
+def test_grid_search_picks_best(ray_start_regular):
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2]),
+                     "b": tune.grid_search([3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.config == {"a": 2, "b": 4}
+    assert best.metrics["score"] == 24
+    worst = grid.get_best_result(mode="min")
+    assert worst.config == {"a": 1, "b": 3}
+
+
+def test_random_search_num_samples(ray_start_regular):
+    def trainable(config):
+        tune.report({"loss": (config["lr"] - 0.1) ** 2})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-4, 1e0)},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               num_samples=6, seed=3),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 6
+    lrs = {r.config["lr"] for r in grid}
+    assert len(lrs) == 6  # distinct draws
+    best = grid.get_best_result()
+    assert best.metrics["loss"] == min(r.metrics["loss"] for r in grid)
+
+
+def test_multiple_reports_history(ray_start_regular):
+    def trainable(config):
+        for i in range(5):
+            tune.report({"v": i * config["m"]})
+
+    grid = Tuner(trainable, param_space={"m": tune.grid_search([1, 2])},
+                 tune_config=TuneConfig(metric="v", mode="max")).fit()
+    for r in grid:
+        assert len(r.metrics_history) == 5
+        assert r.metrics_history[-1]["training_iteration"] == 5
+    assert grid.get_best_result().metrics["v"] == 8
+
+
+def test_trial_error_recorded(ray_start_regular):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("boom")
+        tune.report({"ok": 1})
+
+    grid = Tuner(trainable, param_space={"x": tune.grid_search([0, 1])},
+                 tune_config=TuneConfig(metric="ok", mode="max")).fit()
+    statuses = {r.config["x"]: r.status for r in grid}
+    assert statuses[0] == "TERMINATED"
+    assert statuses[1] == "ERROR"
+    assert grid.get_best_result().config == {"x": 0}
+
+
+def test_asha_stops_bad_trials_early(ray_start_regular):
+    max_t = 32
+
+    def trainable(config):
+        for i in range(1, max_t + 1):
+            tune.report({"acc": config["q"] * i})
+            time.sleep(0.005)
+
+    grid = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        tune_config=TuneConfig(
+            metric="acc", mode="max", max_concurrent_trials=4,
+            scheduler=ASHAScheduler(max_t=max_t, grace_period=2,
+                                    reduction_factor=2)),
+    ).fit()
+    by_q = {r.config["q"]: r for r in grid}
+    # The best trial ran to completion; the worst was cut early.
+    assert len(by_q[1.0].metrics_history) == max_t
+    assert by_q[1.0].status == "TERMINATED"
+    assert len(by_q[0.1].metrics_history) < max_t
+    assert by_q[0.1].status == "STOPPED"
+    assert grid.get_best_result().config["q"] == 1.0
+
+
+def test_dataframe(ray_start_regular):
+    def trainable(config):
+        tune.report({"score": config["a"]})
+
+    grid = Tuner(trainable, param_space={"a": tune.grid_search([1, 2])},
+                 tune_config=TuneConfig(metric="score", mode="max")).fit()
+    df = grid.get_dataframe()
+    assert set(df["config/a"]) == {1, 2}
+    assert len(df) == 2
+
+
+def test_tune_wraps_jax_trainer(ray_start_regular, tmp_path):
+    """4-trial LR sweep where each trial runs a JaxTrainer gang
+    (verdict item 9's done-criterion)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu import train as rt_train
+
+    def trainable(config):
+        lr = config["lr"]
+
+        def loop(cfg):
+            # Pretend loss improves proportionally to -log distance
+            # from the sweet spot 0.1.
+            loss = abs(lr - 0.1)
+            rt_train.report({"loss": loss})
+
+        result = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                storage_path=str(tmp_path / f"lr{lr}")),
+        ).fit()
+        tune.report({"loss": result.metrics["loss"]})
+
+    grid = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.001, 0.01, 0.1, 1.0])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               max_concurrent_trials=2),
+    ).fit()
+    assert len(grid) == 4
+    assert grid.get_best_result().config["lr"] == 0.1
