@@ -63,6 +63,11 @@ class LlamaConfig:
     remat_policy: str = "full"
     # Tie input embedding and LM head (small models).
     tie_embeddings: bool = False
+    # >0 enables REAL pipeline parallelism when the active mesh has a
+    # pipe axis of size >1: the layer stack runs as a GPipe microbatch
+    # schedule over pipe stages (parallel/pipeline.py) instead of one
+    # scan.  Value = number of microbatches.
+    pipeline_microbatches: int = 0
 
     @property
     def q_dim(self) -> int:
@@ -345,6 +350,7 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
         raise NotImplementedError(
             f"custom positions require attention_impl='dot' "
             f"(got {c.attention_impl!r})")
+    custom_positions = positions is not None
     if positions is None:
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
@@ -354,21 +360,54 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
     x = with_logical_constraint(x, "batch", "seq", None)
     sin, cos = rope_table(positions, c.head_dim, c.rope_theta)
 
-    block = functools.partial(decoder_layer, sin=sin, cos=cos,
-                              positions=positions, config=c,
-                              attention_fn=attention_fn)
-    if c.remat:
-        policies = {
-            "full": jax.checkpoint_policies.nothing_saveable,
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "dots_saveable": jax.checkpoint_policies.dots_saveable,
-        }
-        block = jax.checkpoint(block, policy=policies[c.remat_policy])
+    def make_block(sin, cos, positions):
+        block = functools.partial(decoder_layer, sin=sin, cos=cos,
+                                  positions=positions, config=c,
+                                  attention_fn=attention_fn)
+        if c.remat:
+            policies = {
+                "full": jax.checkpoint_policies.nothing_saveable,
+                "dots":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "dots_saveable": jax.checkpoint_policies.dots_saveable,
+            }
+            block = jax.checkpoint(block,
+                                   policy=policies[c.remat_policy])
+        return block
 
-    def scan_body(carry, layer_params):
-        return block(carry, layer_params), None
+    from ray_tpu.parallel.sharding import current_mesh
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    mesh = current_mesh()
+    if (c.pipeline_microbatches > 0 and mesh is not None
+            and mesh.shape.get("pipe", 1) > 1):
+        if custom_positions:
+            raise NotImplementedError(
+                "pipeline parallelism assumes the default arange "
+                "position layout (packed/offset positions differ per "
+                "batch row; microbatches share one row)")
+        if c.attention_impl == "ring":
+            raise NotImplementedError(
+                "attention_impl='ring' inside pipeline stages would "
+                "nest shard_maps; use flash or dot with pipe > 1")
+        from ray_tpu.parallel.pipeline import pipeline_layers
+
+        # The block closes over batch-shaped sin/cos/positions; a
+        # microbatch needs the broadcastable single-row versions, which
+        # are only equivalent for the default arange layout.
+        block = make_block(sin[:1], cos[:1], positions[:1])
+        batch_axes = [a for a in ("data", "fsdp") if a in mesh.shape
+                      and mesh.shape[a] > 1]
+        x = pipeline_layers(
+            lambda h, layer: block(h, layer), params["layers"], x,
+            mesh=mesh, num_microbatches=c.pipeline_microbatches,
+            batch_axes=batch_axes)
+    else:
+        block = make_block(sin, cos, positions)
+
+        def scan_body(carry, layer_params):
+            return block(carry, layer_params), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     if c.tie_embeddings:

@@ -137,15 +137,30 @@ def logical_sharding(logical_axes: Sequence[Optional[str]],
     return NamedSharding(mesh, rules.spec(logical_axes))
 
 
+@contextlib.contextmanager
+def suppress_constraints():
+    """Disable with_logical_constraint within the block — used while
+    tracing code placed inside a fully-manual shard_map region, where
+    global sharding constraints don't apply (the shard_map specs own
+    the layout)."""
+    prev = getattr(_ctx, "suppress", False)
+    _ctx.suppress = True
+    try:
+        yield
+    finally:
+        _ctx.suppress = prev
+
+
 def with_logical_constraint(x, *logical_axes: Optional[str],
                             rules: Optional[ShardingRules] = None):
     """``lax.with_sharding_constraint`` by logical axis names.
 
     No-op outside a mesh context so model code runs unchanged on a
-    single device (tests, single-chip bench).
+    single device (tests, single-chip bench), and under
+    suppress_constraints() (inside shard_map bodies).
     """
     mesh = _ctx.mesh
-    if mesh is None or mesh.size == 1:
+    if mesh is None or mesh.size == 1 or getattr(_ctx, "suppress", False):
         return x
     rules = rules or _ctx.rules
     spec = rules.spec(logical_axes)
