@@ -43,6 +43,7 @@ class ClusterClient:
         self.node_name = node_name
         # actor_id -> (node_id, address) location cache
         self._actor_locations: Dict[Any, Tuple[str, str]] = {}
+        self._actor_meta: Dict[Any, int] = {}  # actor_id -> task retries
         self._loc_lock = threading.Lock()
         self._stopped = threading.Event()
         # (expiry, demand) of the last failed spill placement.
@@ -266,22 +267,84 @@ class ClusterClient:
             "name": options.get("name", ""),
             "namespace": options.get("namespace", ""),
             "klass": dumps(klass),
+            # The head replays this bundle on a survivor to restart the
+            # actor after node death (gcs_actor_manager.h:308).
+            "spec": bundle,
+            "max_restarts": int(options.get("max_restarts") or 0),
+            "max_task_retries": int(options.get("max_task_retries") or 0),
+            "resources": dict(demand or {}),
         })
         return node_id, address
 
+    def actor_task_retries(self, actor_id) -> int:
+        """The actor's registered max_task_retries (0 if unknown)."""
+        with self._loc_lock:
+            cached = self._actor_meta.get(actor_id)
+        if cached is not None:
+            return cached
+        resp = self.head.call("lookup_actor",
+                              {"actor_id": actor_id.binary()})
+        mtr = int(resp.get("max_task_retries", 0)) if \
+            resp.get("found") else 0
+        with self._loc_lock:
+            self._actor_meta[actor_id] = mtr
+        return mtr
+
+    def resubmit_actor_task(self, spec) -> None:
+        """Queue-ish path for a call whose actor is (re)starting: wait
+        out the head-driven restart (state RESTARTING), then push to
+        the new location (reference: actor_task_submitter.h:75 queues
+        and resubmits across restarts).  The deadline tracks the
+        head's restart budget (placement retries + create timeout),
+        not a shorter client-side guess."""
+        from ..exceptions import ActorDiedError
+
+        deadline = time.monotonic() + 330.0
+        while time.monotonic() < deadline:
+            try:
+                resp = self.head.call(
+                    "lookup_actor", {"actor_id": spec.actor_id.binary()},
+                    timeout=5.0)
+            except Exception:
+                break
+            if not resp.get("found"):
+                break
+            if resp.get("state") == "RESTARTING":
+                time.sleep(0.25)
+                continue
+            loc = (resp["node_id"], resp["address"])
+            with self._loc_lock:
+                self._actor_locations[spec.actor_id] = loc
+            self.submit_remote_actor_task(spec, loc)
+            return
+        self.runtime.task_manager.complete_error(
+            spec, ActorDiedError(
+                spec.actor_id, "actor did not come back after its node "
+                "died (no restart budget or restart failed)"),
+            allow_retry=False)
+
     def locate_actor(self, actor_id) -> Optional[Tuple[str, str]]:
+        loc, _state = self.locate_actor_with_state(actor_id)
+        return loc
+
+    def locate_actor_with_state(self, actor_id):
+        """((node_id, address) | None, state).  A RESTARTING actor's
+        stored location is its DEAD node — callers must wait (the
+        resubmit path) rather than push there."""
         with self._loc_lock:
             loc = self._actor_locations.get(actor_id)
         if loc is not None:
-            return loc
+            return loc, "ALIVE"
         resp = self.head.call("lookup_actor",
                               {"actor_id": actor_id.binary()})
         if not resp.get("found"):
-            return None
+            return None, "DEAD"
+        state = resp.get("state", "ALIVE")
         loc = (resp["node_id"], resp["address"])
-        with self._loc_lock:
-            self._actor_locations[actor_id] = loc
-        return loc
+        if state == "ALIVE":
+            with self._loc_lock:
+                self._actor_locations[actor_id] = loc
+        return loc, state
 
     def lookup_named_actor(self, name: str, namespace: str):
         """Returns (actor_id_bytes, klass, node_id, address) or None."""
@@ -307,12 +370,14 @@ class ClusterClient:
 
         def on_done(result, is_error):
             if is_error:
+                # Transport death is retriable when the actor has
+                # max_task_retries budget (spec.max_retries carries it);
+                # the retry waits out the head-driven restart.
                 self._report_node_failure(node_id)
                 self.runtime.task_manager.complete_error(
                     spec, ActorDiedError(
                         spec.actor_id,
-                        f"actor's node {node_id[:8]} died: {result}"),
-                    allow_retry=False)
+                        f"actor's node {node_id[:8]} died: {result}"))
                 return
             status, payload = result
             if status == "ok":
@@ -329,8 +394,7 @@ class ClusterClient:
             self._report_node_failure(node_id)
             self.runtime.task_manager.complete_error(
                 spec, ActorDiedError(spec.actor_id,
-                                     f"actor node unreachable: {e}"),
-                allow_retry=False)
+                                     f"actor node unreachable: {e}"))
 
     def kill_remote_actor(self, actor_id, no_restart: bool = True):
         loc = self.locate_actor(actor_id)

@@ -20,9 +20,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .rpc import RpcServer
+from .rpc import ClientPool, RpcServer
 
 _DEAD_AFTER_S = 10.0  # heartbeats missed before a node is declared dead
+_RESTART_TIMEOUT_S = 300.0
 
 
 _RESERVATION_TTL_S = 2.5  # ≥ 2 heartbeats: by then the placed task is
@@ -94,6 +95,14 @@ class HeadServer:
             "ping": lambda p: "pong",
         }, host=host, port=port)
         self.address = self._server.address
+        # Actor restart machinery (reference: gcs_actor_manager.h:308
+        # FSM — ALIVE → RESTARTING → ALIVE/DEAD with max_restarts).
+        self._pool = ClientPool()
+        self._restart_pending: List[bytes] = []
+        self._restart_cond = threading.Condition(self._lock)
+        self._restarter = threading.Thread(target=self._restart_loop,
+                                           daemon=True)
+        self._restarter.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
 
@@ -140,14 +149,80 @@ class HeadServer:
         return {"ok": True, "dead_actors": dead_actors}
 
     def _forget_actors_on(self, node_id: str) -> List[bytes]:
+        """Actors on a dead node either enter RESTARTING (spec kept and
+        restart budget remaining — reference gcs_actor_manager.h:308)
+        or are dropped."""
         dead = [aid for aid, info in self._actors.items()
-                if info["node_id"] == node_id]
+                if info["node_id"] == node_id and
+                info.get("state", "ALIVE") == "ALIVE"]
+        gone = []
         for aid in dead:
-            info = self._actors.pop(aid)
-            if info.get("name"):
-                self._named.pop((info.get("namespace", ""), info["name"]),
-                                None)
-        return dead
+            info = self._actors[aid]
+            if (info.get("spec") is not None
+                    and info.get("restarts_used", 0) <
+                    info.get("max_restarts", 0)):
+                info["state"] = "RESTARTING"
+                self._restart_pending.append(aid)
+                self._restart_cond.notify_all()
+            else:
+                self._actors.pop(aid)
+                if info.get("name"):
+                    self._named.pop(
+                        (info.get("namespace", ""), info["name"]), None)
+                gone.append(aid)
+        return gone
+
+    def _restart_loop(self):
+        while True:
+            with self._restart_cond:
+                while not self._restart_pending:
+                    self._restart_cond.wait()
+                aid = self._restart_pending.pop(0)
+                info = self._actors.get(aid)
+                if info is None or info.get("state") != "RESTARTING":
+                    continue
+                if "restart_deadline" not in info:
+                    info["restart_deadline"] = (
+                        time.monotonic() + _RESTART_TIMEOUT_S)
+                spec = info["spec"]
+                demand = dict(info.get("resources") or {})
+                dead_node = info["node_id"]
+                deadline = info["restart_deadline"]
+            placed = self._place({"resources": demand,
+                                  "exclude": [dead_node]})
+            ok = False
+            if placed.get("ok"):
+                try:
+                    resp = self._pool.get(placed["address"]).call(
+                        "create_actor", spec,
+                        timeout=_RESTART_TIMEOUT_S)
+                    ok = bool(resp.get("ok"))
+                except Exception:
+                    ok = False
+            with self._lock:
+                info = self._actors.get(aid)
+                if info is None:
+                    continue
+                if ok:
+                    info["node_id"] = placed["node_id"]
+                    info["address"] = placed["address"]
+                    info["restarts_used"] = \
+                        info.get("restarts_used", 0) + 1
+                    info["state"] = "ALIVE"
+                    info.pop("restart_deadline", None)
+                elif time.monotonic() < deadline:
+                    # Transient placement/RPC failure: keep trying —
+                    # the reference GCS reschedules while the restart
+                    # budget remains, it doesn't drop on first miss.
+                    self._restart_pending.append(aid)
+                else:
+                    self._actors.pop(aid, None)
+                    if info.get("name"):
+                        self._named.pop(
+                            (info.get("namespace", ""), info["name"]),
+                            None)
+            if not ok:
+                time.sleep(1.0)
 
     def _list_nodes(self, _p):
         with self._lock:
@@ -291,6 +366,14 @@ class HeadServer:
                 "name": p.get("name", ""),
                 "namespace": p.get("namespace", ""),
                 "klass": p.get("klass"),
+                # Restart machinery: the pickled creation bundle is
+                # replayed on a survivor when this actor's node dies.
+                "spec": p.get("spec"),
+                "max_restarts": int(p.get("max_restarts", 0)),
+                "max_task_retries": int(p.get("max_task_retries", 0)),
+                "resources": p.get("resources") or {},
+                "restarts_used": 0,
+                "state": "ALIVE",
             }
             if p.get("name"):
                 key = (p.get("namespace", ""), p["name"])
@@ -304,12 +387,17 @@ class HeadServer:
                 self._named[key] = p["actor_id"]
         return {"ok": True}
 
+    @staticmethod
+    def _actor_view(info):
+        # The creation bundle stays head-side; lookups don't ship it.
+        return {k: v for k, v in info.items() if k != "spec"}
+
     def _lookup_actor(self, p):
         with self._lock:
             info = self._actors.get(p["actor_id"])
         if info is None:
             return {"found": False}
-        return {"found": True, **info}
+        return {"found": True, **self._actor_view(info)}
 
     def _lookup_named_actor(self, p):
         key = (p.get("namespace", ""), p["name"])
@@ -318,7 +406,7 @@ class HeadServer:
             info = self._actors.get(aid) if aid else None
         if info is None:
             return {"found": False}
-        return {"found": True, "actor_id": aid, **info}
+        return {"found": True, "actor_id": aid, **self._actor_view(info)}
 
     def _remove_actor(self, p):
         with self._lock:

@@ -225,6 +225,14 @@ class Runtime:
             from ..exceptions import ActorDiedError
 
             core = self.actor_manager.get_core(spec.actor_id)
+            if core is None and self.cluster is not None:
+                # Remote actor: wait out a head-driven restart and push
+                # to the new location.  The wait can take seconds, so
+                # it runs off the completion path.
+                threading.Thread(
+                    target=self.cluster.resubmit_actor_task,
+                    args=(spec,), daemon=True).start()
+                return
             if core is None or core.info.state == ActorState.DEAD:
                 self.task_manager.complete_error(
                     spec, ActorDiedError(spec.actor_id, "actor is dead"),
@@ -543,6 +551,25 @@ class Runtime:
                 "node_id": self.cluster.node_id,
                 "address": self.cluster.address,
                 "name": name, "namespace": ns, "klass": _dumps(klass),
+                "max_task_retries": max_task_retries,
+                "max_restarts": max_restarts,
+                "resources": dict(demand or {}),
+                # Same creation bundle shape the node server's
+                # create_actor handler takes: the head replays it on a
+                # survivor if this node dies (locally-created actors
+                # must be as restartable as spilled ones).
+                "spec": _dumps({
+                    "actor_id": actor_id, "klass": klass,
+                    "args": args, "kwargs": kwargs, "options": {
+                        "name": name, "namespace": ns,
+                        "max_restarts": max_restarts,
+                        "max_task_retries": max_task_retries,
+                        "max_concurrency": max_concurrency,
+                        "max_pending_calls": max_pending_calls,
+                        "lifetime": lifetime,
+                        "resources": demand,
+                    },
+                }),
             })
 
         creation_task_id = TaskID.for_task(actor_id)
@@ -685,7 +712,8 @@ class Runtime:
         another node (reference: actor_task_submitter.h:75 — per-actor
         client queue + direct push; ordering is preserved by the
         receiving node's inline submission of ``actor_call``)."""
-        location = self.cluster.locate_actor(actor_id)
+        location, actor_state = \
+            self.cluster.locate_actor_with_state(actor_id)
         if location is None:
             raise ValueError(f"no such actor {actor_id!r}")
         n = options.num_returns
@@ -702,7 +730,10 @@ class Runtime:
                 getattr(klass, "__module__", "") or "", method_name,
                 getattr(klass, "__qualname__", "")),
             args=tuple(args), kwargs=dict(kwargs), num_returns=n,
-            resources={}, max_retries=0,
+            resources={},
+            # A call may survive as many actor-node deaths as the
+            # actor's max_task_retries allows (was silently forced 0).
+            max_retries=self.cluster.actor_task_retries(actor_id),
             retry_exceptions=options.retry_exceptions,
             name=options.name, actor_id=actor_id, is_actor_task=True,
             parent_task_id=self.current_task_id(), return_ids=return_ids)
@@ -712,7 +743,14 @@ class Runtime:
         arg_ids += [v.object_id() for v in spec.kwargs.values()
                     if isinstance(v, ObjectRef)]
         self.reference_counter.add_submitted_task_references(arg_ids)
-        self.cluster.submit_remote_actor_task(spec, location)
+        if actor_state == "RESTARTING":
+            # Queue behind the head-driven restart instead of pushing
+            # to the dead node's address.
+            threading.Thread(
+                target=self.cluster.resubmit_actor_task,
+                args=(spec,), daemon=True).start()
+        else:
+            self.cluster.submit_remote_actor_task(spec, location)
         return self._refs_for(spec)
 
     def _release_actor_resources(self, info):

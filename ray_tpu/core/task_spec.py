@@ -165,7 +165,12 @@ class TaskSpec:
                                   OutOfMemoryError, TaskError)
 
         system_failure = isinstance(
-            error, (NodeDiedError, OutOfMemoryError))
+            error, (NodeDiedError, OutOfMemoryError)) or (
+            # An actor dying with its node is a system failure for the
+            # CALL; the budget (max_retries = the actor's
+            # max_task_retries) gates how many such deaths a call may
+            # survive (reference: actor_task_submitter.h:75).
+            self.is_actor_task and isinstance(error, ActorDiedError))
         if system_failure:
             return True
         if self.retry_exceptions is True:

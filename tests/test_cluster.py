@@ -469,3 +469,42 @@ class TestClusterScheduling:
             for _ in range(2)
         ]
         assert set(ray_tpu.get(refs, timeout=60)) == {target}
+
+
+# ---------------------------------------------------------------------------
+# Actor restart across node death (reference:
+# gcs_actor_manager.h:308 FSM + actor_task_submitter.h:75 resubmits)
+# ---------------------------------------------------------------------------
+
+class TestActorRestart:
+    def test_named_actor_restarts_on_survivor(self, cluster):
+        procs = [cluster.add_node(num_cpus=1, resources={"ha2": 1},
+                                  name=f"resur{i}") for i in range(2)]
+        a = Counter.options(
+            name="phoenix", max_restarts=1, max_task_retries=3,
+            resources={"ha2": 1}).remote(100)
+        assert ray_tpu.get(a.incr.remote(), timeout=30) == 101
+        host_pid = ray_tpu.get(a.pid.remote(), timeout=30)
+        victim = next(p for p in procs if p.pid == host_pid)
+        cluster.kill_node(victim)
+        # The call rides out the restart: fresh __init__(100) + incr.
+        assert ray_tpu.get(a.incr.remote(), timeout=60) == 101
+        # The restarted actor runs on the survivor, and the name
+        # resolves to it.
+        new_pid = ray_tpu.get(a.pid.remote(), timeout=30)
+        survivor = next(p for p in procs if p is not victim)
+        assert new_pid == survivor.pid
+        b = ray_tpu.get_actor("phoenix")
+        assert ray_tpu.get(b.get.remote(), timeout=30) == 101
+
+    def test_actor_without_restart_budget_dies(self, cluster):
+        proc = cluster.add_node(num_cpus=1, resources={"mort": 1},
+                                name="mortal")
+        a = Counter.options(max_restarts=0,
+                            resources={"mort": 1}).remote(0)
+        assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+        cluster.kill_node(proc)
+        from ray_tpu.exceptions import ActorDiedError
+
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(a.incr.remote(), timeout=60)
