@@ -5,7 +5,8 @@ built TPU-first: tasks/actors/objects orchestrate *processes and hosts*;
 jax/XLA (pjit over device meshes, Pallas kernels, ICI/DCN collectives)
 owns the chip-level compute.  Public surface mirrors python/ray/__init__.py:
 ``init/shutdown/remote/get/put/wait/cancel/kill`` plus the libraries
-(``ray_tpu.data``, ``.train``, ``.tune``, ``.serve``, ``.rl``).
+(``ray_tpu.data``, ``.train``, ``.tune``, ``.serve``; an RLlib
+equivalent is not built yet).
 """
 
 from __future__ import annotations

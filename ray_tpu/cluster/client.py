@@ -44,6 +44,9 @@ class ClusterClient:
         # actor_id -> (node_id, address) location cache
         self._actor_locations: Dict[Any, Tuple[str, str]] = {}
         self._actor_meta: Dict[Any, int] = {}  # actor_id -> task retries
+        # actor_id -> FIFO of specs waiting out a restart (one waiter
+        # thread per actor preserves call order and bounds head load).
+        self._restart_queues: Dict[Any, list] = {}
         self._loc_lock = threading.Lock()
         self._stopped = threading.Event()
         # (expiry, demand) of the last failed spill placement.
@@ -291,37 +294,62 @@ class ClusterClient:
         return mtr
 
     def resubmit_actor_task(self, spec) -> None:
-        """Queue-ish path for a call whose actor is (re)starting: wait
-        out the head-driven restart (state RESTARTING), then push to
-        the new location (reference: actor_task_submitter.h:75 queues
-        and resubmits across restarts).  The deadline tracks the
-        head's restart budget (placement retries + create timeout),
-        not a shorter client-side guess."""
+        """Queue a call whose actor is (re)starting behind a per-actor
+        FIFO waiter (reference: actor_task_submitter.h:75 — a per-actor
+        queue resubmits across restarts IN ORDER).  One waiter thread
+        per actor polls the head (so N queued calls cost one poll loop,
+        not N) and pushes the queue to the new location when the actor
+        turns ALIVE."""
+        with self._loc_lock:
+            q = self._restart_queues.get(spec.actor_id)
+            if q is not None:
+                q.append(spec)
+                return
+            self._restart_queues[spec.actor_id] = [spec]
+        threading.Thread(target=self._restart_waiter,
+                         args=(spec.actor_id,), daemon=True).start()
+
+    def _restart_waiter(self, actor_id) -> None:
         from ..exceptions import ActorDiedError
 
+        # Deadline tracks the head's restart budget (placement retries
+        # + create timeout), not a shorter client-side guess.
         deadline = time.monotonic() + 330.0
+        error: Optional[BaseException] = None
+        loc = None
         while time.monotonic() < deadline:
             try:
                 resp = self.head.call(
-                    "lookup_actor", {"actor_id": spec.actor_id.binary()},
+                    "lookup_actor", {"actor_id": actor_id.binary()},
                     timeout=5.0)
             except Exception:
-                break
+                # Transient head hiccup (it is busy handling the same
+                # node death): keep waiting, don't burn the budget.
+                time.sleep(1.0)
+                continue
             if not resp.get("found"):
+                error = ActorDiedError(
+                    actor_id, "actor did not come back after its node "
+                    "died (no restart budget or restart failed)")
                 break
             if resp.get("state") == "RESTARTING":
                 time.sleep(0.25)
                 continue
             loc = (resp["node_id"], resp["address"])
-            with self._loc_lock:
-                self._actor_locations[spec.actor_id] = loc
-            self.submit_remote_actor_task(spec, loc)
-            return
-        self.runtime.task_manager.complete_error(
-            spec, ActorDiedError(
-                spec.actor_id, "actor did not come back after its node "
-                "died (no restart budget or restart failed)"),
-            allow_retry=False)
+            break
+        if loc is None and error is None:
+            error = ActorDiedError(
+                actor_id, "timed out waiting for the actor to restart")
+        with self._loc_lock:
+            queued = self._restart_queues.pop(actor_id, [])
+            if loc is not None:
+                self._actor_locations[actor_id] = loc
+        for spec in queued:
+            if loc is not None:
+                self.submit_remote_actor_task(spec, loc)
+            else:
+                self.runtime.task_manager.complete_error(
+                    spec, error, allow_retry=False)
 
     def locate_actor(self, actor_id) -> Optional[Tuple[str, str]]:
         loc, _state = self.locate_actor_with_state(actor_id)

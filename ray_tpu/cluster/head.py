@@ -21,6 +21,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .rpc import ClientPool, RpcServer
+from .serialization import loads
 
 _DEAD_AFTER_S = 10.0  # heartbeats missed before a node is declared dead
 _RESTART_TIMEOUT_S = 300.0
@@ -193,15 +194,27 @@ class HeadServer:
             ok = False
             if placed.get("ok"):
                 try:
+                    # Per-attempt timeout stays well under the overall
+                    # restart deadline so one wedged target can't hold
+                    # the restart thread for every other actor's budget.
                     resp = self._pool.get(placed["address"]).call(
-                        "create_actor", spec,
-                        timeout=_RESTART_TIMEOUT_S)
+                        "create_actor", spec, timeout=60.0)
                     ok = bool(resp.get("ok"))
                 except Exception:
                     ok = False
             with self._lock:
                 info = self._actors.get(aid)
                 if info is None:
+                    # Killed/removed while we were restarting it: the
+                    # fresh replica (if any) must not leak.
+                    if ok:
+                        try:
+                            self._pool.get(placed["address"]).call(
+                                "kill_actor",
+                                {"actor_id": loads(spec)["actor_id"],
+                                 "no_restart": True}, timeout=10.0)
+                        except Exception:
+                            pass
                     continue
                 if ok:
                     info["node_id"] = placed["node_id"]
@@ -419,7 +432,9 @@ class HeadServer:
     def _list_actors_rpc(self, _p):
         with self._lock:
             return [{"actor_id": aid, "node_id": i["node_id"],
-                     "name": i["name"]} for aid, i in self._actors.items()]
+                     "name": i["name"],
+                     "state": i.get("state", "ALIVE")}
+                    for aid, i in self._actors.items()]
 
     # ---------------------------------------------------------------- pgs
     def _create_pg(self, p):

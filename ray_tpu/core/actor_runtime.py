@@ -16,6 +16,7 @@ import asyncio
 import inspect
 import queue
 import threading
+import time
 from enum import Enum
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -154,8 +155,12 @@ class _ActorCore:
 
     def _run_one(self, spec: TaskSpec):
         if spec.is_actor_creation:
+            t0 = time.time()
             self.create_instance()
             self._runtime.finish_actor_creation(self, spec)
+            self._runtime._record_task_event(
+                spec, t0,
+                "ok" if self._creation_error is None else "error")
             return
         self._call_started(spec)
         if self.info.state == ActorState.DEAD:
@@ -167,8 +172,12 @@ class _ActorCore:
 
     async def _run_one_async(self, spec: TaskSpec):
         if spec.is_actor_creation:
+            t0 = time.time()
             self.create_instance()
             self._runtime.finish_actor_creation(self, spec)
+            self._runtime._record_task_event(
+                spec, t0,
+                "ok" if self._creation_error is None else "error")
             return
         self._call_started(spec)
         if self.info.state == ActorState.DEAD:

@@ -3,9 +3,9 @@
 Reference semantics: src/ray/common/scheduling/ — a node advertises a
 total resource set ({"CPU": n, "TPU": m, custom...}); tasks demand
 resources which are acquired at dispatch and released at completion.
-TPU note: a TPU host additionally advertises topology labels
-(``TPU-v5p-16-head``, ICI coordinates) so placement can pack along the
-torus — see ray_tpu.parallel.mesh.
+TPU note: nodes can carry placement labels (see NodeLabel scheduling
+in cluster/head.py); ICI-topology-aware labels are not auto-detected
+yet — pass them explicitly at node start.
 """
 
 from __future__ import annotations

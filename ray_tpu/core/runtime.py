@@ -376,6 +376,8 @@ class Runtime:
                           attempt_number=spec.attempt_number,
                           parent_task_id=spec.parent_task_id)
         rc_mod.set_task_context(ctx)
+        t_start = time.time()
+        outcome = "ok"
         try:
             fn = self._lookup_callable(spec, bound_instance)
             result = fn(*args, **kwargs)
@@ -388,13 +390,16 @@ class Runtime:
             if actor_core is not None:
                 self.kill_actor(spec.actor_id, no_restart=True)
         except TaskCancelledError as e:
+            outcome = "cancelled"
             self.task_manager.complete_error(spec, e, allow_retry=False)
         except BaseException as e:  # noqa: BLE001
+            outcome = "error"
             err = e if isinstance(e, TaskError) else TaskError(
                 spec.repr_name(), e)
             self.task_manager.complete_error(spec, err)
         finally:
             rc_mod.set_task_context(None)
+            self._record_task_event(spec, t_start, outcome)
 
     async def execute_task_inline_async(self, spec: TaskSpec,
                                         bound_instance=None,
@@ -408,6 +413,8 @@ class Runtime:
                           actor_id=spec.actor_id,
                           attempt_number=spec.attempt_number)
         rc_mod.set_task_context(ctx)
+        t_start = time.time()
+        outcome = "ok"
         try:
             fn = self._lookup_callable(spec, bound_instance)
             result = fn(*args, **kwargs)
@@ -425,13 +432,41 @@ class Runtime:
             if actor_core is not None:
                 self.kill_actor(spec.actor_id, no_restart=True)
         except TaskCancelledError as e:
+            outcome = "cancelled"
             self.task_manager.complete_error(spec, e, allow_retry=False)
         except BaseException as e:  # noqa: BLE001
+            outcome = "error"
             err = e if isinstance(e, TaskError) else TaskError(
                 spec.repr_name(), e)
             self.task_manager.complete_error(spec, err)
         finally:
             rc_mod.set_task_context(None)
+            self._record_task_event(spec, t_start, outcome)
+
+    def _record_task_event(self, spec: TaskSpec, t_start: float,
+                           outcome: str):
+        """Timeline span + counters for one executed task (reference:
+        TaskEventBuffer, task_event_buffer.h:220 → ray.timeline)."""
+        from ..observability import metrics as _metrics
+        from ..observability.timeline import record_span
+
+        t_end = time.time()
+        kind = ("actor_creation" if spec.is_actor_creation
+                else "actor_task" if spec.is_actor_task else "task")
+        record_span(
+            spec.repr_name(), t_start, t_end,
+            pid=f"node:{self.node_id.hex()[:8]}",
+            tid=threading.current_thread().name,
+            args={"task_id": spec.task_id.hex(), "kind": kind,
+                  "outcome": outcome,
+                  "attempt": spec.attempt_number})
+        counters = _metrics.runtime_counters()
+        tags = {"kind": kind}
+        if outcome == "ok":
+            counters["tasks_finished"].inc(tags=tags)
+        else:
+            counters["tasks_failed"].inc(tags=tags)
+        counters["task_seconds"].observe(t_end - t_start, tags=tags)
 
     def _seal_stream_item(self, spec: TaskSpec, index: int, item):
         item_id = ObjectID.for_return(spec.task_id, index + 1)

@@ -1,0 +1,122 @@
+"""Application + runtime metrics.
+
+Reference: python/ray/util/metrics.py (Counter/Gauge/Histogram over
+the C++ OpenCensus registry, stats/metric.h:103) — here a process-local
+registry; the runtime increments task/object counters and
+``metrics_summary()`` snapshots everything.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Metric"] = {}
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[Tuple, float] = {}
+        self._vlock = threading.Lock()
+        with _lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                # Re-declaring a metric returns the same series.
+                self.__dict__ = existing.__dict__
+            else:
+                _registry[name] = self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        tags = tags or {}
+        return tuple(tags.get(k, "") for k in self.tag_keys)
+
+    def snapshot(self) -> Dict[Tuple, float]:
+        with self._vlock:
+            return dict(self._values)
+
+
+class Counter(_Metric):
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._vlock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._vlock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (), tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        if not getattr(self, "boundaries", None):
+            self.boundaries = sorted(boundaries) or [
+                0.001, 0.01, 0.1, 1.0, 10.0, 100.0]
+            self._counts: Dict[Tuple, List[int]] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._vlock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._values[k] = self._values.get(k, 0.0) + value  # sum
+
+    def buckets(self, tags: Optional[Dict[str, str]] = None) -> List[int]:
+        with self._vlock:
+            return list(self._counts.get(self._key(tags), []))
+
+
+def metrics_summary() -> Dict[str, Dict]:
+    """{metric name: {tag-tuple repr: value}} snapshot of everything."""
+    with _lock:
+        metrics = dict(_registry)
+    out = {}
+    for name, m in metrics.items():
+        snap = m.snapshot()
+        out[name] = {
+            ",".join(k) if k else "": v for k, v in snap.items()}
+    return out
+
+
+def reset_metrics():
+    with _lock:
+        _registry.clear()
+
+
+# Runtime-internal series (incremented by ray_tpu.core.runtime).
+_runtime_counters = None
+
+
+def runtime_counters():
+    """Singleton: called per task completion, so construct (and take
+    the registry lock) only once.  reset_metrics() invalidates it."""
+    global _runtime_counters
+    rc = _runtime_counters
+    if rc is not None and _registry.get("ray_tpu_tasks_finished") is \
+            rc["tasks_finished"]:
+        return rc
+    rc = {
+        "tasks_finished": Counter(
+            "ray_tpu_tasks_finished", "tasks completed OK",
+            tag_keys=("kind",)),
+        "tasks_failed": Counter(
+            "ray_tpu_tasks_failed", "tasks completed with error",
+            tag_keys=("kind",)),
+        "task_seconds": Histogram(
+            "ray_tpu_task_seconds", "task execution wall time",
+            tag_keys=("kind",)),
+    }
+    _runtime_counters = rc
+    return rc

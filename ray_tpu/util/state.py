@@ -1,0 +1,123 @@
+"""Cluster/runtime state listing.
+
+Reference: python/ray/util/state/api.py:110,781,1008 — ``ray list
+tasks/actors/objects/nodes`` aggregating GCS + workers; server side
+dashboard/state_aggregator.py.  Here the local runtime answers for its
+own tables and the head answers cluster-wide questions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _runtime():
+    from ..core.runtime import get_runtime
+
+    return get_runtime()
+
+
+def list_tasks(*, include_done: bool = False) -> List[Dict[str, Any]]:
+    """Pending (owner-side) tasks; with ``include_done``, also every
+    finished task recorded in the timeline buffer this session."""
+    rt = _runtime()
+    out = []
+    with rt.task_manager._lock:
+        pending = list(rt.task_manager._pending.values())
+    for spec in pending:
+        out.append({
+            "task_id": spec.task_id.hex(),
+            "name": spec.repr_name(),
+            "state": "PENDING",
+            "kind": ("ACTOR_CREATION" if spec.is_actor_creation else
+                     "ACTOR_TASK" if spec.is_actor_task else "TASK"),
+            "attempt": spec.attempt_number,
+        })
+    if include_done:
+        from ..observability.timeline import export_timeline
+
+        for ev in export_timeline():
+            args = ev.get("args") or {}
+            if "task_id" in args:
+                out.append({
+                    "task_id": args["task_id"],
+                    "name": ev["name"],
+                    "state": ("FINISHED" if args.get("outcome") == "ok"
+                              else "FAILED"),
+                    "kind": args.get("kind", "task").upper(),
+                    "attempt": args.get("attempt", 0),
+                    "duration_s": ev.get("dur", 0) / 1e6,
+                })
+    return out
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    """Local actors plus (in cluster mode) every actor the head knows."""
+    rt = _runtime()
+    out = []
+    with rt.actor_manager._lock:
+        cores = list(rt.actor_manager._cores.values())
+    for core in cores:
+        info = core.info
+        out.append({
+            "actor_id": info.actor_id.hex(),
+            "class_name": info.klass.__name__,
+            "name": info.name, "namespace": info.namespace,
+            "state": info.state.name
+            if hasattr(info.state, "name") else str(info.state),
+            "node_id": rt.node_id.hex(),
+            "pid": __import__("os").getpid(),
+        })
+    if rt.cluster is not None:
+        local_ids = {a["actor_id"] for a in out}
+        try:
+            for a in rt.cluster.head.call("list_actors", None,
+                                          timeout=10.0):
+                aid = a["actor_id"].hex() if hasattr(
+                    a["actor_id"], "hex") else str(a["actor_id"])
+                if aid not in local_ids:
+                    out.append({
+                        "actor_id": aid,
+                        "class_name": "",
+                        "name": a.get("name", ""),
+                        "namespace": "",
+                        "state": a.get("state", "ALIVE"),
+                        "node_id": a.get("node_id", ""),
+                        "pid": None,
+                    })
+        except Exception:
+            pass
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    rt = _runtime()
+    with rt.object_store._lock:
+        items = list(rt.object_store._objects.items())
+    out = []
+    for oid, obj in items:
+        out.append({
+            "object_id": oid.hex(),
+            "is_error": obj.is_error(),
+            "size_bytes": obj.size_bytes,
+        })
+    return out
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    return ray_tpu.nodes()
+
+
+def summarize_tasks() -> Dict[str, int]:
+    from ..observability import metrics as _metrics
+
+    summary: Dict[str, int] = {"PENDING": len(list_tasks())}
+    snap = _metrics.metrics_summary()
+    for name, series in snap.items():
+        if name == "ray_tpu_tasks_finished":
+            summary["FINISHED"] = int(sum(series.values()))
+        if name == "ray_tpu_tasks_failed":
+            summary["FAILED"] = int(sum(series.values()))
+    return summary

@@ -1,0 +1,109 @@
+"""Timeline recording, metrics, state API (reference:
+util/state/api.py + ray.timeline + util/metrics.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.observability import metrics as rt_metrics
+from ray_tpu.observability.timeline import clear as clear_timeline
+from ray_tpu.util import state as rt_state
+
+
+@pytest.fixture(autouse=True)
+def fresh_buffers():
+    clear_timeline()
+    rt_metrics.reset_metrics()
+    yield
+
+
+def test_timeline_records_task_spans(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(3)]) == [1, 2, 3]
+    events = ray_tpu.timeline()
+    spans = [e for e in events if e.get("args", {}).get("kind") == "task"]
+    assert len(spans) >= 3
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in spans)
+    assert any(e["name"].endswith("work") or "work" in e["name"]
+               for e in spans)
+    # File export round-trips.
+    out = ray_tpu.timeline(str(tmp_path / "trace.json"))
+    import json
+
+    with open(out) as f:
+        assert len(json.load(f)) == len(events)
+
+
+def test_timeline_records_actor_calls(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 7
+
+    a = A.remote()
+    assert ray_tpu.get(a.m.remote()) == 7
+    kinds = {e["args"]["kind"] for e in ray_tpu.timeline()
+             if e.get("args", {}).get("kind")}
+    assert "actor_task" in kinds
+    assert "actor_creation" in kinds
+
+
+def test_runtime_counters(ray_start_regular):
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    @ray_tpu.remote
+    def bad():
+        raise ValueError("x")
+
+    ray_tpu.get([ok.remote() for _ in range(4)])
+    with pytest.raises(Exception):
+        ray_tpu.get(bad.options(max_retries=0).remote())
+    summary = rt_metrics.metrics_summary()
+    assert sum(summary["ray_tpu_tasks_finished"].values()) >= 4
+    assert sum(summary["ray_tpu_tasks_failed"].values()) >= 1
+    assert sum(summary["ray_tpu_task_seconds"].values()) >= 0
+
+
+def test_user_metrics_api(ray_start_regular):
+    c = rt_metrics.Counter("my_counter", tag_keys=("route",))
+    c.inc(2, tags={"route": "a"})
+    c.inc(3, tags={"route": "b"})
+    g = rt_metrics.Gauge("my_gauge")
+    g.set(1.5)
+    h = rt_metrics.Histogram("my_hist", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+    s = rt_metrics.metrics_summary()
+    assert s["my_counter"]["a"] == 2
+    assert s["my_counter"]["b"] == 3
+    assert s["my_gauge"][""] == 1.5
+    assert h.buckets() == [1, 1, 1]
+
+
+def test_state_lists(ray_start_regular):
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return 1
+
+    h = Holder.options(name="observed").remote()
+    ray_tpu.get(h.ping.remote())
+    ref = ray_tpu.put(np.arange(16))
+
+    actors = rt_state.list_actors()
+    assert any(a["name"] == "observed" for a in actors)
+    objects = rt_state.list_objects()
+    assert any(o["size_bytes"] and not o["is_error"] for o in objects)
+    nodes = rt_state.list_nodes()
+    assert len(nodes) >= 1
+    done = rt_state.list_tasks(include_done=True)
+    assert any(t["state"] == "FINISHED" for t in done)
+    summary = rt_state.summarize_tasks()
+    assert summary["FINISHED"] >= 1
+    del ref
