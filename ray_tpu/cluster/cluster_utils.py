@@ -41,7 +41,22 @@ class Cluster:
             # self._procs must not count) + the driver node if connected.
             live = sum(1 for p in self._procs if p.poll() is None)
             alive_target = live + (1 if self._connected else 0)
-            wait_for_nodes(self.head_address, alive_target, timeout=60.0)
+            try:
+                wait_for_nodes(self.head_address, alive_target,
+                               timeout=60.0)
+            except TimeoutError as e:
+                # Surface the worker's own output — a silent 60s wait
+                # with no diagnosis is undebuggable.
+                out = b""
+                proc.kill()
+                try:
+                    out, _ = proc.communicate(timeout=5)
+                except Exception:
+                    pass
+                raise TimeoutError(
+                    f"{e}; worker rc={proc.poll()} output:\n"
+                    f"{(out or b'').decode(errors='replace')[-2000:]}"
+                ) from None
         return proc
 
     def connect(self, **kwargs):

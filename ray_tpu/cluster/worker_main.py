@@ -19,6 +19,18 @@ import time
 
 
 def main(argv=None) -> int:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # This environment's sitecustomize force-registers the axon TPU
+        # plugin and overwrites jax_platforms at interpreter start, so
+        # the env var alone does not stick — and a worker that probes
+        # the (possibly busy) tunneled TPU can hang its registration
+        # past the cluster fixture's timeout.  Same pattern as
+        # tests/conftest.py and __graft_entry__.py.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--head", required=True)
     ap.add_argument("--num-cpus", type=float, default=None)
