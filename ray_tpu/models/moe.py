@@ -1,0 +1,175 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Reference: Ray implements NO MoE/EP (SURVEY §2.3 — it only offers
+placement-group primitives); the TPU build must supply the strategy
+natively.  Design is GShard/Switch-style DENSE dispatch, the
+TPU-idiomatic formulation: top-k routing builds a (tokens, experts,
+capacity) one-hot dispatch tensor, so dispatch/combine are einsums
+that run on the MXU with static shapes — no ragged buffers, no
+data-dependent shapes.  Sharding the expert dimension over the
+``expert`` mesh axis (logical axis "expert") makes XLA lower the
+dispatch/combine einsums to all_to_all over ICI automatically.
+
+Tokens beyond an expert's capacity are dropped (their combine weight
+is zero and the residual path carries them) — standard Switch
+semantics; ``capacity_factor`` trades drop rate for padding compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import with_logical_constraint
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    intermediate_size: int
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+
+def init_moe_params(rng: jax.Array, config: MoEConfig,
+                    dtype=jnp.float32) -> PyTree:
+    c = config
+    k_router, k_gate, k_up, k_down = jax.random.split(rng, 4)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                            jnp.float32)
+                * fan_in ** -0.5).astype(dtype)
+
+    E, D, H = c.n_experts, c.hidden_size, c.intermediate_size
+    return {
+        "router": dense(k_router, (D, E), D),
+        "w_gate": dense(k_gate, (E, D, H), D),
+        "w_up": dense(k_up, (E, D, H), D),
+        "w_down": dense(k_down, (E, H, D), H),
+    }
+
+
+def moe_param_logical_axes() -> Dict[str, Tuple]:
+    return {
+        "router": (None, "expert"),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def _einsum(eq, *args):
+    """bf16×bf16 einsum with f32 MXU accumulation (same measured
+    rationale as llama.matmul: operand-dtype accumulation drops XLA
+    onto a ~4-5x slower path)."""
+    out = jnp.einsum(eq, *args, preferred_element_type=jnp.float32)
+    return out.astype(args[0].dtype)
+
+
+def _route(xt: jax.Array, router: jax.Array, k: int):
+    """Shared by moe_ffn and the parity reference so the two can't
+    drift: f32 softmax routing + renormalized top-k gates."""
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                        router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)          # (T, E)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (T, K)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    return probs, gate_vals, expert_idx
+
+
+def moe_ffn(x: jax.Array, params: PyTree, config: MoEConfig
+            ) -> Tuple[jax.Array, jax.Array]:
+    """x: (B, S, D) → (out (B, S, D), aux_loss scalar).
+
+    aux_loss is the Switch load-balancing loss (mean fraction of
+    tokens per expert × mean router prob per expert × E); add it to
+    the training loss scaled by ~1e-2."""
+    c = config
+    B, S, D = x.shape
+    T = B * S
+    E, K = c.n_experts, c.top_k
+    dt = c.dtype
+    xt = x.reshape(T, D).astype(dt)
+
+    probs, gate_vals, expert_idx = _route(xt, params["router"], K)
+
+    capacity = int(max(1, round(T * K / E * c.capacity_factor)))
+
+    # Position of each (token, k) within its expert's capacity buffer:
+    # cumulative count of prior assignments to the same expert.
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (T,K,E)
+    flat = onehot.reshape(T * K, E)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(T, K, E)
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)            # (T, K)
+    keep = pos < capacity
+
+    # Dense dispatch tensor (T, E, C): 1 where token t goes to slot
+    # (e, c).  combine = dispatch weighted by the gate.
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    t_idx = jnp.arange(T)[:, None].repeat(K, 1)
+    dispatch = dispatch.at[
+        t_idx.reshape(-1),
+        expert_idx.reshape(-1),
+        jnp.clip(pos, 0, capacity - 1).reshape(-1),
+    ].add(keep.astype(jnp.float32).reshape(-1))
+    gate_te = jnp.zeros((T, E), jnp.float32).at[
+        t_idx.reshape(-1), expert_idx.reshape(-1)
+    ].add((gate_vals * keep).reshape(-1))
+    combine = dispatch * gate_te[:, :, None]
+
+    # Expert inputs (E, C, D): the einsum's sharding constraint on the
+    # expert dim is what turns this into an all_to_all over ICI.
+    expert_in = _einsum("tec,td->ecd", dispatch.astype(dt), xt)
+    expert_in = with_logical_constraint(expert_in, "expert", None, None)
+
+    h = _einsum("ecd,edh->ech", expert_in, params["w_gate"].astype(dt))
+    u = _einsum("ecd,edh->ech", expert_in, params["w_up"].astype(dt))
+    act = jax.nn.silu(h) * u
+    expert_out = _einsum("ech,ehd->ecd", act,
+                         params["w_down"].astype(dt))
+    expert_out = with_logical_constraint(expert_out,
+                                         "expert", None, None)
+
+    out = _einsum("tec,ecd->td", combine.astype(dt), expert_out)
+
+    # Switch aux loss: E * mean_e(frac_tokens_e * mean_prob_e).
+    top1 = jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32)
+    frac_tokens = top1.mean(0)
+    mean_prob = probs.mean(0)
+    aux = E * jnp.sum(frac_tokens * mean_prob)
+    return out.reshape(B, S, D).astype(x.dtype), aux
+
+
+def moe_ffn_reference(x: jax.Array, params: PyTree, config: MoEConfig
+                      ) -> jax.Array:
+    """Slow per-token loop-free reference (no capacity drops) for
+    parity tests at small shapes: every token visits its top-k experts
+    exactly."""
+    c = config
+    B, S, D = x.shape
+    dt = c.dtype
+    xt = x.reshape(-1, D).astype(dt)
+    _probs, gate_vals, expert_idx = _route(xt, params["router"],
+                                           c.top_k)
+
+    def per_expert(e):
+        h = xt.astype(dt) @ params["w_gate"][e].astype(dt)
+        u = xt.astype(dt) @ params["w_up"][e].astype(dt)
+        return (jax.nn.silu(h) * u) @ params["w_down"][e].astype(dt)
+
+    all_out = jnp.stack([per_expert(e)
+                         for e in range(c.n_experts)])  # (E, T, D)
+    out = jnp.zeros_like(xt, dtype=jnp.float32)
+    for k in range(c.top_k):
+        picked = all_out[expert_idx[:, k], jnp.arange(xt.shape[0])]
+        out = out + gate_vals[:, k:k + 1] * picked.astype(jnp.float32)
+    return out.reshape(B, S, D).astype(x.dtype)

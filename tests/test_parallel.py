@@ -217,3 +217,61 @@ def test_pipeline_train_step_runs():
         state, metrics = step(state, {"tokens": tokens})
         state, metrics = step(state, {"tokens": tokens})
         assert 0.0 < float(metrics["loss"]) < 20.0
+
+
+def test_moe_parity_and_aux_loss():
+    """Dense-dispatch MoE matches the per-token reference when
+    capacity is ample; aux loss is near 1 for near-uniform routing."""
+    from ray_tpu.models import moe
+
+    cfg = moe.MoEConfig(hidden_size=32, intermediate_size=64,
+                        n_experts=4, top_k=2, capacity_factor=4.0,
+                        dtype=jnp.float32)
+    params = moe.init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 32))
+    out, aux = moe.moe_ffn(x, params, cfg)
+    ref = moe.moe_ffn_reference(x, params, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_moe_expert_parallel_mesh():
+    """Expert-sharded MoE compiles + runs + differentiates on the
+    simulated mesh (expert axis 4 × data 2): the sharding constraints
+    make XLA insert the all_to_all dispatch."""
+    from ray_tpu.models import moe
+    from ray_tpu.parallel import shard_params
+
+    cfg = moe.MoEConfig(hidden_size=32, intermediate_size=64,
+                        n_experts=8, top_k=2, dtype=jnp.float32)
+    mesh = MeshSpec(expert=4, data=2).build()
+    params = moe.init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (4, 16, 32))
+    ref, _ = moe.moe_ffn(x, params, cfg)  # unsharded reference
+    with use_mesh(mesh):
+        sharded = shard_params(params, moe.moe_param_logical_axes())
+
+        @jax.jit
+        def f(p, x):
+            out, aux = moe.moe_ffn(x, p, cfg)
+            return out, aux
+
+        # The sharding constraints must actually shard the expert dim:
+        # the compiled module contains an all-to-all (or equivalent
+        # collective-permute dispatch) over the expert axis.
+        hlo = f.lower(sharded, x).compile().as_text()
+        assert ("all-to-all" in hlo or "collective-permute" in hlo
+                or "all-gather" in hlo), "expert dim not distributed"
+        out, aux = f(sharded, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+        @jax.jit
+        def loss(p, x):
+            out, aux = moe.moe_ffn(x, p, cfg)
+            return jnp.sum(out ** 2) + 0.01 * aux
+
+        grads = jax.grad(loss)(sharded, x)
+        for g in jax.tree.leaves(grads):
+            assert bool(jnp.all(jnp.isfinite(g)))
