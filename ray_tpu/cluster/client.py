@@ -47,6 +47,8 @@ class ClusterClient:
         # actor_id -> FIFO of specs waiting out a restart (one waiter
         # thread per actor preserves call order and bounds head load).
         self._restart_queues: Dict[Any, list] = {}
+        # oid -> owner address for objects this node borrowed.
+        self._borrowed: Dict[Any, str] = {}
         self._loc_lock = threading.Lock()
         self._stopped = threading.Event()
         # (expiry, demand) of the last failed spill placement.
@@ -167,7 +169,7 @@ class ClusterClient:
         def on_done(result, is_error):
             if is_error:
                 # Transport failure → node presumed dead → retriable.
-                self._report_node_failure(node_id)
+                self._report_node_failure(node_id, address)
                 spec.exclude_node(node_id)
                 self.runtime.task_manager.complete_error(
                     spec, NodeDiedError(
@@ -198,20 +200,34 @@ class ClusterClient:
             raise RuntimeError(resp.get("error", "placement failed"))
         return resp["node_id"], resp["address"]
 
-    def _report_node_failure(self, node_id: str):
+    def _report_node_failure(self, node_id: str,
+                             address: Optional[str] = None):
         try:
             self.head.call("report_node_failure", {"node_id": node_id},
                            timeout=5.0)
         except Exception:
             pass
         with self._loc_lock:
-            for aid in [a for a, (n, _addr) in
-                        self._actor_locations.items() if n == node_id]:
+            stale = [a for a, (n, addr) in
+                     self._actor_locations.items()
+                     if n == node_id or (address and addr == address)]
+            for aid in stale:
                 del self._actor_locations[aid]
+        if address:
+            # Objects the dead node borrowed must not stay pinned here.
+            self.runtime.reference_counter.remove_borrower_node(address)
 
     # ------------------------------------------------------------ objects
     def fetch_object(self, ref) -> None:
-        """Pull an object from its owner and seal a local copy."""
+        """Pull an object from its owner and seal a local copy.  The
+        fetch registers this node as a BORROWER with the owner
+        (reference_count.h:64): the owner keeps the value alive until
+        every borrower's cached copy goes out of scope and releases.
+
+        Known gap vs the reference: the borrow registers at FETCH
+        time, so a nested ref that crosses the wire but is never
+        fetched does not hold the object — the reference registers
+        borrowers at deserialization via owner-assigned metadata."""
         from ..core.object_store import RayObject
         from ..exceptions import OwnerDiedError
 
@@ -219,7 +235,8 @@ class ClusterClient:
         owner = ref.owner_address()
         try:
             resp = self.pool.get(owner).call(
-                "get_object", {"oid": oid}, timeout=300.0)
+                "get_object", {"oid": oid, "borrower": self.address},
+                timeout=300.0)
         except (ConnectionError, TimeoutError) as e:
             self.runtime.object_store.put(
                 oid, RayObject(error=OwnerDiedError(
@@ -229,8 +246,27 @@ class ClusterClient:
             self.runtime.object_store.put(
                 oid, RayObject(error=resp["error"]))
         else:
+            if resp.get("borrow_registered"):
+                with self._loc_lock:
+                    self._borrowed[oid] = owner
             self.runtime.object_store.put(
                 oid, RayObject(sealed=from_wire(resp["data"])))
+
+    def release_borrowed(self, oid) -> None:
+        """Called when this node's cached copy goes out of scope: tell
+        the owner to drop our borrower hold (fire-and-forget; a dead
+        owner means there is nothing left to release)."""
+        with self._loc_lock:
+            owner = self._borrowed.pop(oid, None)
+        if owner is None:
+            return
+        try:
+            self.pool.get(owner).call_async(
+                "release_borrower",
+                {"oid": oid, "borrower": self.address},
+                callback=lambda _r, _e: None)
+        except Exception:
+            pass
 
     def ensure_local(self, ref) -> None:
         owner = ref.owner_address()
@@ -496,6 +532,7 @@ class NodeServer:
             "actor_ready": self._actor_ready,
             "kill_actor": self._kill_actor,
             "get_object": self._get_object,
+            "release_borrower": self._release_borrower,
             "ping": lambda p: "pong",
         }, ordered={"actor_call"})
         self.address = self._server.address
@@ -587,7 +624,18 @@ class NodeServer:
                                                      timeout=300.0)
         if obj.is_error():
             return {"error": obj.error, "data": None}
-        return {"error": None, "data": to_wire(obj.sealed)}
+        registered = False
+        borrower = p.get("borrower")
+        if borrower:
+            registered = self.runtime.reference_counter.add_borrower(
+                p["oid"], borrower)
+        return {"error": None, "data": to_wire(obj.sealed),
+                "borrow_registered": registered}
+
+    def _release_borrower(self, p):
+        self.runtime.reference_counter.remove_borrower(
+            p["oid"], p["borrower"])
+        return {"ok": True}
 
     def shutdown(self):
         self._server.shutdown()

@@ -23,14 +23,22 @@ from .ids import ObjectID, TaskID
 class _Ref:
     # Lineage pinning itself lives in TaskManager._lineage_refcount;
     # this table only counts references.
-    __slots__ = ("local_refs", "submitted_task_refs")
+    __slots__ = ("local_refs", "submitted_task_refs", "borrowers")
 
     def __init__(self):
         self.local_refs = 0
         self.submitted_task_refs = 0
+        # Remote nodes (by object-service address) holding fetched
+        # copies (reference borrower protocol, reference_count.h:64).
+        # A COUNT per address, not a set: releases are async and
+        # unordered, so release-then-refetch must net to one hold
+        # regardless of arrival order (set semantics has an ABA race
+        # where a stale release cancels a fresh borrow).
+        self.borrowers: Dict[str, int] = {}
 
     def total(self) -> int:
-        return self.local_refs + self.submitted_task_refs
+        return (self.local_refs + self.submitted_task_refs
+                + sum(self.borrowers.values()))
 
 
 class ReferenceCounter:
@@ -62,6 +70,49 @@ class ReferenceCounter:
         for oid in object_ids:
             self._decrement(oid, "submitted_task_refs")
 
+    def add_borrower(self, object_id: ObjectID, borrower: str):
+        """An owner-side hold for a remote node that fetched a copy;
+        the value stays alive until every borrower releases."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return False  # already out of scope: borrow refused
+            ref.borrowers[borrower] = ref.borrowers.get(borrower, 0) + 1
+            return True
+
+    def remove_borrower(self, object_id: ObjectID, borrower: str):
+        to_free: Optional[ObjectID] = None
+        listeners = []
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            n = ref.borrowers.get(borrower, 0) - 1
+            if n > 0:
+                ref.borrowers[borrower] = n
+            else:
+                ref.borrowers.pop(borrower, None)
+            if ref.total() == 0:
+                del self._refs[object_id]
+                to_free = object_id
+                listeners = self._out_of_scope_listeners.pop(object_id, [])
+        self._fire(to_free, listeners)
+
+    def remove_borrower_node(self, borrower: str):
+        """Drop every hold a (dead) borrower node had — without this,
+        objects it fetched stay pinned at their owners forever."""
+        to_free = []
+        with self._lock:
+            for oid, ref in list(self._refs.items()):
+                if (ref.borrowers.pop(borrower, None) is not None
+                        and ref.total() == 0):
+                    del self._refs[oid]
+                    to_free.append(
+                        (oid,
+                         self._out_of_scope_listeners.pop(oid, [])))
+        for oid, listeners in to_free:
+            self._fire(oid, listeners)
+
     def _decrement(self, object_id: ObjectID, field: str):
         to_free: Optional[ObjectID] = None
         listeners = []
@@ -74,6 +125,9 @@ class ReferenceCounter:
                 del self._refs[object_id]
                 to_free = object_id
                 listeners = self._out_of_scope_listeners.pop(object_id, [])
+        self._fire(to_free, listeners)
+
+    def _fire(self, to_free: Optional[ObjectID], listeners):
         if to_free is not None:
             self._on_out_of_scope(to_free)
             for cb in listeners:

@@ -62,7 +62,7 @@ class Runtime:
 
         self.object_store = MemoryStore()
         self.reference_counter = ReferenceCounter(
-            on_object_out_of_scope=self.object_store.free)
+            on_object_out_of_scope=self._free_object)
         self.streaming_manager = StreamingGeneratorManager()
         self.task_manager = TaskManager(self)
         self.node_resources = ResourceSet(
@@ -107,6 +107,13 @@ class Runtime:
             idx = self._put_counters.get(task_id, 0)
             self._put_counters[task_id] = idx + 1
         return ObjectID.for_put(task_id, idx)
+
+    def _free_object(self, oid: ObjectID):
+        """Out-of-scope hook: free the local copy; if it was borrowed
+        from another node, release our hold with the owner."""
+        self.object_store.free(oid)
+        if self.cluster is not None:
+            self.cluster.release_borrowed(oid)
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:

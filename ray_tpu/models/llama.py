@@ -153,6 +153,14 @@ def param_logical_axes(config: LlamaConfig) -> Dict[str, Any]:
     return axes
 
 
+def init_dense(key, shape, fan_in, dtype=jnp.float32):
+    """Truncated-normal fan-in-scaled initializer shared across model
+    families (llama, moe)."""
+    scale = fan_in ** -0.5
+    return (jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                        jnp.float32) * scale).astype(dtype)
+
+
 def init_params(rng: jax.Array, config: LlamaConfig,
                 dtype: Any = jnp.float32) -> PyTree:
     """Initialize the stacked-layer param pytree (truncated-normal,
@@ -161,9 +169,7 @@ def init_params(rng: jax.Array, config: LlamaConfig,
     keys = jax.random.split(rng, 8)
 
     def dense(key, shape, fan_in):
-        scale = fan_in ** -0.5
-        return (jax.random.truncated_normal(key, -2.0, 2.0, shape,
-                                            jnp.float32) * scale).astype(dtype)
+        return init_dense(key, shape, fan_in, dtype)
 
     L = c.n_layers
     params = {

@@ -40,13 +40,13 @@ class MoEConfig:
 
 def init_moe_params(rng: jax.Array, config: MoEConfig,
                     dtype=jnp.float32) -> PyTree:
+    from ray_tpu.models.llama import init_dense
+
     c = config
     k_router, k_gate, k_up, k_down = jax.random.split(rng, 4)
 
     def dense(key, shape, fan_in):
-        return (jax.random.truncated_normal(key, -2.0, 2.0, shape,
-                                            jnp.float32)
-                * fan_in ** -0.5).astype(dtype)
+        return init_dense(key, shape, fan_in, dtype)
 
     E, D, H = c.n_experts, c.hidden_size, c.intermediate_size
     return {

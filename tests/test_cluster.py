@@ -508,3 +508,54 @@ class TestActorRestart:
 
         with pytest.raises(ActorDiedError):
             ray_tpu.get(a.incr.remote(), timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# Borrower protocol (reference: reference_count.h:64 — owners keep
+# values alive while remote fetched copies exist)
+# ---------------------------------------------------------------------------
+
+class TestBorrowerProtocol:
+    def test_free_while_borrowed_is_safe(self, cluster):
+        import gc
+
+        @ray_tpu.remote
+        class Holder:
+            def hold(self, ref_list):
+                # Nested refs are NOT auto-resolved (top-level args
+                # are); keep the deserialized ref in actor state and
+                # fetch it now — the fetch caches a copy and registers
+                # this node as a borrower with the owner.
+                self.ref = ref_list[0]
+                ray_tpu.get(self.ref)
+                return True
+
+            def read(self):
+                return int(ray_tpu.get(self.ref).sum())
+
+            def drop(self):
+                self.ref = None
+                gc.collect()
+                return True
+
+        rt = ray_tpu.get_runtime()
+        ref = ray_tpu.put(np.arange(100))
+        oid = ref.object_id()
+        h = Holder.options(resources={"worker0": 1}).remote()
+        assert ray_tpu.get(h.hold.remote([ref]))
+        # Drop the owner's only local reference: the borrower's hold
+        # must keep the value alive at the owner.
+        del ref
+        gc.collect()
+        time.sleep(0.3)
+        assert rt.object_store.contains(oid), \
+            "owner freed a borrowed object"
+        assert ray_tpu.get(h.read.remote()) == sum(range(100))
+        # Borrower releases -> owner frees.
+        assert ray_tpu.get(h.drop.remote())
+        deadline = time.monotonic() + 10
+        while rt.object_store.contains(oid):
+            assert time.monotonic() < deadline, \
+                "owner never freed after the borrower released"
+            time.sleep(0.1)
+        ray_tpu.kill(h)
