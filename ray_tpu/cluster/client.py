@@ -49,6 +49,11 @@ class ClusterClient:
         self._restart_queues: Dict[Any, list] = {}
         # oid -> owner address for objects this node borrowed.
         self._borrowed: Dict[Any, str] = {}
+        # oid -> Event: fetches in flight.  Deduplicates concurrent
+        # fetches of one object so the owner records exactly one hold
+        # per borrower copy (ADVICE r3: two racing fetches registered
+        # two holds but release_borrowed dropped only one).
+        self._fetching: Dict[Any, threading.Event] = {}
         self._loc_lock = threading.Lock()
         self._stopped = threading.Event()
         # (expiry, demand) of the last failed spill placement.
@@ -187,7 +192,7 @@ class ClusterClient:
             self.pool.get(address).call_async(
                 "push_task", bundle, callback=on_done)
         except ConnectionError as e:
-            self._report_node_failure(node_id)
+            self._report_node_failure(node_id, address)
             spec.exclude_node(node_id)
             self.runtime.task_manager.complete_error(
                 spec, NodeDiedError(f"push to {node_id[:8]} failed: {e}"))
@@ -247,8 +252,20 @@ class ClusterClient:
                 oid, RayObject(error=resp["error"]))
         else:
             if resp.get("borrow_registered"):
+                dup = False
                 with self._loc_lock:
-                    self._borrowed[oid] = owner
+                    if oid in self._borrowed:
+                        dup = True  # a racing fetch already holds one
+                    else:
+                        self._borrowed[oid] = owner
+                if dup:
+                    try:
+                        self.pool.get(owner).call_async(
+                            "release_borrower",
+                            {"oid": oid, "borrower": self.address},
+                            callback=lambda _r, _e: None)
+                    except Exception:
+                        pass
             self.runtime.object_store.put(
                 oid, RayObject(sealed=from_wire(resp["data"])))
 
@@ -272,9 +289,24 @@ class ClusterClient:
         owner = ref.owner_address()
         if not owner or owner == self.address:
             return
-        if self.runtime.object_store.contains(ref.object_id()):
+        oid = ref.object_id()
+        store = self.runtime.object_store
+        while not store.contains(oid):
+            with self._loc_lock:
+                ev = self._fetching.get(oid)
+                mine = ev is None
+                if mine:
+                    ev = self._fetching[oid] = threading.Event()
+            if not mine:
+                ev.wait(timeout=310.0)
+                continue  # loser re-checks the store
+            try:
+                self.fetch_object(ref)
+            finally:
+                with self._loc_lock:
+                    self._fetching.pop(oid, None)
+                ev.set()
             return
-        self.fetch_object(ref)
 
     def ensure_args_local(self, args, kwargs) -> None:
         from ..core.object_ref import ObjectRef
@@ -376,16 +408,26 @@ class ClusterClient:
         if loc is None and error is None:
             error = ActorDiedError(
                 actor_id, "timed out waiting for the actor to restart")
-        with self._loc_lock:
-            queued = self._restart_queues.pop(actor_id, [])
-            if loc is not None:
-                self._actor_locations[actor_id] = loc
-        for spec in queued:
-            if loc is not None:
-                self.submit_remote_actor_task(spec, loc)
-            else:
-                self.runtime.task_manager.complete_error(
-                    spec, error, allow_retry=False)
+        # Drain the FIFO BEFORE publishing the new location: were the
+        # location visible first, a concurrent caller could locate the
+        # actor ALIVE and push a new call ahead of the queued ones
+        # (ADVICE r3).  New resubmits landing mid-flush append to the
+        # still-registered queue and drain on the next pass.
+        while True:
+            with self._loc_lock:
+                queued = self._restart_queues.get(actor_id, [])
+                if not queued:
+                    self._restart_queues.pop(actor_id, None)
+                    if loc is not None:
+                        self._actor_locations[actor_id] = loc
+                    break
+                self._restart_queues[actor_id] = []
+            for spec in queued:
+                if loc is not None:
+                    self.submit_remote_actor_task(spec, loc)
+                else:
+                    self.runtime.task_manager.complete_error(
+                        spec, error, allow_retry=False)
 
     def locate_actor(self, actor_id) -> Optional[Tuple[str, str]]:
         loc, _state = self.locate_actor_with_state(actor_id)
@@ -396,6 +438,12 @@ class ClusterClient:
         stored location is its DEAD node — callers must wait (the
         resubmit path) rather than push there."""
         with self._loc_lock:
+            if actor_id in self._restart_queues:
+                # The waiter is still draining this actor's FIFO: even
+                # if the head already reports ALIVE, a direct push now
+                # would jump ahead of the queued calls.  Report
+                # RESTARTING so the caller appends to the queue.
+                return None, "RESTARTING"
             loc = self._actor_locations.get(actor_id)
         if loc is not None:
             return loc, "ALIVE"
@@ -407,6 +455,10 @@ class ClusterClient:
         loc = (resp["node_id"], resp["address"])
         if state == "ALIVE":
             with self._loc_lock:
+                if actor_id in self._restart_queues:
+                    # Drain began between our two lock sections: do not
+                    # re-open the cached fast path mid-drain.
+                    return None, "RESTARTING"
                 self._actor_locations[actor_id] = loc
         return loc, state
 
@@ -437,7 +489,7 @@ class ClusterClient:
                 # Transport death is retriable when the actor has
                 # max_task_retries budget (spec.max_retries carries it);
                 # the retry waits out the head-driven restart.
-                self._report_node_failure(node_id)
+                self._report_node_failure(node_id, address)
                 self.runtime.task_manager.complete_error(
                     spec, ActorDiedError(
                         spec.actor_id,
@@ -455,7 +507,7 @@ class ClusterClient:
             self.pool.get(address).call_async(
                 "actor_call", bundle, callback=on_done)
         except ConnectionError as e:
-            self._report_node_failure(node_id)
+            self._report_node_failure(node_id, address)
             self.runtime.task_manager.complete_error(
                 spec, ActorDiedError(spec.actor_id,
                                      f"actor node unreachable: {e}"))

@@ -159,9 +159,10 @@ class HeadServer:
         gone = []
         for aid in dead:
             info = self._actors[aid]
+            mr = info.get("max_restarts", 0)
             if (info.get("spec") is not None
-                    and info.get("restarts_used", 0) <
-                    info.get("max_restarts", 0)):
+                    and (mr < 0  # max_restarts=-1: infinite budget
+                         or info.get("restarts_used", 0) < mr)):
                 info["state"] = "RESTARTING"
                 self._restart_pending.append(aid)
                 self._restart_cond.notify_all()

@@ -756,7 +756,7 @@ class Runtime:
         receiving node's inline submission of ``actor_call``)."""
         location, actor_state = \
             self.cluster.locate_actor_with_state(actor_id)
-        if location is None:
+        if location is None and actor_state != "RESTARTING":
             raise ValueError(f"no such actor {actor_id!r}")
         n = options.num_returns
         if n == STREAMING:

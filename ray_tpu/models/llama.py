@@ -68,6 +68,14 @@ class LlamaConfig:
     # schedule over pipe stages (parallel/pipeline.py) instead of one
     # scan.  Value = number of microbatches.
     pipeline_microbatches: int = 0
+    # >0 replaces every layer's dense FFN with a GShard/Switch MoE FFN
+    # (models/moe.py) of this many experts, sharded over the "expert"
+    # mesh axis.  The Switch aux loss is added to the training loss
+    # scaled by moe_aux_weight.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def q_dim(self) -> int:
@@ -84,6 +92,25 @@ class LlamaConfig:
                     n_kv_heads=2, head_dim=16, intermediate_size=128,
                     max_seq_len=128, rope_theta=10000.0, remat=False,
                     tie_embeddings=True)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def moe_debug(cls, **kw) -> "LlamaConfig":
+        """Tiny MoE config (expert-parallel dryruns/tests on CPU)."""
+        base = dict(moe_experts=4, moe_top_k=2)
+        base.update(kw)
+        return cls.debug(**base)
+
+    @classmethod
+    def llama_moe_1b(cls, **kw) -> "LlamaConfig":
+        """Switch-style MoE bench model: 8 experts over the 440M dense
+        trunk (~1.6B total params, ~440M active/token)."""
+        base = dict(vocab_size=32000, hidden_size=1024, n_layers=24,
+                    n_heads=16, n_kv_heads=16, head_dim=64,
+                    intermediate_size=4096, max_seq_len=2048,
+                    rope_theta=10000.0, tie_embeddings=True,
+                    attention_impl="flash", moe_experts=8, moe_top_k=2)
         base.update(kw)
         return cls(**base)
 
@@ -133,6 +160,19 @@ class LlamaConfig:
 
 def param_logical_axes(config: LlamaConfig) -> Dict[str, Any]:
     """Pytree (matching init_params) of per-dim logical axis names."""
+    if config.moe_experts > 0:
+        ffn_axes = {
+            "router": ("layers", None, "expert"),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        }
+    else:
+        ffn_axes = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
     axes = {
         "embed_tokens": ("vocab", "embed"),
         "layers": {
@@ -142,9 +182,7 @@ def param_logical_axes(config: LlamaConfig) -> Dict[str, Any]:
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
             "mlp_norm": ("layers", None),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **ffn_axes,
         },
         "final_norm": (None,),
     }
@@ -172,6 +210,29 @@ def init_params(rng: jax.Array, config: LlamaConfig,
         return init_dense(key, shape, fan_in, dtype)
 
     L = c.n_layers
+    if c.moe_experts > 0:
+        E = c.moe_experts
+        ffn = {
+            "router": dense(keys[5], (L, c.hidden_size, E), c.hidden_size),
+            "w_gate": dense(keys[6],
+                            (L, E, c.hidden_size, c.intermediate_size),
+                            c.hidden_size),
+            "w_up": dense(jax.random.fold_in(keys[6], 1),
+                          (L, E, c.hidden_size, c.intermediate_size),
+                          c.hidden_size),
+            "w_down": dense(keys[7],
+                            (L, E, c.intermediate_size, c.hidden_size),
+                            c.intermediate_size),
+        }
+    else:
+        ffn = {
+            "w_gate": dense(keys[5], (L, c.hidden_size, c.intermediate_size),
+                            c.hidden_size),
+            "w_up": dense(keys[6], (L, c.hidden_size, c.intermediate_size),
+                          c.hidden_size),
+            "w_down": dense(keys[7], (L, c.intermediate_size, c.hidden_size),
+                            c.intermediate_size),
+        }
     params = {
         "embed_tokens": dense(keys[0], (c.vocab_size, c.hidden_size),
                               c.hidden_size),
@@ -182,12 +243,7 @@ def init_params(rng: jax.Array, config: LlamaConfig,
             "wv": dense(keys[3], (L, c.hidden_size, c.kv_dim), c.hidden_size),
             "wo": dense(keys[4], (L, c.q_dim, c.hidden_size), c.q_dim),
             "mlp_norm": jnp.ones((L, c.hidden_size), dtype),
-            "w_gate": dense(keys[5], (L, c.hidden_size, c.intermediate_size),
-                            c.hidden_size),
-            "w_up": dense(keys[6], (L, c.hidden_size, c.intermediate_size),
-                          c.hidden_size),
-            "w_down": dense(keys[7], (L, c.intermediate_size, c.hidden_size),
-                            c.intermediate_size),
+            **ffn,
         },
         "final_norm": jnp.ones((c.hidden_size,), dtype),
     }
@@ -333,6 +389,30 @@ def _attn_out_mlp(x: jax.Array, attn: jax.Array,
     return with_logical_constraint(x, "batch", "seq", None)
 
 
+def _attn_out_moe(x: jax.Array, attn: jax.Array,
+                  layer: Dict[str, jax.Array],
+                  config: LlamaConfig) -> Tuple[jax.Array, jax.Array]:
+    """MoE twin of _attn_out_mlp: the dense FFN is replaced by the
+    expert-parallel Switch FFN; returns (x, layer aux loss)."""
+    from ray_tpu.models.moe import MoEConfig, moe_ffn
+
+    c = config
+    B, S, _ = x.shape
+    dt = c.dtype
+    x = x + matmul(attn.reshape(B, S, c.q_dim), layer["wo"].astype(dt))
+    x = with_logical_constraint(x, "batch", "seq", None)
+    h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+    mcfg = MoEConfig(hidden_size=c.hidden_size,
+                     intermediate_size=c.intermediate_size,
+                     n_experts=c.moe_experts, top_k=c.moe_top_k,
+                     capacity_factor=c.moe_capacity_factor, dtype=dt)
+    moe_params = {k: layer[k]
+                  for k in ("router", "w_gate", "w_up", "w_down")}
+    ff, aux = moe_ffn(h, moe_params, mcfg)
+    x = x + ff.astype(x.dtype)
+    return with_logical_constraint(x, "batch", "seq", None), aux
+
+
 def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
                   sin: jax.Array, cos: jax.Array, positions: jax.Array,
                   config: LlamaConfig,
@@ -342,13 +422,27 @@ def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
     return _attn_out_mlp(x, attn, layer, config)
 
 
+def decoder_layer_moe(x: jax.Array, layer: Dict[str, jax.Array],
+                      sin: jax.Array, cos: jax.Array,
+                      positions: jax.Array, config: LlamaConfig,
+                      attention_fn: Callable
+                      ) -> Tuple[jax.Array, jax.Array]:
+    q, k, v = _qkv_rope(x, layer, sin, cos, config)
+    attn = attention_fn(q, k, v, positions)
+    return _attn_out_moe(x, attn, layer, config)
+
+
 # ---------------------------------------------------------------------------
 # Forward / loss
 # ---------------------------------------------------------------------------
 
 def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
-            positions: Optional[jax.Array] = None) -> jax.Array:
-    """Logits for next-token prediction.  tokens: (B, S) int32."""
+            positions: Optional[jax.Array] = None,
+            return_aux: bool = False):
+    """Logits for next-token prediction.  tokens: (B, S) int32.
+
+    With ``return_aux=True`` returns (logits, aux) where aux is the
+    summed MoE load-balancing loss over layers (0.0 for dense)."""
     c = config
     if positions is not None and c.attention_impl != "dot":
         # flash/ring mask on raw row index, not positions — packed or
@@ -366,10 +460,13 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
     x = with_logical_constraint(x, "batch", "seq", None)
     sin, cos = rope_table(positions, c.head_dim, c.rope_theta)
 
+    moe = c.moe_experts > 0
+
     def make_block(sin, cos, positions):
-        block = functools.partial(decoder_layer, sin=sin, cos=cos,
-                                  positions=positions, config=c,
-                                  attention_fn=attention_fn)
+        block = functools.partial(
+            decoder_layer_moe if moe else decoder_layer,
+            sin=sin, cos=cos, positions=positions, config=c,
+            attention_fn=attention_fn)
         if c.remat:
             policies = {
                 "full": jax.checkpoint_policies.nothing_saveable,
@@ -384,8 +481,14 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
     from ray_tpu.parallel.sharding import current_mesh
 
     mesh = current_mesh()
+    aux_total = jnp.zeros((), jnp.float32)
     if (c.pipeline_microbatches > 0 and mesh is not None
             and mesh.shape.get("pipe", 1) > 1):
+        if moe:
+            raise NotImplementedError(
+                "MoE layers inside pipeline stages are not supported "
+                "yet (the GPipe schedule carries no aux accumulator); "
+                "use expert parallelism with pipe=1")
         if custom_positions:
             raise NotImplementedError(
                 "pipeline parallelism assumes the default arange "
@@ -410,10 +513,19 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
     else:
         block = make_block(sin, cos, positions)
 
-        def scan_body(carry, layer_params):
-            return block(carry, layer_params), None
+        if moe:
+            def scan_body(carry, layer_params):
+                h, aux = carry
+                h, aux_l = block(h, layer_params)
+                return (h, aux + aux_l), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+            (x, aux_total), _ = jax.lax.scan(
+                scan_body, (x, aux_total), params["layers"])
+        else:
+            def scan_body(carry, layer_params):
+                return block(carry, layer_params), None
+
+            x, _ = jax.lax.scan(scan_body, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     if c.tie_embeddings:
@@ -421,7 +533,10 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
     else:
         head = params["lm_head"].astype(c.dtype)
     logits = matmul(x, head)
-    return with_logical_constraint(logits, "batch", "seq", "vocab")
+    logits = with_logical_constraint(logits, "batch", "seq", "vocab")
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(params: PyTree, batch: Dict[str, jax.Array],
@@ -430,20 +545,23 @@ def loss_fn(params: PyTree, batch: Dict[str, jax.Array],
     optional loss_mask (B,S)."""
     tokens = batch["tokens"]
     positions = batch.get("positions")
+    aux = jnp.zeros((), jnp.float32)
     if positions is None:
         # Run the forward at the full sequence length and drop the last
         # position's logits, instead of slicing tokens to S-1: a
         # 2047-long sequence defeats the flash kernel's block tiling
         # (its fallback materializes S×S f32 scores — measured
         # 2.4s/step vs 1.4s on the 440M bench).
-        logits = forward(params, tokens, config)[:, :-1]
+        logits, aux = forward(params, tokens, config, return_aux=True)
+        logits = logits[:, :-1]
     else:
         # Packed/offset positions (dot-attention path): keep the old
         # S-1 slice so the last raw token never becomes a key — at full
         # length a small positions[S-1] (new-document start) would be
         # attended by every later-positioned query.
-        logits = forward(params, tokens[:, :-1], config,
-                         positions=positions[:, :-1])
+        logits, aux = forward(params, tokens[:, :-1], config,
+                              positions=positions[:, :-1],
+                              return_aux=True)
     targets = tokens[:, 1:]
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -452,9 +570,14 @@ def loss_fn(params: PyTree, batch: Dict[str, jax.Array],
     nll = logz - gold
     mask = batch.get("loss_mask")
     if mask is None:
-        return jnp.mean(nll)
-    mask = mask[:, 1:].astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        ce = jnp.mean(nll)
+    else:
+        mask = mask[:, 1:].astype(jnp.float32)
+        ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if config.moe_experts > 0:
+        # Per-layer mean so the weight is depth-invariant.
+        ce = ce + config.moe_aux_weight * aux / config.n_layers
+    return ce
 
 
 # ---------------------------------------------------------------------------
@@ -560,6 +683,10 @@ def forward_with_cache(params: PyTree, tokens: jax.Array,
     T=prompt_bucket for prefill, T=1 for decode — each T compiles
     once."""
     c = config
+    if c.moe_experts > 0:
+        raise NotImplementedError(
+            "KV-cache decode for MoE models is not implemented yet; "
+            "serve with a dense config")
     B, T = tokens.shape
     dt = c.dtype
     x = params["embed_tokens"].astype(dt)[tokens]

@@ -75,7 +75,13 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         idx = self._pick()
         actor = self._replicas[idx]
-        ref = actor.handle_request.remote(self._method, args, kwargs)
+        try:
+            ref = actor.handle_request.remote(self._method, args, kwargs)
+        except BaseException:
+            # e.g. PendingCallsLimitExceededError: give the slot back or
+            # the router is permanently biased away from this replica.
+            self._release(idx)
+            raise
         resp = DeploymentResponse(ref, on_done=lambda: self._release(idx))
         # Release the slot when the result lands even if .result() is
         # never called (completion callback keeps counts truthful).

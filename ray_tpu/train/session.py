@@ -23,10 +23,16 @@ class TrainContext:
     def __init__(self, *, rank: int, world_size: int, local_rank: int = 0,
                  mesh=None, experiment_name: str = "",
                  storage_path: str = "", datasets=None,
-                 latest_checkpoint: Optional[Checkpoint] = None):
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 colocated: bool = True):
         self._rank = rank
         self._world_size = world_size
         self._local_rank = local_rank
+        # True iff EVERY worker shares the driver process (decided by
+        # the trainer from worker identity handshakes).  Must be
+        # uniform across the gang: the streaming-split router barrier
+        # only works when all `world` consumers live in one process.
+        self._colocated = colocated
         self.mesh = mesh
         self._experiment_name = experiment_name
         self._storage_path = storage_path
@@ -116,6 +122,15 @@ def get_dataset_shard(dataset_name: str = "train"):
     world = s.context.get_world_size()
     # ray_tpu.data.Dataset → streaming split; plain iterables → strided.
     if hasattr(ds, "streaming_split"):
+        # streaming_split's router barrier lives in ONE process.  If
+        # any worker runs outside the driver process it has its own
+        # copy of the module state: its router would wait for ``world``
+        # consumers that never arrive (deadlock, ADVICE r3).  The
+        # trainer decides colocation for the WHOLE gang (identity
+        # handshake), so either every worker shares one router or every
+        # worker strides independently — never a mix.
+        if not s.context._colocated:
+            return _StridedBlockShard(ds, rank, world)
         # One shared split per dataset NAME (not per object: two names
         # bound to the same Dataset need independent executions, or
         # each would see only a fraction of the rows): each worker
@@ -142,6 +157,40 @@ def reset_dataset_shards():
     never advances), and evicting per run bounds the cache."""
     with _split_lock:
         _split_cache.clear()
+
+
+class _StridedBlockShard:
+    """Cross-process dataset shard: this worker process executes the
+    full plan and keeps every ``world``-th block.  Redundant execution
+    traded for correctness where no shared router can exist."""
+
+    def __init__(self, ds, rank: int, world: int):
+        self._ds = ds
+        self._rank = rank
+        self._world = world
+
+    def iter_blocks(self):
+        for i, block in enumerate(self._ds.iter_blocks()):
+            if i % self._world == self._rank:
+                yield block
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     device_put: bool = False):
+        from ray_tpu.data.dataset import _assemble_batches
+
+        return _assemble_batches(
+            self.iter_blocks(), batch_size=batch_size,
+            drop_last=drop_last, batch_format=batch_format,
+            prefetch=prefetch_batches, device_put=device_put)
+
+    def iter_rows(self):
+        from ray_tpu.data.block import BlockAccessor
+
+        for block in self.iter_blocks():
+            yield from BlockAccessor.to_rows(block)
 
 
 class _StridedShard:

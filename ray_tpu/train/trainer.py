@@ -79,11 +79,17 @@ class JaxTrainer:
                 self.scaling_config.worker_resources(),
                 self.scaling_config.placement_strategy)
             try:
+                from .worker_group import process_identity
+
+                mine = process_identity()
+                colocated = all(ident == mine
+                                for ident in group.run_all("identity"))
                 refs = group.run_all_async(
                     "run", self.train_loop_per_worker,
                     self.train_loop_config, self.scaling_config.mesh,
                     collector, name, storage, self.datasets,
-                    latest_ckpt.path if latest_ckpt else None)
+                    latest_ckpt.path if latest_ckpt else None,
+                    colocated)
                 ray_tpu.get(refs)
                 latest_ckpt = self._drain(
                     collector, manager, all_metrics) or latest_ckpt

@@ -55,16 +55,34 @@ class _ReportCollector:
         return self.reports[-1] if self.reports else None
 
 
+def process_identity():
+    """(node_id, pid) of the current process — the driver compares its
+    own against every worker's to decide gang colocation."""
+    import os
+
+    import ray_tpu as _rt
+
+    try:
+        node = _rt.get_runtime_context().get_node_id()
+    except Exception:
+        node = ""
+    return (node, os.getpid())
+
+
 @ray_tpu.remote
 class _TrainWorker:
     def __init__(self, rank: int, world_size: int):
         self.rank = rank
         self.world_size = world_size
 
+    def identity(self):
+        return process_identity()
+
     def run(self, loop_fn: Callable, loop_config: Optional[Dict[str, Any]],
             mesh_spec: Optional[MeshSpec], collector,
             experiment_name: str, storage_path: str,
-            datasets, latest_checkpoint_path: Optional[str]):
+            datasets, latest_checkpoint_path: Optional[str],
+            colocated: bool = True):
         latest = (Checkpoint(latest_checkpoint_path)
                   if latest_checkpoint_path else None)
         mesh = None
@@ -76,7 +94,7 @@ class _TrainWorker:
             rank=self.rank, world_size=self.world_size,
             mesh=mesh, experiment_name=experiment_name,
             storage_path=storage_path, datasets=datasets,
-            latest_checkpoint=latest)
+            latest_checkpoint=latest, colocated=colocated)
         _set_session(_Session(ctx, collector, latest))
         try:
             if mesh is not None:
