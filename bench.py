@@ -71,6 +71,44 @@ def _serve_bench(n_requests: int = 32) -> dict:
     }
 
 
+def _object_plane_bench(size_bytes: int) -> dict:
+    """Node-to-node primary-copy pull: a worker subprocess produces a
+    big array (pinned as a primary on its node); the driver times the
+    chunked materialization (pull_manager.h:52 analogue).  Loopback TCP
+    bounds the absolute number; the point is the protocol overhead."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2, resources={"holder": 1})
+    c.connect(num_cpus=2)
+    try:
+        @ray_tpu.remote(resources={"holder": 1})
+        def produce(n):
+            rng = np.random.default_rng(0)
+            return rng.integers(0, 255, n, dtype=np.uint8)
+
+        ref = produce.remote(size_bytes)
+        rt = ray_tpu.get_runtime()
+        # Wait for the location record (production time excluded).
+        obj = rt.object_store.wait_and_get(ref.object_id(), 300.0)
+        assert obj.location is not None, "expected a primary-copy return"
+        t0 = time.perf_counter()
+        out = ray_tpu.get(ref, timeout=600)
+        dt = time.perf_counter() - t0
+        assert out.nbytes == size_bytes
+        return {
+            "object_pull_gbytes_per_s": round(size_bytes / dt / 1e9, 2),
+            "object_pull_mb": size_bytes // (1024 * 1024),
+        }
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -157,6 +195,12 @@ def main():
             extra.update(_serve_bench())
         except Exception as e:  # noqa: BLE001
             extra["serve_error"] = f"{type(e).__name__}: {e}"
+
+    try:
+        extra.update(_object_plane_bench(
+            1024 * 1024 * 1024 if on_tpu else 64 * 1024 * 1024))
+    except Exception as e:  # noqa: BLE001
+        extra["object_pull_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

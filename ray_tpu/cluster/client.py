@@ -49,6 +49,10 @@ class ClusterClient:
         self._restart_queues: Dict[Any, list] = {}
         # oid -> owner address for objects this node borrowed.
         self._borrowed: Dict[Any, str] = {}
+        # oid -> (node_id, address) of the pinned primary copy for
+        # objects THIS node owns (ownership-based object directory,
+        # ownership_based_object_directory.h).
+        self._object_locations: Dict[Any, Tuple[str, str]] = {}
         # oid -> Event: fetches in flight.  Deduplicates concurrent
         # fetches of one object so the owner records exactly one hold
         # per borrower copy (ADVICE r3: two racing fetches registered
@@ -162,6 +166,7 @@ class ClusterClient:
         self._push_to(spec, node_id, address)
 
     def _push_to(self, spec, node_id: str, address: str) -> None:
+        from ..core.task_spec import STREAMING
         from ..exceptions import NodeDiedError
         bundle = dumps({
             "function": spec.function,
@@ -169,24 +174,45 @@ class ClusterClient:
             "num_returns": spec.num_returns,
             "name": spec.name,
             "resources": dict(spec.resources or {}),
+            # Big returns stay pinned on the executor under the OWNER's
+            # ids (primary copies); streaming items report back here.
+            "return_ids": list(spec.return_ids),
+            "owner": self.address,
         })
 
         def on_done(result, is_error):
             if is_error:
-                # Transport failure → node presumed dead → retriable.
+                # Transport failure → node presumed dead → retriable —
+                # unless items of a streaming task were already
+                # consumed (a re-run would duplicate them; mirrors the
+                # local mid-stream no-retry rule).
                 self._report_node_failure(node_id, address)
                 spec.exclude_node(node_id)
+                allow_retry = True
+                if spec.num_returns == STREAMING:
+                    allow_retry = (self.runtime.streaming_manager
+                                   .num_items(spec.return_ids[0]) == 0)
                 self.runtime.task_manager.complete_error(
                     spec, NodeDiedError(
                         f"node {node_id[:8]} died running "
-                        f"{spec.repr_name()}: {result}"))
+                        f"{spec.repr_name()}: {result}"),
+                    allow_retry=allow_retry)
                 return
             status, payload = result
             if status == "ok":
-                self.runtime.task_manager.complete_success(
-                    spec, loads(payload))
+                self.runtime.task_manager.complete_remote(spec, payload)
+            elif status == "stream_done":
+                self.runtime.streaming_manager.finish(spec.return_ids[0])
+                self.runtime.task_manager.complete_success(spec, None)
             else:
-                self.runtime.task_manager.complete_error(spec, payload)
+                allow_retry = True
+                if spec.num_returns == STREAMING:
+                    # A partially-consumed stream must not re-run (the
+                    # re-reported items would duplicate).
+                    allow_retry = (self.runtime.streaming_manager
+                                   .num_items(spec.return_ids[0]) == 0)
+                self.runtime.task_manager.complete_error(
+                    spec, payload, allow_retry=allow_retry)
 
         try:
             self.pool.get(address).call_async(
@@ -223,35 +249,156 @@ class ClusterClient:
             self.runtime.reference_counter.remove_borrower_node(address)
 
     # ------------------------------------------------------------ objects
+    def register_location(self, oid, node_id: str, address: str) -> None:
+        with self._loc_lock:
+            self._object_locations[oid] = (node_id, address)
+
+    def drop_location(self, oid) -> None:
+        with self._loc_lock:
+            self._object_locations.pop(oid, None)
+
+    def free_primary_of(self, oid) -> None:
+        """Owner out-of-scope hook: release the pinned primary copy on
+        its holder (fire-and-forget; a dead holder has nothing left)."""
+        with self._loc_lock:
+            loc = self._object_locations.pop(oid, None)
+        if loc is None:
+            return
+        try:
+            self.pool.get(loc[1]).call_async(
+                "free_primary", {"oid": oid},
+                callback=lambda _r, _e: None)
+        except Exception:
+            pass
+
+    def pull_sealed(self, oid, address: str, timeout: float = 300.0):
+        """Chunked parallel pull of an object's flat wire layout from
+        ``address`` (reference: pull_manager.h:52 bounded in-flight
+        chunk admission over object_buffer_pool.h chunks).  Returns the
+        rebuilt Serialized; raises ConnectionError on holder loss."""
+        from ..core.config import GLOBAL_CONFIG
+        from .serialization import sealed_from_flat
+
+        client = self.pool.get(address)
+        meta_resp = client.call("object_meta", {"oid": oid}, timeout=30.0)
+        if not meta_resp.get("found"):
+            raise ConnectionError(
+                f"holder {address} no longer has {oid!r}")
+        total = meta_resp["size"]
+        meta = meta_resp["meta"]
+        chunk = max(64 * 1024, GLOBAL_CONFIG.object_chunk_bytes())
+        window = max(1, GLOBAL_CONFIG.object_pull_window())
+        buf = bytearray(total)
+        if total <= chunk:
+            data = client.call(
+                "object_chunk", {"oid": oid, "offset": 0, "len": total},
+                timeout=timeout)
+            if data is None or len(data) != total:
+                raise ConnectionError(
+                    f"short read pulling {oid!r} from {address}")
+            buf[:] = data
+            return sealed_from_flat(meta, memoryview(buf).toreadonly())
+
+        sem = threading.Semaphore(window)
+        lk = threading.Lock()
+        state = {"left": (total + chunk - 1) // chunk, "err": None}
+        done = threading.Event()
+
+        def _finish_one(err=None):
+            sem.release()
+            with lk:
+                if err is not None and state["err"] is None:
+                    state["err"] = err
+                state["left"] -= 1
+                if state["left"] <= 0:
+                    done.set()
+
+        def make_cb(off: int, ln: int):
+            def cb(result, is_error):
+                if is_error:
+                    e = result if isinstance(result, BaseException) \
+                        else ConnectionError(str(result))
+                    _finish_one(e)
+                elif result is None or len(result) != ln:
+                    _finish_one(ConnectionError(
+                        f"short chunk at {off} pulling {oid!r}"))
+                else:
+                    buf[off:off + ln] = result
+                    _finish_one()
+            return cb
+
+        deadline = time.monotonic() + timeout
+        for off in range(0, total, chunk):
+            ln = min(chunk, total - off)
+            if not sem.acquire(timeout=max(0.0,
+                                           deadline - time.monotonic())):
+                _finish_one(TimeoutError(
+                    f"pull window stalled for {oid!r}"))
+                break
+            with lk:
+                if state["err"] is not None:
+                    _finish_one()
+                    continue
+            try:
+                client.call_async(
+                    "object_chunk",
+                    {"oid": oid, "offset": off, "len": ln},
+                    callback=make_cb(off, ln))
+            except (ConnectionError, OSError) as e:
+                _finish_one(e)
+        if not done.wait(max(0.0, deadline - time.monotonic())):
+            raise TimeoutError(f"pull of {oid!r} from {address} timed out")
+        if state["err"] is not None:
+            err = state["err"]
+            raise err if isinstance(err, (ConnectionError, TimeoutError)) \
+                else ConnectionError(str(err))
+        return sealed_from_flat(meta, memoryview(buf).toreadonly())
+
     def fetch_object(self, ref) -> None:
-        """Pull an object from its owner and seal a local copy.  The
-        fetch registers this node as a BORROWER with the owner
-        (reference_count.h:64): the owner keeps the value alive until
-        every borrower's cached copy goes out of scope and releases.
+        """Pull an object and seal a local copy.  Small values ride the
+        owner's reply; big values redirect to the node pinning the
+        primary copy and arrive as parallel chunks.  The fetch registers
+        this node as a BORROWER with the owner (reference_count.h:64):
+        the owner keeps the value alive until every borrower's cached
+        copy goes out of scope and releases.  A dead primary holder is
+        reported to the owner, which reconstructs from lineage
+        (object_recovery_manager.h:41) — the fetch then retries.
 
         Known gap vs the reference: the borrow registers at FETCH
         time, so a nested ref that crosses the wire but is never
         fetched does not hold the object — the reference registers
         borrowers at deserialization via owner-assigned metadata."""
         from ..core.object_store import RayObject
-        from ..exceptions import OwnerDiedError
+        from ..exceptions import ObjectLostError, OwnerDiedError
 
         oid = ref.object_id()
         owner = ref.owner_address()
-        try:
-            resp = self.pool.get(owner).call(
-                "get_object", {"oid": oid, "borrower": self.address},
-                timeout=300.0)
-        except (ConnectionError, TimeoutError) as e:
-            self.runtime.object_store.put(
-                oid, RayObject(error=OwnerDiedError(
-                    f"owner {owner} of {ref!r} unreachable: {e}")))
+        store = self.runtime.object_store
+
+        # Short-circuit: this node pins the primary copy (it executed
+        # the creating task) — no network, no borrow hold needed.
+        sealed = self.runtime.plasma.get_sealed(oid)
+        if sealed is not None:
+            store.put(oid, RayObject(sealed=sealed))
             return
-        if resp.get("error") is not None:
-            self.runtime.object_store.put(
-                oid, RayObject(error=resp["error"]))
-        else:
+
+        registered = False
+        for _attempt in range(4):
+            try:
+                resp = self.pool.get(owner).call(
+                    "get_object",
+                    {"oid": oid,
+                     "borrower": None if registered else self.address},
+                    timeout=300.0)
+            except (ConnectionError, TimeoutError) as e:
+                store.put(oid, RayObject(error=OwnerDiedError(
+                    f"owner {owner} of {ref!r} unreachable: {e}")))
+                return
+            if resp.get("error") is not None:
+                store.put(oid, RayObject(error=resp["error"]))
+                return
             if resp.get("borrow_registered"):
+                registered = True
                 dup = False
                 with self._loc_lock:
                     if oid in self._borrowed:
@@ -266,8 +413,32 @@ class ClusterClient:
                             callback=lambda _r, _e: None)
                     except Exception:
                         pass
-            self.runtime.object_store.put(
-                oid, RayObject(sealed=from_wire(resp["data"])))
+            redirect = resp.get("redirect")
+            if redirect is None:
+                store.put(oid, RayObject(sealed=from_wire(resp["data"])))
+                return
+            holder_node, holder_addr = redirect
+            try:
+                sealed = self.pull_sealed(oid, holder_addr)
+            except (ConnectionError, TimeoutError):
+                # Holder died (or freed early): the owner reconstructs;
+                # then we re-request.
+                try:
+                    self.pool.get(owner).call(
+                        "report_object_lost",
+                        {"oid": oid, "holder": holder_node},
+                        timeout=330.0)
+                except (ConnectionError, TimeoutError) as e:
+                    store.put(oid, RayObject(error=OwnerDiedError(
+                        f"owner {owner} of {ref!r} unreachable during "
+                        f"recovery: {e}")))
+                    return
+                continue
+            store.put(oid, RayObject(sealed=sealed))
+            return
+        store.put(oid, RayObject(error=ObjectLostError(
+            reason=f"{ref!r}: repeated pulls failed and recovery did "
+                   f"not converge")))
 
     def release_borrowed(self, oid) -> None:
         """Called when this node's cached copy goes out of scope: tell
@@ -477,28 +648,39 @@ class ClusterClient:
         from ..exceptions import ActorDiedError
 
         node_id, address = location
+        from ..core.task_spec import STREAMING
         bundle = dumps({
             "actor_id": spec.actor_id,
             "method": spec.descriptor.function_name,
             "args": spec.args, "kwargs": spec.kwargs,
             "num_returns": spec.num_returns,
+            "return_ids": list(spec.return_ids),
+            "owner": self.address,
         })
 
         def on_done(result, is_error):
             if is_error:
                 # Transport death is retriable when the actor has
                 # max_task_retries budget (spec.max_retries carries it);
-                # the retry waits out the head-driven restart.
+                # the retry waits out the head-driven restart.  A
+                # partially-consumed stream must not re-run.
                 self._report_node_failure(node_id, address)
+                allow_retry = True
+                if spec.num_returns == STREAMING:
+                    allow_retry = (self.runtime.streaming_manager
+                                   .num_items(spec.return_ids[0]) == 0)
                 self.runtime.task_manager.complete_error(
                     spec, ActorDiedError(
                         spec.actor_id,
-                        f"actor's node {node_id[:8]} died: {result}"))
+                        f"actor's node {node_id[:8]} died: {result}"),
+                    allow_retry=allow_retry)
                 return
             status, payload = result
             if status == "ok":
-                self.runtime.task_manager.complete_success(
-                    spec, loads(payload))
+                self.runtime.task_manager.complete_remote(spec, payload)
+            elif status == "stream_done":
+                self.runtime.streaming_manager.finish(spec.return_ids[0])
+                self.runtime.task_manager.complete_success(spec, None)
             else:
                 self.runtime.task_manager.complete_error(
                     spec, payload, allow_retry=False)
@@ -585,29 +767,92 @@ class NodeServer:
             "kill_actor": self._kill_actor,
             "get_object": self._get_object,
             "release_borrower": self._release_borrower,
+            "object_meta": self._object_meta,
+            "object_chunk": self._object_chunk,
+            "free_primary": self._free_primary,
+            "report_object_lost": self._report_object_lost,
+            "stream_item": self._stream_item,
+            "add_pg_capacity": self._add_pg_capacity,
+            "remove_pg_capacity": self._remove_pg_capacity,
             "ping": lambda p: "pong",
         }, ordered={"actor_call"})
         self.address = self._server.address
 
-    # Completion helper: collect refs → ("ok", wire) | ("error", exc)
-    def _collect(self, refs, num_returns):
-        from ..core.task_spec import STREAMING
+    # Completion helper: wait for the local returns, then per return —
+    # small → inline wire bytes in the reply; big → pin a primary copy
+    # here under the OWNER's id and report its location (reference:
+    # small results inline in the PushTask reply, big results
+    # plasma-resident; max_direct_call_object_size).
+    def _collect(self, refs, num_returns, owner_return_ids=None):
+        from ..core.config import GLOBAL_CONFIG
 
+        store = self.runtime.object_store
         try:
             if num_returns == 0 or refs is None:
-                value = None
                 if refs is not None:
                     self.runtime.get(refs)
-            elif isinstance(refs, list):
-                value = tuple(self.runtime.get(refs))
-            else:
-                value = self.runtime.get(refs)
-            return ("ok", dumps(value))
+                return ("ok", [])
+            ref_list = refs if isinstance(refs, list) else [refs]
+            inline_limit = GLOBAL_CONFIG.max_direct_call_object_size()
+            entries = []
+            for i, ref in enumerate(ref_list):
+                obj = store.wait_and_get(ref.object_id(), timeout=None)
+                if obj.is_error():
+                    return ("error", obj.error)
+                sealed = obj.sealed
+                if (owner_return_ids is not None
+                        and sealed.size_bytes > inline_limit):
+                    ooid = owner_return_ids[i]
+                    self.runtime.plasma.put_primary(ooid, sealed)
+                    entries.append(("stored", self.client.node_id,
+                                    self.client.address,
+                                    sealed.size_bytes))
+                else:
+                    entries.append(("inline", to_wire(sealed)))
+            return ("ok", entries)
         except BaseException as e:  # noqa: BLE001
             return ("error", e)
 
+    def _forward_stream(self, gen, owner_stream_id, owner_addr: str):
+        """Drain a locally-executing streaming generator, reporting
+        each item out-of-band to the owner (reference: per-item
+        HandleReportGeneratorItemReturns, task_manager.h:301).  Items
+        are sent synchronously so arrival order matches yield order;
+        big items pin primaries here and ship as location records."""
+        from ..core.config import GLOBAL_CONFIG
+        from ..core.ids import ObjectID
+
+        store = self.runtime.object_store
+        owner = self.client.pool.get(owner_addr)
+        owner_tid = owner_stream_id.task_id()
+        inline_limit = GLOBAL_CONFIG.max_direct_call_object_size()
+        index = 0
+        try:
+            for item_ref in gen:
+                obj = store.get_if_exists(item_ref.object_id())
+                if obj is None:
+                    continue  # freed under us; owner sees a gap-free index
+                if obj.is_error():
+                    entry = ("err", obj.error)
+                else:
+                    sealed = obj.sealed
+                    if sealed.size_bytes > inline_limit:
+                        ooid = ObjectID.for_return(owner_tid, index + 1)
+                        self.runtime.plasma.put_primary(ooid, sealed)
+                        entry = ("stored", self.client.node_id,
+                                 self.client.address, sealed.size_bytes)
+                    else:
+                        entry = ("inline", to_wire(sealed))
+                owner.call("stream_item",
+                           {"stream": owner_stream_id, "index": index,
+                            "entry": entry}, timeout=300.0)
+                index += 1
+        except BaseException as e:  # noqa: BLE001
+            return ("error", e)
+        return ("stream_done", index)
+
     def _push_task(self, wire):
-        from ..core.task_spec import TaskOptions
+        from ..core.task_spec import STREAMING, TaskOptions
 
         bundle = loads(wire)
         self.client.ensure_args_local(bundle["args"], bundle["kwargs"])
@@ -620,7 +865,11 @@ class NodeServer:
         refs = self.runtime.submit_task(
             bundle["function"], bundle["args"], bundle["kwargs"], opts,
             local_only=True)
-        return self._collect(refs, bundle["num_returns"])
+        if bundle["num_returns"] == STREAMING:
+            return self._forward_stream(refs, bundle["return_ids"][0],
+                                        bundle["owner"])
+        return self._collect(refs, bundle["num_returns"],
+                             bundle.get("return_ids"))
 
     def _create_actor(self, wire):
         b = loads(wire)
@@ -643,7 +892,7 @@ class NodeServer:
     def _actor_call(self, wire):
         """Ordered: submission runs inline on the connection reader so
         calls from one caller enter the actor queue in send order."""
-        from ..core.task_spec import TaskOptions
+        from ..core.task_spec import STREAMING, TaskOptions
 
         b = loads(wire)
         self.client.ensure_args_local(b["args"], b["kwargs"])
@@ -653,7 +902,11 @@ class NodeServer:
                 b["actor_id"], b["method"], b["args"], b["kwargs"], opts)
         except BaseException as e:  # noqa: BLE001
             return ("error", e)
-        return Deferred(lambda: self._collect(refs, b["num_returns"]))
+        if b["num_returns"] == STREAMING:
+            return Deferred(lambda: self._forward_stream(
+                refs, b["return_ids"][0], b["owner"]))
+        return Deferred(lambda: self._collect(refs, b["num_returns"],
+                                              b.get("return_ids")))
 
     def _actor_ready(self, p):
         core = self.runtime.actor_manager.get_core(p["actor_id"])
@@ -672,21 +925,153 @@ class NodeServer:
         return {"ok": True}
 
     def _get_object(self, p):
-        obj = self.runtime.object_store.wait_and_get(p["oid"],
-                                                     timeout=300.0)
+        """Owner-side object service.  Small sealed values ship inline;
+        big ones (and values whose primary copy is pinned elsewhere)
+        redirect the caller to the chunk protocol."""
+        from ..core.config import GLOBAL_CONFIG
+
+        oid = p["oid"]
+        obj = self.runtime.object_store.wait_and_get(oid, timeout=300.0)
         if obj.is_error():
             return {"error": obj.error, "data": None}
         registered = False
         borrower = p.get("borrower")
         if borrower:
             registered = self.runtime.reference_counter.add_borrower(
-                p["oid"], borrower)
-        return {"error": None, "data": to_wire(obj.sealed),
-                "borrow_registered": registered}
+                oid, borrower)
+        if obj.sealed is not None:
+            if (obj.sealed.size_bytes
+                    <= GLOBAL_CONFIG.max_direct_call_object_size()):
+                return {"error": None, "data": to_wire(obj.sealed),
+                        "borrow_registered": registered}
+            # Big owner-held value: serve it through the chunk protocol
+            # from this node.
+            self.runtime.plasma.serve_foreign(oid, obj.sealed)
+            return {"error": None,
+                    "redirect": (self.client.node_id,
+                                 self.client.address),
+                    "size": obj.sealed.size_bytes,
+                    "borrow_registered": registered}
+        return {"error": None, "redirect": obj.location,
+                "size": obj.size_bytes, "borrow_registered": registered}
 
     def _release_borrower(self, p):
         self.runtime.reference_counter.remove_borrower(
             p["oid"], p["borrower"])
+        return {"ok": True}
+
+    # ----------------------------------------------------- object plane
+    def _object_meta(self, p):
+        oid = p["oid"]
+        m = self.runtime.plasma.wire_meta(oid)
+        if m is None:
+            obj = self.runtime.object_store.get_if_exists(oid)
+            if obj is not None and obj.sealed is not None:
+                m = self.runtime.plasma.serve_foreign(oid, obj.sealed)
+        if m is None:
+            return {"found": False}
+        return {"found": True, "meta": m["meta"], "size": m["size"]}
+
+    def _object_chunk(self, p):
+        data = self.runtime.plasma.read_chunk(
+            p["oid"], p["offset"], p["len"])
+        if data is None:
+            raise KeyError(f"no object {p['oid']!r} to serve")
+        return data
+
+    def _free_primary(self, p):
+        self.runtime.plasma.free(p["oid"])
+        return {"ok": True}
+
+    # ------------------------------------------------- placement groups
+    def _add_pg_capacity(self, p):
+        """Mint this node's share of a placement group: acquire the
+        underlying resources and advertise the synthetic per-bundle
+        names (raylet/placement_group_resource_manager.h; head learns
+        the new names through an add_resources heartbeat)."""
+        from ..util.placement_group import bundle_capacity
+
+        rt = self.runtime
+        bundles = p["bundles"]
+        total: Dict[str, float] = {}
+        for b in bundles.values():
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        if not rt.node_resources.can_ever_fit(total):
+            return {"ok": False, "error": f"cannot ever fit {total}"}
+        deadline = time.monotonic() + 30.0
+        while not rt.node_resources.try_acquire(total):
+            if time.monotonic() > deadline:
+                return {"ok": False,
+                        "error": f"resources {total} busy for 30s"}
+            time.sleep(0.05)
+        cap = bundle_capacity(p["pg_id"], bundles)
+        rt.node_resources.add_capacity(cap)
+        try:
+            # add_resources only — an "available" snapshot here would
+            # double-count (the handler adds cap on top of it).
+            self.client.head.call("heartbeat", {
+                "node_id": self.client.node_id,
+                "add_resources": cap}, timeout=10.0)
+        except Exception:
+            pass  # the next periodic heartbeat carries availability
+        return {"ok": True}
+
+    def _remove_pg_capacity(self, p):
+        from ..util.placement_group import bundle_capacity
+
+        rt = self.runtime
+        bundles = p["bundles"]
+        cap = bundle_capacity(p["pg_id"], bundles)
+        rt.node_resources.remove_capacity(cap)
+        total: Dict[str, float] = {}
+        for b in bundles.values():
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        rt.node_resources.release(total)
+        try:
+            self.client.head.call("heartbeat", {
+                "node_id": self.client.node_id,
+                "remove_resources": list(cap)}, timeout=10.0)
+        except Exception:
+            pass
+        return {"ok": True}
+
+    def _report_object_lost(self, p):
+        """A consumer failed to pull this object's primary copy: mark
+        the holder suspect and reconstruct from lineage.  Blocks until
+        the object is usable again (the caller then re-requests)."""
+        ok = self.runtime.recover_object(p["oid"],
+                                         dead_node=p.get("holder"))
+        return {"ok": ok}
+
+    def _stream_item(self, p):
+        """Owner-side per-item ingestion for a stream executing on a
+        remote node (task_manager.h:301 HandleReportGeneratorItemReturns).
+        Seals the item under this owner's deterministic item id and
+        wakes consumers."""
+        from ..core.ids import ObjectID
+        from ..core.object_store import RayObject
+
+        stream_oid = p["stream"]
+        entry = p["entry"]
+        rt = self.runtime
+        tid = stream_oid.task_id()
+        if entry[0] == "err":
+            ooid = ObjectID.for_return(tid, 2**20)
+            obj = RayObject(error=entry[1])
+        else:
+            ooid = ObjectID.for_return(tid, p["index"] + 1)
+            if entry[0] == "inline":
+                obj = RayObject(sealed=from_wire(entry[1]))
+            else:
+                _kind, node_id, address, size = entry
+                obj = RayObject(location=(node_id, address),
+                                size_bytes=size)
+                rt.register_object_location(ooid, node_id, address)
+        rt.reference_counter.add_owned_object(ooid)
+        rt.object_store.put(ooid, obj)
+        rt.streaming_manager.report_item(stream_oid, ooid)
         return {"ok": True}
 
     def shutdown(self):

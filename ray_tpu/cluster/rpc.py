@@ -72,39 +72,74 @@ class DeserializationError(RuntimeError):
 
 def _send_msg(sock: socket.socket, kind: str, req_id: str, method: str,
               payload: Any, lock: threading.Lock):
-    env = pickle.dumps((kind, req_id, method),
-                       protocol=pickle.HIGHEST_PROTOCOL)
-    body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    """Bytes-like payloads are framed RAW (kind gets a "+raw" suffix) —
+    no pickle copy on either side; the data plane's chunk transfers and
+    pre-serialized task bundles ride this path at memcpy speed."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        env = pickle.dumps((kind + "+raw", req_id, method),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+        body = payload
+    else:
+        env = pickle.dumps((kind, req_id, method),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+        body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
-        sock.sendall(_LEN.pack(len(env)) + env +
-                     _LEN.pack(len(body)) + body)
+        # Scatter-gather write: no concatenation copy of the body.
+        # sendmsg may queue only a prefix (signal, full send buffer) —
+        # loop on the remainder or the framing desynchronizes.
+        bufs = [_LEN.pack(len(env)), memoryview(env),
+                _LEN.pack(len(body)),
+                memoryview(body) if not isinstance(body, memoryview)
+                else body]
+        while bufs:
+            sent = sock.sendmsg(bufs)
+            while bufs and sent >= len(bufs[0]):
+                sent -= len(bufs[0])
+                bufs.pop(0)
+            if sent and bufs:
+                bufs[0] = bufs[0][sent:]
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             raise ConnectionError("socket closed")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
-def _recv_segment(sock: socket.socket) -> bytes:
+def _recv_segment(sock: socket.socket) -> bytearray:
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
     return _recv_exact(sock, length)
 
 
-def _recv_msg(sock: socket.socket) -> Tuple[str, str, str, bytes]:
-    """Returns (kind, req_id, method, raw_payload_bytes).  The payload
-    is NOT deserialized here: the caller decodes it after correlation so
-    a bad payload fails one call, not the connection."""
+def _recv_msg(sock: socket.socket) -> Tuple[str, str, str, bytes, bool]:
+    """Returns (kind, req_id, method, raw_payload, is_raw).  A pickled
+    payload is NOT deserialized here: the caller decodes it after
+    correlation so a bad payload fails one call, not the connection.
+    Raw payloads skip pickle entirely."""
     env = pickle.loads(_recv_segment(sock))
     body = _recv_segment(sock)
     kind, req_id, method = env
-    return kind, req_id, method, body
+    if kind.endswith("+raw"):
+        return kind[:-4], req_id, method, body, True
+    return kind, req_id, method, body, False
+
+
+def _tune_socket(sock: socket.socket) -> None:
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # Big windows keep chunked object pulls streaming (the default
+    # buffers stall a 4 MiB in-flight window on loopback).
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, 4 * 1024 * 1024)
+        except OSError:
+            pass
 
 
 class Deferred:
@@ -156,7 +191,7 @@ class RpcServer:
                 conn, _addr = self._sock.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_socket(conn)
             threading.Thread(target=self._conn_loop, args=(conn,),
                              daemon=True).start()
 
@@ -164,9 +199,9 @@ class RpcServer:
         wlock = threading.Lock()
         try:
             while not self._stopped.is_set():
-                kind, req_id, method, raw = _recv_msg(conn)
+                kind, req_id, method, raw, is_raw = _recv_msg(conn)
                 try:
-                    payload = pickle.loads(raw)
+                    payload = raw if is_raw else pickle.loads(raw)
                 except BaseException as e:  # noqa: BLE001
                     self._reply_err(conn, wlock, req_id, method,
                                     DeserializationError(
@@ -277,7 +312,7 @@ class RpcClient:
             try:
                 sock = socket.create_connection((host, int(port)),
                                                 timeout=timeout)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _tune_socket(sock)
                 sock.settimeout(None)
                 self._sock = sock
                 threading.Thread(target=self._read_loop, args=(sock,),
@@ -293,13 +328,13 @@ class RpcClient:
     def _read_loop(self, sock: socket.socket):
         try:
             while True:
-                kind, req_id, method, raw = _recv_msg(sock)
+                kind, req_id, method, raw, is_raw = _recv_msg(sock)
                 with self._lock:
                     call = self._pending.pop(req_id, None)
                 if call is None:
                     continue
                 try:
-                    payload = pickle.loads(raw)
+                    payload = raw if is_raw else pickle.loads(raw)
                 except BaseException as e:  # noqa: BLE001
                     # Fail the one correlated call; the connection and
                     # every other pending call stay healthy.

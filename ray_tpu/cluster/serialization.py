@@ -154,6 +154,87 @@ def _parse_dtype(name: str) -> np.dtype:
         return np.dtype(name)
 
 
+# ---------------------------------------------------------------------------
+# Flat wire layout (chunked object plane)
+# ---------------------------------------------------------------------------
+#
+# The chunk protocol (reference: object_manager.h:117 chunked push/pull,
+# object_buffer_pool.h) needs a byte-addressable view of a sealed object.
+# Layout: ``payload || extern0 || extern1 || ...`` where each extern is its
+# C-contiguous raw bytes.  ``wire_layout`` builds zero-copy views for host
+# numpy externs (jax externs pay exactly one device→host transfer);
+# ``sealed_from_flat`` rebuilds a Serialized from one contiguous buffer with
+# zero-copy ``np.frombuffer`` views per extern.
+
+
+def wire_layout(sealed: Serialized) -> Tuple[dict, List[memoryview]]:
+    """(meta, buffers) describing ``sealed`` as a flat byte stream.
+
+    ``meta`` pickles small and is all a receiver needs to rebuild the
+    object from the flat bytes.  ``buffers`` hold references to the live
+    arrays, so the layout stays valid even if the store entry is freed
+    mid-transfer."""
+    bufs = [memoryview(sealed.payload)]
+    externs = []
+    for kind, arr in sealed.externs:
+        host = np.ascontiguousarray(np.asarray(arr))
+        externs.append((kind, str(host.dtype), tuple(host.shape),
+                        int(host.nbytes)))
+        if host.nbytes:
+            flat = host.reshape(-1).view(np.uint8)
+            bufs.append(memoryview(flat))
+    meta = {"payload": len(sealed.payload), "externs": externs}
+    return meta, bufs
+
+
+def wire_size(meta: dict) -> int:
+    return meta["payload"] + sum(e[3] for e in meta["externs"])
+
+
+def read_layout_chunk(bufs: List[memoryview], offset: int, length: int):
+    """Read ``length`` bytes at ``offset`` of the virtual concatenation.
+    A chunk that falls inside one buffer is returned as a zero-copy
+    memoryview (the RPC layer sends bytes-like payloads raw)."""
+    pieces = []
+    taken = 0
+    for b in bufs:
+        n = len(b)
+        if offset >= n:
+            offset -= n
+            continue
+        take = min(length - taken, n - offset)
+        pieces.append(b[offset:offset + take])
+        taken += take
+        offset = 0
+        if taken >= length:
+            break
+    if len(pieces) == 1:
+        return pieces[0]
+    return b"".join(pieces)
+
+
+def sealed_from_flat(meta: dict, buf) -> Serialized:
+    """Rebuild a Serialized from a flat buffer laid out by wire_layout.
+    Extern arrays are zero-copy read-only views into ``buf``."""
+    view = memoryview(buf)
+    if not view.readonly:
+        view = view.toreadonly()
+    off = meta["payload"]
+    payload = bytes(view[:off])
+    externs: List[Tuple[str, Any]] = []
+    for kind, dtype, shape, nbytes in meta["externs"]:
+        arr = np.frombuffer(view[off:off + nbytes],
+                            dtype=_parse_dtype(dtype)).reshape(shape)
+        off += nbytes
+        if kind == "jax":
+            import jax
+
+            externs.append(("jax", jax.device_put(arr)))
+        else:
+            externs.append(("np", arr))
+    return Serialized(payload, externs)
+
+
 def dumps(value: Any) -> bytes:
     """One-shot: value → wire bytes."""
     return to_wire(serialize(value))

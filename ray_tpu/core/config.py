@@ -128,6 +128,13 @@ _d("object_spilling_threshold", float, 0.8,
 _d("object_spilling_directory", str, "",
    "Directory for spilled objects; empty = <session_dir>/spill.")
 _d("object_store_full_delay_ms", int, 100, "Retry delay when store is full.")
+_d("object_chunk_bytes", int, 4 * 1024 * 1024,
+   "Chunk size for inter-node object pulls (object_buffer_pool.h).")
+_d("object_pull_window", int, 2,
+   "Max in-flight chunk requests per pull (pull_manager.h:52 "
+   "admission control).  The path is memcpy-bound, not latency-bound: "
+   "2 in flight hides the RTT; more just thrashes the GIL (measured "
+   "0.85 GB/s at 2 vs 0.76 at 8 on loopback).")
 _d("max_lineage_bytes", int, 100 * 1024 * 1024,
    "Lineage pinned for reconstruction, per owner (task_manager.h:219).")
 

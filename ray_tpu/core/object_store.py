@@ -26,7 +26,8 @@ _UNSET = object()
 
 
 class RayObject:
-    """A sealed object: exactly one of sealed-value / error is meaningful.
+    """A sealed object: exactly one of sealed-value / error / remote
+    location is meaningful.
 
     Values are sealed through the serialization boundary at put time
     (cluster/serialization.py): each ``value`` access deserializes a
@@ -35,12 +36,19 @@ class RayObject:
     consumer's (reference plasma semantics).  Array leaves are shared —
     jax.Arrays by reference (immutable), numpy as frozen read-only
     copies.
+
+    A *location record* (``location=(node_id, address)``) is the owner's
+    view of a primary copy pinned on the executing node (reference:
+    plasma-resident big task returns + ownership-based object directory,
+    ownership_based_object_directory.h).  ``get`` materializes it via a
+    chunked pull; losing the holder triggers lineage reconstruction.
     """
 
-    __slots__ = ("sealed", "error", "size_bytes")
+    __slots__ = ("sealed", "error", "size_bytes", "location")
 
     def __init__(self, value: Any = _UNSET, error: Optional[BaseException] = None,
-                 size_bytes: Optional[int] = None, sealed=None):
+                 size_bytes: Optional[int] = None, sealed=None,
+                 location: Optional[tuple] = None):
         if sealed is not None:
             self.sealed = sealed
         elif value is not _UNSET:
@@ -50,6 +58,7 @@ class RayObject:
         else:
             self.sealed = None
         self.error = error
+        self.location = location
         if size_bytes is None:
             size_bytes = self.sealed.size_bytes if self.sealed else 0
         self.size_bytes = size_bytes
@@ -57,6 +66,10 @@ class RayObject:
     @property
     def value(self) -> Any:
         if self.sealed is None:
+            if self.location is not None:
+                raise RuntimeError(
+                    "located object was not materialized before value "
+                    "access (runtime.get pulls it first)")
             return None
         from ..cluster.serialization import deserialize
 
@@ -64,6 +77,10 @@ class RayObject:
 
     def is_error(self) -> bool:
         return self.error is not None
+
+    def is_located_only(self) -> bool:
+        return (self.sealed is None and self.error is None
+                and self.location is not None)
 
 
 class MemoryStore:
@@ -107,6 +124,27 @@ class MemoryStore:
             if old is not None:
                 self._total_bytes -= old.size_bytes
             self._objects[object_id] = RayObject(error=error)
+
+    def materialize(self, object_id: ObjectID, sealed) -> None:
+        """Attach the pulled value to a location record in place (the
+        entry keeps its location so later borrowers can still be
+        redirected).  No-op if the entry is gone or already sealed."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None or obj.sealed is not None or obj.is_error():
+                return
+            obj.sealed = sealed
+            self._total_bytes += sealed.size_bytes - obj.size_bytes
+            obj.size_bytes = sealed.size_bytes
+
+    def invalidate_for_recovery(self, object_id: ObjectID) -> None:
+        """Drop a stale location record so a reconstruction re-seal can
+        land.  Unlike ``free``, registered waiter events and callbacks
+        stay: the recovery ``put`` fires them."""
+        with self._lock:
+            obj = self._objects.pop(object_id, None)
+            if obj is not None:
+                self._total_bytes -= obj.size_bytes
 
     # -- read side -----------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:

@@ -96,13 +96,20 @@ def detect_node_resources(num_cpus: Optional[float] = None,
     total["CPU"] = float(num_cpus if num_cpus is not None
                          else os.cpu_count() or 1)
     if num_tpus is None:
-        try:
-            import jax
-
-            num_tpus = float(len([d for d in jax.devices()
-                                  if d.platform != "cpu"]))
-        except Exception:
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # CPU-forced process: no TPUs by construction.  Probing
+            # would initialize the jax backend, which must stay
+            # untouched until a possible jax.distributed.initialize
+            # (multi-host train bootstrap requires init-before-backend).
             num_tpus = 0.0
+        else:
+            try:
+                import jax
+
+                num_tpus = float(len([d for d in jax.devices()
+                                      if d.platform != "cpu"]))
+            except Exception:
+                num_tpus = 0.0
     if num_tpus:
         total["TPU"] = float(num_tpus)
     total["memory"] = float(_detect_memory_bytes())

@@ -61,8 +61,20 @@ class Runtime:
         self.start_time = time.time()
 
         self.object_store = MemoryStore()
+        # Node-level object plane: primary copies of task returns pinned
+        # for remote owners + spill-past-capacity (core/plasma.py).
+        from .plasma import LocalObjectStore
+
+        self.plasma = LocalObjectStore()
         self.reference_counter = ReferenceCounter(
             on_object_out_of_scope=self._free_object)
+        # Single-flight lineage recovery per creating task
+        # (object_recovery_manager.h:41).
+        self._recovery_lock = threading.Lock()
+        self._recovering: Dict[TaskID, threading.Event] = {}
+        # Single-flight pulls of located objects (one chunked pull per
+        # object regardless of concurrent getters).
+        self._materializing: Dict[ObjectID, threading.Event] = {}
         self.streaming_manager = StreamingGeneratorManager()
         self.task_manager = TaskManager(self)
         self.node_resources = ResourceSet(
@@ -110,10 +122,19 @@ class Runtime:
 
     def _free_object(self, oid: ObjectID):
         """Out-of-scope hook: free the local copy; if it was borrowed
-        from another node, release our hold with the owner."""
+        from another node, release our hold with the owner; if its
+        primary copy is pinned on a remote holder, free it there."""
         self.object_store.free(oid)
         if self.cluster is not None:
             self.cluster.release_borrowed(oid)
+            self.cluster.free_primary_of(oid)
+
+    def register_object_location(self, oid: ObjectID, node_id: str,
+                                 address: str) -> None:
+        """Owner-side object directory entry for a primary copy pinned
+        on ``node_id`` (ownership_based_object_directory.h)."""
+        if self.cluster is not None:
+            self.cluster.register_location(oid, node_id, address)
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
@@ -153,10 +174,153 @@ class Runtime:
             t = None if deadline is None else max(
                 0.0, deadline - time.monotonic())
             obj = self.object_store.wait_and_get(ref.object_id(), t)
+            if obj.is_located_only():
+                obj = self._materialize_located(ref.object_id(), deadline)
             if obj.is_error():
                 raise obj.error
             values.append(obj.value)
         return values[0] if single else values
+
+    def _materialize_located(self, oid: ObjectID,
+                             deadline: Optional[float] = None):
+        """Pull a located object's primary copy into the local store;
+        on holder death, reconstruct it from lineage and retry
+        (object_recovery_manager.h:41).  Single-flight per object: the
+        first caller pulls, concurrent getters wait on its result.  The
+        caller's deadline bounds every phase (pull, recovery)."""
+        def remaining(default: float) -> float:
+            if deadline is None:
+                return default
+            left = deadline - time.monotonic()
+            if left <= 0:
+                from ..exceptions import GetTimeoutError
+
+                raise GetTimeoutError(
+                    f"get() timed out materializing {oid!r}")
+            return min(left, default)
+
+        attempts = 0
+        while True:
+            obj = self.object_store.wait_and_get(oid, remaining(3600.0))
+            if not obj.is_located_only():
+                return obj
+            with self._recovery_lock:
+                ev = self._materializing.get(oid)
+                mine = ev is None
+                if mine:
+                    ev = self._materializing[oid] = threading.Event()
+            if not mine:
+                ev.wait(remaining(300.0))
+                continue  # loser re-reads the store
+            try:
+                node_id, address = obj.location
+                try:
+                    sealed = self.cluster.pull_sealed(
+                        oid, address, timeout=remaining(300.0))
+                    self.object_store.materialize(oid, sealed)
+                except (ConnectionError, TimeoutError):
+                    attempts += 1
+                    self.cluster._report_node_failure(node_id, address)
+                    if attempts > 3:
+                        from ..exceptions import ObjectLostError
+
+                        self.object_store.invalidate_for_recovery(oid)
+                        self.object_store.put(oid, RayObject(
+                            error=ObjectLostError(
+                                reason=f"{oid!r}: holder unreachable "
+                                       f"and recovery kept failing")))
+                        continue
+                    self.recover_object(oid, dead_node=node_id,
+                                        timeout=remaining(300.0))
+            finally:
+                with self._recovery_lock:
+                    self._materializing.pop(oid, None)
+                ev.set()
+
+    def recover_object(self, oid: ObjectID, dead_node: Optional[str] = None,
+                       timeout: float = 300.0) -> bool:
+        """Owner-side lineage reconstruction: re-execute the pinned
+        creating task so a lost return is re-sealed (reference:
+        object_recovery_manager.h:41 + lineage pinning
+        task_manager.h:219-240; tested upstream by
+        python/ray/tests/test_reconstruction.py).
+
+        Missing *arguments* of the re-run recover recursively: the
+        executing node's fetch fails against the dead holder, reports
+        the loss here, and this method runs again for the argument.
+        Actor-task outputs are not reconstructable (function is None) —
+        they seal ObjectLostError, matching the default reference
+        behavior for non-retryable lineage.  Returns True if the object
+        is usable (sealed, relocated, or in flight) after the call."""
+        from ..exceptions import ObjectLostError
+
+        store = self.object_store
+        tid = oid.task_id()
+        with self._recovery_lock:
+            existing = self._recovering.get(tid)
+            mine = existing is None
+            ev = existing if existing is not None else threading.Event()
+            if mine:
+                self._recovering[tid] = ev
+        if not mine:
+            ev.wait(timeout)
+        else:
+            try:
+                obj = store.get_if_exists(oid)
+                if obj is not None and (obj.sealed is not None
+                                        or obj.is_error()):
+                    pass  # already usable / already failed
+                elif self.task_manager.is_pending(tid):
+                    pass  # creating task in flight; wait below
+                else:
+                    spec = self.task_manager.take_lineage_for_recovery(tid)
+                    recoverable = (
+                        spec is not None and spec.function is not None
+                        and spec.max_retries != 0)
+                    if not recoverable:
+                        if spec is not None:
+                            # Stale location records must clear or the
+                            # error seal below is a no-op (the store
+                            # keeps the first entry).
+                            for rid in spec.return_ids:
+                                e = store.get_if_exists(rid)
+                                if e is not None and e.is_located_only():
+                                    store.invalidate_for_recovery(rid)
+                                    if self.cluster is not None:
+                                        self.cluster.drop_location(rid)
+                            self.task_manager.reregister_for_recovery(spec)
+                            self.task_manager.complete_error(
+                                spec, ObjectLostError(
+                                    reason=f"{oid!r} lost and its "
+                                    "creating task is not retriable"),
+                                allow_retry=False)
+                        else:
+                            store.invalidate_for_recovery(oid)
+                            store.put(oid, RayObject(error=ObjectLostError(
+                                reason=f"{oid!r} lost with no pinned "
+                                       f"lineage (owner restarted or "
+                                       f"lineage released)")))
+                    else:
+                        if dead_node:
+                            spec.exclude_node(dead_node)
+                        spec.attempt_number += 1
+                        for rid in spec.return_ids:
+                            e = store.get_if_exists(rid)
+                            if e is not None and e.is_located_only():
+                                store.invalidate_for_recovery(rid)
+                                if self.cluster is not None:
+                                    self.cluster.drop_location(rid)
+                        self.task_manager.reregister_for_recovery(spec)
+                        self._dispatch(spec)
+            finally:
+                with self._recovery_lock:
+                    self._recovering.pop(tid, None)
+                ev.set()
+        try:
+            obj = store.wait_and_get(oid, timeout)
+        except Exception:
+            return False
+        return not obj.is_error()
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True
@@ -272,8 +436,9 @@ class Runtime:
         until packed, then spill — cluster_task_manager.cc:159, policies
         under raylet/scheduling/policy/).
 
-        - No cluster / streaming task → local scheduler (cross-process
-          generator reporting comes with the object-plane round).
+        - No cluster → local scheduler.  Streaming tasks route like any
+          other: a remote executor reports items back per-item
+          (stream_item RPC, task_manager.h:301 analogue).
         - Spread / NodeAffinity / NodeLabel strategies → cluster
           placement (the head implements the policy; affinity to this
           node comes straight back to us).
@@ -286,7 +451,7 @@ class Runtime:
                                 NodeLabelSchedulingStrategy,
                                 SpreadSchedulingStrategy)
 
-        if self.cluster is None or spec.num_returns == STREAMING:
+        if self.cluster is None:
             self.scheduler.submit(spec)
             return
         strat = spec.scheduling_strategy
@@ -347,6 +512,8 @@ class Runtime:
                 if obj is None:
                     raise RuntimeError(
                         f"dependency {v!r} not local at dispatch time")
+                if obj.is_located_only():
+                    obj = self._materialize_located(v.object_id())
                 if obj.is_error() and error is None:
                     error = obj.error
                     return None
@@ -583,9 +750,10 @@ class Runtime:
             max_pending_calls=max_pending_calls, lifetime=lifetime,
             resources=demand)
         core = self.actor_manager.create(info)
-        if self.cluster is not None and name and not _skip_cluster_routing:
-            # Publish named actors cluster-wide (reference: GCS named
-            # actor registry).
+        if self.cluster is not None and not _skip_cluster_routing:
+            # Publish EVERY actor cluster-wide (reference: GCS actor
+            # registry) — a handle crossing to another node resolves
+            # location through the head, named or not.
             from ..cluster.serialization import dumps as _dumps
 
             self.cluster.head.call("register_actor", {
@@ -760,12 +928,12 @@ class Runtime:
             raise ValueError(f"no such actor {actor_id!r}")
         n = options.num_returns
         if n == STREAMING:
-            raise NotImplementedError(
-                "streaming generators across nodes land with the "
-                "object-plane round; call the actor from its own node")
-        task_id = TaskID.for_task(actor_id)
-        return_ids = tuple(
-            ObjectID.for_return(task_id, i) for i in range(int(n)))
+            task_id = TaskID.for_task(actor_id)
+            return_ids = (ObjectID.for_return(task_id, 0),)
+        else:
+            task_id = TaskID.for_task(actor_id)
+            return_ids = tuple(
+                ObjectID.for_return(task_id, i) for i in range(int(n)))
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, function=None,
             descriptor=FunctionDescriptor(
@@ -785,6 +953,8 @@ class Runtime:
         arg_ids += [v.object_id() for v in spec.kwargs.values()
                     if isinstance(v, ObjectRef)]
         self.reference_counter.add_submitted_task_references(arg_ids)
+        if n == STREAMING:
+            self.streaming_manager.create_stream(spec.return_ids[0])
         if actor_state == "RESTARTING":
             # Queue behind the head-driven restart instead of pushing
             # to the dead node's address.
@@ -811,6 +981,14 @@ class Runtime:
             self.cluster.kill_remote_actor(actor_id, no_restart)
             return
         self.actor_manager.kill(actor_id, no_restart)
+        if core is not None and self.cluster is not None and no_restart:
+            # Locally-hosted actors are registered cluster-wide; a kill
+            # must retire the head entry too.
+            try:
+                self.cluster.head.call("remove_actor",
+                                       {"actor_id": actor_id.binary()})
+            except Exception:
+                pass
         if core is not None and core.info.state == ActorState.DEAD:
             self._release_actor_resources(core.info)
             # If the kill landed between the creation thread's acquire
@@ -843,6 +1021,7 @@ class Runtime:
             self.cluster = None
         self.actor_manager.shutdown()
         self.scheduler.shutdown()
+        self.plasma.destroy()
 
 
 # ---------------------------------------------------------------- global API

@@ -59,6 +59,13 @@ class StreamingGeneratorManager:
                 return stream.items[index]
             return None
 
+    def num_items(self, generator_id: ObjectID) -> int:
+        """Items reported so far (gates retry of a remote stream: a
+        partially-consumed stream must not re-run)."""
+        with self._cond:
+            stream = self._streams.get(generator_id)
+            return 0 if stream is None else len(stream.items)
+
     def is_finished(self, generator_id: ObjectID) -> bool:
         """True once the executor has reported the end of the stream."""
         with self._cond:

@@ -11,7 +11,7 @@ re-running its creating task (object_recovery_manager.h:41).
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Set
 
 from .ids import ObjectID, TaskID
 from .object_store import RayObject
@@ -26,7 +26,12 @@ class TaskManager:
         self._pending: Dict[TaskID, TaskSpec] = {}
         self._lineage: Dict[TaskID, TaskSpec] = {}
         self._lineage_refcount: Dict[TaskID, int] = {}
+        # Return oids with a registered out-of-scope listener: a spec
+        # can finish more than once (lineage reconstruction re-runs it)
+        # but each return must decrement the lineage refcount once.
+        self._listening: Set[ObjectID] = set()
         self._num_retries: int = 0
+        self._num_reconstructions: int = 0
 
     # -- lifecycle -----------------------------------------------------------
     def register_pending(self, spec: TaskSpec):
@@ -59,6 +64,29 @@ class TaskManager:
                 return
             for oid, v in zip(spec.return_ids, values):
                 store.put(oid, RayObject(value=v))
+        self._finish(spec)
+
+    def complete_remote(self, spec: TaskSpec, entries):
+        """Seal return objects from a remote executor's reply.  Each
+        entry is ``("inline", wire_bytes)`` — small results ride the
+        reply, sealed here without re-serializing — or
+        ``("stored", node_id, address, size)`` — the primary copy stays
+        pinned on the executing node and the owner seals a location
+        record (reference: small returns inline in the PushTask reply
+        vs plasma-resident big returns, task_manager.cc seal paths +
+        ownership-based directory)."""
+        from ..cluster.serialization import from_wire
+
+        store = self._runtime.object_store
+        for oid, entry in zip(spec.return_ids, entries):
+            if entry[0] == "inline":
+                store.put(oid, RayObject(sealed=from_wire(entry[1])))
+            else:
+                _kind, node_id, address, size = entry
+                store.put(oid, RayObject(location=(node_id, address),
+                                         size_bytes=size))
+                self._runtime.register_object_location(
+                    oid, node_id, address)
         self._finish(spec)
 
     def complete_error(self, spec: TaskSpec, error: BaseException,
@@ -96,8 +124,14 @@ class TaskManager:
             if live_returns and spec.function is not None:
                 self._lineage[spec.task_id] = spec
                 self._lineage_refcount[spec.task_id] = live_returns
-        # Release lineage when the last return goes out of scope.
+        # Release lineage when the last return goes out of scope.  A
+        # reconstruction re-finish must not stack a second listener on
+        # the same oid (it would double-decrement the refcount).
         for oid in spec.return_ids:
+            with self._lock:
+                if oid in self._listening:
+                    continue
+                self._listening.add(oid)
             self._runtime.reference_counter.on_out_of_scope(
                 oid, self._on_return_out_of_scope)
 
@@ -114,6 +148,7 @@ class TaskManager:
     def _on_return_out_of_scope(self, object_id: ObjectID):
         task_id = object_id.task_id()
         with self._lock:
+            self._listening.discard(object_id)
             if task_id in self._lineage_refcount:
                 self._lineage_refcount[task_id] -= 1
                 if self._lineage_refcount[task_id] <= 0:
@@ -134,6 +169,36 @@ class TaskManager:
         with self._lock:
             return self._lineage.get(object_id.task_id())
 
+    def take_lineage_for_recovery(self, task_id: TaskID
+                                  ) -> Optional[TaskSpec]:
+        """Pop a finished task's pinned spec to re-execute it (object
+        recovery, object_recovery_manager.h:41).  The spec re-enters
+        the pending table via ``reregister_for_recovery`` and re-pins
+        itself on the next finish."""
+        with self._lock:
+            spec = self._lineage.pop(task_id, None)
+            if spec is not None:
+                self._lineage_refcount.pop(task_id, None)
+            return spec
+
+    def reregister_for_recovery(self, spec: TaskSpec) -> None:
+        """Put a recovered spec back in flight: pending-table entry,
+        owned return refs, and submitted-task refs on its args (the
+        mirror of what ``_finish`` released)."""
+        with self._lock:
+            self._pending[spec.task_id] = spec
+            self._num_reconstructions += 1
+        rc = self._runtime.reference_counter
+        for oid in spec.return_ids:
+            rc.add_owned_object(oid)
+        from .object_ref import ObjectRef
+
+        arg_ids = [a.object_id() for a in spec.args
+                   if isinstance(a, ObjectRef)]
+        arg_ids += [v.object_id() for v in spec.kwargs.values()
+                    if isinstance(v, ObjectRef)]
+        rc.add_submitted_task_references(arg_ids)
+
     def num_pending(self) -> int:
         with self._lock:
             return len(self._pending)
@@ -145,6 +210,10 @@ class TaskManager:
     def num_retries(self) -> int:
         with self._lock:
             return self._num_retries
+
+    def num_reconstructions(self) -> int:
+        with self._lock:
+            return self._num_reconstructions
 
 
 def _sizeof(value) -> int:

@@ -82,8 +82,23 @@ class JaxTrainer:
                 from .worker_group import process_identity
 
                 mine = process_identity()
-                colocated = all(ident == mine
-                                for ident in group.run_all("identity"))
+                idents = group.run_all("identity")
+                colocated = all(ident == mine for ident in idents)
+                if (not colocated and self.scaling_config.mesh is not None
+                        and self.scaling_config.num_workers > 1):
+                    # The gang spans processes/hosts: form ONE global
+                    # jax runtime so the mesh covers every worker's
+                    # devices (multi-controller SPMD; reference shape:
+                    # _setup_torch_process_group, train/torch/config.py:66).
+                    if len(set(idents)) != len(idents):
+                        raise ValueError(
+                            "distributed training needs one worker per "
+                            "node process (actors share their node's "
+                            "jax runtime) — got multiple workers on one "
+                            "node; use placement_strategy='SPREAD'")
+                    coordinator = ray_tpu.get(
+                        group.workers[0].reserve_coordinator.remote())
+                    group.run_all("setup_distributed", coordinator)
                 refs = group.run_all_async(
                     "run", self.train_loop_per_worker,
                     self.train_loop_config, self.scaling_config.mesh,

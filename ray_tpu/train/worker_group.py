@@ -2,10 +2,15 @@
 
 Reference: train/_internal/worker_group.py:102,193 — N actors placed by
 a placement group; train/_internal/backend_executor.py:68 starts them
-and installs the distributed backend.  TPU-native backend setup means
-building the jax device mesh (multi-host: `jax.distributed.initialize`
-against the runtime KV rendezvous; single-controller test mode: the
-global mesh is shared by every worker thread).
+and installs the distributed backend (the torch path's process-group
+bootstrap is train/torch/config.py:66 _setup_torch_process_group).
+
+TPU-native backend setup: when the gang spans processes/hosts, rank 0
+reserves a coordinator endpoint and every worker joins one global jax
+runtime via ``jax.distributed.initialize`` — after which
+``jax.devices()`` spans all hosts and the per-run ``MeshSpec`` builds
+ONE multi-host mesh (multi-controller SPMD).  Colocated test gangs skip
+the bootstrap and share the process-local mesh.
 """
 
 from __future__ import annotations
@@ -69,6 +74,10 @@ def process_identity():
     return (node, os.getpid())
 
 
+_jax_distributed_state = {"initialized": False, "coordinator": None,
+                          "rank": None}
+
+
 @ray_tpu.remote
 class _TrainWorker:
     def __init__(self, rank: int, world_size: int):
@@ -77,6 +86,61 @@ class _TrainWorker:
 
     def identity(self):
         return process_identity()
+
+    def reserve_coordinator(self) -> str:
+        """Rank 0: reserve a host:port for the jax coordination service
+        (reference analogue: the TCP store master address in
+        train/torch/config.py:66)."""
+        import socket
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        rt = ray_tpu.get_runtime()
+        host = "127.0.0.1"
+        if rt.cluster is not None:
+            host = rt.cluster.address.rsplit(":", 1)[0]
+        return f"{host}:{port}"
+
+    def setup_distributed(self, coordinator: str) -> bool:
+        """Join the global jax runtime (jax.distributed.initialize).
+
+        One call per OS process: actors run as threads inside their
+        node's process, so a multi-host gang needs one worker per node
+        (SPREAD placement).  jax backends must not have been touched in
+        this process yet — detect_node_resources deliberately avoids
+        probing on CPU-forced workers for this reason."""
+        import jax
+
+        st = _jax_distributed_state
+        if st["initialized"]:
+            if (st["coordinator"] == coordinator
+                    and st["rank"] == self.rank):
+                return True  # FailureConfig retry landed on the same node
+            raise RuntimeError(
+                f"jax.distributed already initialized in this process "
+                f"(coordinator {st['coordinator']}, rank {st['rank']}); "
+                f"a distributed gang needs one train worker per node — "
+                f"use placement_strategy='SPREAD' or STRICT_SPREAD")
+        import os
+
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # Cross-process CPU collectives (virtual-device test mode).
+            # Probing jax.default_backend() here would initialize the
+            # backend and break initialize(), so gate on the env var.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=self.world_size,
+            process_id=self.rank)
+        st.update(initialized=True, coordinator=coordinator,
+                  rank=self.rank)
+        return True
 
     def run(self, loop_fn: Callable, loop_config: Optional[Dict[str, Any]],
             mesh_spec: Optional[MeshSpec], collector,

@@ -37,6 +37,13 @@ class PlacementGroup:
         self.name = name
         self._ready_event = threading.Event()
         self._removed = False
+        # Guards the ready/removed handoff: reservation completes in a
+        # background thread, and exactly ONE of {reserver, remover} must
+        # tear a completed reservation down.
+        self._state_lock = threading.Lock()
+        # Cluster mode: {"nodes": [node_id per bundle],
+        # "addresses": [addr per bundle]} once reserved.
+        self._cluster_assignment: Optional[Dict[str, List[str]]] = None
 
     # -- lifecycle -----------------------------------------------------------
     def ready(self):
@@ -69,12 +76,14 @@ class PlacementGroup:
                        bundle_index: int = -1) -> Dict[str, float]:
         """Rewrite a task's demand onto this PG's synthetic resources.
 
-        Single-node note: capacity is minted only at the aggregate
+        Single-node mode mints capacity only at the aggregate
         (wildcard) level, so indexed and wildcard consumers draw from one
         pool — on one node every bundle is co-located anyway, and a split
         pool would let the two forms double-spend the reservation.
-        Cluster mode places bundles on nodes and enforces per-bundle
-        capacity there.
+        Cluster mode mints per-bundle indexed capacity on the node
+        holding each bundle (reference: CPU_group_<i>_<pgid> synthetic
+        resources, raylet/placement_group_resource_manager.h), so an
+        indexed demand lands exactly on its bundle's node.
         """
         if self._removed:
             raise ValueError(f"placement group {self.id!r} was removed")
@@ -82,6 +91,9 @@ class PlacementGroup:
             raise ValueError(
                 f"bundle index {bundle_index} out of range "
                 f"(PG has {len(self.bundles)} bundles)")
+        if self._cluster_assignment is not None and bundle_index >= 0:
+            return {self.group_resource_name(k, bundle_index): v
+                    for k, v in demand.items()}
         return {self.group_resource_name(k): v for k, v in demand.items()}
 
     def synthetic_capacity(self) -> Dict[str, float]:
@@ -121,7 +133,12 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     with _lock:
         _groups[pg.id] = pg
 
-    # Reserve: acquire the aggregate demand from the node, then mint
+    if rt.cluster is not None:
+        threading.Thread(target=_reserve_cluster, args=(rt, pg),
+                         daemon=True).start()
+        return pg
+
+    # Single node: acquire the aggregate demand locally, then mint
     # synthetic bundle resources (the one-node analogue of the GCS
     # two-phase prepare/commit across raylets).
     total: Dict[str, float] = {}
@@ -133,11 +150,89 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
         if not rt.node_resources.can_ever_fit(total):
             return  # infeasible — stays pending forever, like reference
         rt.node_resources.acquire(total)
-        rt.node_resources.add_capacity(pg.synthetic_capacity())
-        pg._ready_event.set()
+        with pg._state_lock:
+            if not pg._removed:
+                rt.node_resources.add_capacity(pg.synthetic_capacity())
+                pg._ready_event.set()
+                return
+        # Removed while we were acquiring: give the resources back.
+        rt.node_resources.release(total)
 
     threading.Thread(target=reserve, daemon=True).start()
     return pg
+
+
+def bundle_capacity(pg_id_hex: str,
+                    bundles: Dict[int, Dict[str, float]]
+                    ) -> Dict[str, float]:
+    """Synthetic resources a node advertises for the PG bundles it
+    hosts: indexed (``CPU_group_<i>_<pgid>``) + wildcard aggregate
+    (``CPU_group_<pgid>``) — reference
+    raylet/placement_group_resource_manager.h."""
+    cap: Dict[str, float] = {}
+    for i, bundle in bundles.items():
+        for k, v in bundle.items():
+            idx = f"{k}_group_{i}_{pg_id_hex}"
+            wild = f"{k}_group_{pg_id_hex}"
+            cap[idx] = cap.get(idx, 0.0) + v
+            cap[wild] = cap.get(wild, 0.0) + v
+    return cap
+
+
+def _reserve_cluster(rt, pg: PlacementGroup) -> None:
+    """Cluster reservation: the head assigns each bundle a node
+    (strategy-aware, head._create_pg), then every chosen node mints the
+    bundle's synthetic resources against its real capacity (the
+    two-phase prepare/commit of SURVEY A.13, collapsed to assign+mint
+    with per-node rollback on failure)."""
+    resp = rt.cluster.head.call("create_pg", {
+        "pg_id": pg.id.hex(), "bundles": pg.bundles,
+        "strategy": pg.strategy}, timeout=30.0)
+    if not resp.get("ok"):
+        return  # infeasible — stays pending, like the reference
+    nodes, addrs = resp["nodes"], resp["addresses"]
+    by_addr: Dict[str, Dict[int, Dict[str, float]]] = {}
+    for i, addr in enumerate(addrs):
+        by_addr.setdefault(addr, {})[i] = pg.bundles[i]
+    minted: List[str] = []
+    for addr, bundles in by_addr.items():
+        try:
+            r = rt.cluster.pool.get(addr).call(
+                "add_pg_capacity",
+                {"pg_id": pg.id.hex(), "bundles": bundles}, timeout=60.0)
+        except Exception:
+            r = {"ok": False}
+        if not r.get("ok"):
+            for done in minted:  # roll back nodes already minted
+                try:
+                    rt.cluster.pool.get(done).call(
+                        "remove_pg_capacity",
+                        {"pg_id": pg.id.hex(),
+                         "bundles": by_addr[done]}, timeout=30.0)
+                except Exception:
+                    pass
+            rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
+            return
+        minted.append(addr)
+    pg._cluster_assignment = {"nodes": nodes, "addresses": addrs}
+    with pg._state_lock:
+        if not pg._removed:
+            pg._ready_event.set()
+            return
+    # remove_placement_group ran while we were reserving (it saw
+    # not-ready and tore nothing down): undo everything now.
+    for addr, bundles in by_addr.items():
+        try:
+            rt.cluster.pool.get(addr).call(
+                "remove_pg_capacity",
+                {"pg_id": pg.id.hex(), "bundles": bundles},
+                timeout=30.0)
+        except Exception:
+            pass
+    try:
+        rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
+    except Exception:
+        pass
 
 
 def get_placement_group_by_id(pg_id: PlacementGroupID) -> PlacementGroup:
@@ -152,14 +247,33 @@ def remove_placement_group(pg: PlacementGroup):
     rt = get_runtime()
     with _lock:
         _groups.pop(pg.id, None)
-    if pg.is_ready():
-        rt.node_resources.remove_capacity(pg.synthetic_capacity())
-        total: Dict[str, float] = {}
-        for b in pg.bundles:
-            for k, v in b.items():
-                total[k] = total.get(k, 0.0) + v
-        rt.node_resources.release(total)
-    pg._removed = True
+    with pg._state_lock:
+        was_ready = pg.is_ready()
+        pg._removed = True
+    if was_ready:
+        if pg._cluster_assignment is not None:
+            by_addr: Dict[str, Dict[int, Dict[str, float]]] = {}
+            for i, addr in enumerate(pg._cluster_assignment["addresses"]):
+                by_addr.setdefault(addr, {})[i] = pg.bundles[i]
+            for addr, bundles in by_addr.items():
+                try:
+                    rt.cluster.pool.get(addr).call(
+                        "remove_pg_capacity",
+                        {"pg_id": pg.id.hex(), "bundles": bundles},
+                        timeout=30.0)
+                except Exception:
+                    pass
+            try:
+                rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
+            except Exception:
+                pass
+        else:
+            rt.node_resources.remove_capacity(pg.synthetic_capacity())
+            total: Dict[str, float] = {}
+            for b in pg.bundles:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            rt.node_resources.release(total)
 
 
 def get_current_placement_group() -> Optional[PlacementGroup]:

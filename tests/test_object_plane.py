@@ -1,0 +1,352 @@
+"""Object plane v2: flat wire layout, node-local store (spill/restore),
+chunked pulls, primary-copy task returns, lineage reconstruction, and
+cross-node streaming generators.
+
+Reference models: plasma (src/ray/object_manager/plasma/store.h:55),
+spill (raylet/local_object_manager.h:41), chunked pull
+(object_manager/pull_manager.h:52), object recovery
+(core_worker/object_recovery_manager.h:41; tested upstream by
+python/ray/tests/test_reconstruction.py), streaming generator item
+reporting (core_worker/task_manager.h:301).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.cluster.serialization import (read_layout_chunk,
+                                           sealed_from_flat, serialize,
+                                           wire_layout, wire_size)
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import ObjectID, TaskID, ActorID, JobID
+from ray_tpu.core.plasma import LocalObjectStore
+
+
+def _oid(i=0):
+    tid = TaskID.for_task(ActorID.nil_for_job(JobID.from_int(7)))
+    return ObjectID.for_return(tid, i)
+
+
+# ---------------------------------------------------------------------------
+# Flat wire layout
+# ---------------------------------------------------------------------------
+
+class TestWireLayout:
+    def test_roundtrip_mixed(self):
+        value = {"w": np.arange(1000, dtype=np.float32).reshape(10, 100),
+                 "meta": ("x", 1, [2.5]), "b": b"raw"}
+        sealed = serialize(value)
+        meta, bufs = wire_layout(sealed)
+        flat = b"".join(bytes(b) for b in bufs)
+        assert len(flat) == wire_size(meta)
+        rebuilt = sealed_from_flat(meta, flat)
+        from ray_tpu.cluster.serialization import deserialize
+
+        out = deserialize(rebuilt)
+        assert out["meta"] == ("x", 1, [2.5])
+        assert out["b"] == b"raw"
+        np.testing.assert_array_equal(out["w"], value["w"])
+
+    def test_chunk_reads_cross_buffer_boundaries(self):
+        sealed = serialize([np.arange(100, dtype=np.int64),
+                            np.ones(50, dtype=np.float32)])
+        meta, bufs = wire_layout(sealed)
+        flat = b"".join(bytes(b) for b in bufs)
+        step = 37  # coprime with buffer sizes → crosses every boundary
+        got = b"".join(read_layout_chunk(bufs, off, step)
+                       for off in range(0, len(flat), step))
+        assert got == flat
+
+    def test_bfloat16_extern(self):
+        import ml_dtypes
+
+        arr = np.arange(64).astype(ml_dtypes.bfloat16)
+        sealed = serialize({"x": arr})
+        meta, bufs = wire_layout(sealed)
+        flat = b"".join(bytes(b) for b in bufs)
+        from ray_tpu.cluster.serialization import deserialize
+
+        out = deserialize(sealed_from_flat(meta, flat))
+        np.testing.assert_array_equal(
+            out["x"].astype(np.float32), arr.astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Node-local store: pinning, spill, restore, chunk serving
+# ---------------------------------------------------------------------------
+
+class TestLocalObjectStore:
+    def test_put_get_free(self, tmp_path):
+        store = LocalObjectStore(spill_dir=str(tmp_path))
+        oid = _oid()
+        store.put_primary(oid, serialize(np.arange(100)))
+        np.testing.assert_array_equal(
+            store.get_sealed(oid).externs[0][1], np.arange(100))
+        store.free(oid)
+        assert store.get_sealed(oid) is None
+
+    def test_spill_past_cap_and_read_back(self, tmp_path):
+        """Past the watermark, LRU primaries spill to disk and reads
+        restore them (local_object_manager.h:41)."""
+        store = LocalObjectStore(spill_dir=str(tmp_path))
+        GLOBAL_CONFIG.set("object_store_memory_bytes", 1 * 1024 * 1024)
+        try:
+            oids, arrays = [], []
+            for i in range(6):  # 6 × 400 KB ≫ 1 MB cap
+                arr = np.full(100_000, i, dtype=np.int32)
+                oid = _oid(i)
+                store.put_primary(oid, serialize(arr))
+                oids.append(oid)
+                arrays.append(arr)
+            stats = store.stats()
+            assert stats["num_spilled"] >= 3
+            assert stats["mem_bytes"] <= 1 * 1024 * 1024
+            # Every object — spilled or resident — reads back intact.
+            for oid, arr in zip(oids, arrays):
+                sealed = store.get_sealed(oid)
+                np.testing.assert_array_equal(sealed.externs[0][1], arr)
+            assert store.stats()["num_restored"] >= 3
+        finally:
+            GLOBAL_CONFIG.reset()
+
+    def test_chunks_served_from_spill_file(self, tmp_path):
+        store = LocalObjectStore(spill_dir=str(tmp_path))
+        GLOBAL_CONFIG.set("object_store_memory_bytes", 1024)
+        try:
+            arr = np.arange(50_000, dtype=np.int64)
+            sealed = serialize(arr)
+            meta, bufs = wire_layout(sealed)
+            flat = b"".join(bytes(b) for b in bufs)
+            oid = _oid()
+            store.put_primary(oid, sealed)
+            # Force it out of memory with a second object.
+            store.put_primary(_oid(1), serialize(np.zeros(1000)))
+            got = b"".join(
+                store.read_chunk(oid, off, 64 * 1024)
+                for off in range(0, len(flat), 64 * 1024))
+            assert got == flat
+        finally:
+            GLOBAL_CONFIG.reset()
+
+
+# ---------------------------------------------------------------------------
+# Cluster: primary-copy returns, chunked pulls, recovery, streaming
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def plane_cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2, resources={"w0": 2}, name="w0")
+    c.add_node(num_cpus=2, resources={"w1": 2}, name="w1")
+    c.connect(num_cpus=2)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+def big_array(n, fill):
+    return np.full(n, fill, dtype=np.float32)
+
+
+@ray_tpu.remote
+def array_sum(a):
+    return float(np.asarray(a).sum())
+
+
+class TestPrimaryCopyReturns:
+    def test_big_return_stays_remote_until_get(self, plane_cluster):
+        """A large task output is pinned on the executing node; the
+        owner holds a location record and materializes on get."""
+        rt = ray_tpu.get_runtime()
+        ref = big_array.options(resources={"w0": 1}).remote(500_000, 3.0)
+        # Wait for completion (location record sealed at the owner).
+        obj = rt.object_store.wait_and_get(ref.object_id(), 30.0)
+        assert obj.location is not None
+        assert obj.sealed is None  # not yet materialized
+        out = ray_tpu.get(ref, timeout=30)
+        assert out.shape == (500_000,) and float(out[0]) == 3.0
+
+    def test_small_return_inlines(self, plane_cluster):
+        rt = ray_tpu.get_runtime()
+        ref = big_array.options(resources={"w0": 1}).remote(10, 1.0)
+        obj = rt.object_store.wait_and_get(ref.object_id(), 30.0)
+        assert obj.sealed is not None and obj.location is None
+
+    def test_chained_tasks_pull_primary_between_nodes(self, plane_cluster):
+        """w0 produces a big primary; w1 consumes it — the argument
+        rides the chunk protocol node-to-node (not through the owner's
+        value)."""
+        a = big_array.options(resources={"w0": 1}).remote(400_000, 2.0)
+        s = array_sum.options(resources={"w1": 1}).remote(a)
+        assert ray_tpu.get(s, timeout=60) == pytest.approx(800_000.0)
+
+    def test_free_releases_primary_on_holder(self, plane_cluster):
+        @ray_tpu.remote
+        def plasma_objects():
+            return ray_tpu.get_runtime().plasma.stats()["num_objects"]
+
+        ref = big_array.options(resources={"w1": 1}).remote(300_000, 1.0)
+        ray_tpu.get(ref, timeout=30)
+        before = ray_tpu.get(
+            plasma_objects.options(resources={"w1": 1}).remote(),
+            timeout=30)
+        assert before >= 1
+        del ref
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            n = ray_tpu.get(
+                plasma_objects.options(resources={"w1": 1}).remote(),
+                timeout=30)
+            if n < before:
+                break
+            time.sleep(0.3)
+        assert n < before
+
+    def test_borrower_pulls_big_owner_value(self, plane_cluster):
+        """A worker fetching a big driver-owned put() gets redirected to
+        the chunk protocol."""
+        data = np.arange(300_000, dtype=np.float64)
+        ref = ray_tpu.put(data)
+        s = array_sum.options(resources={"w0": 1}).remote(ref)
+        assert ray_tpu.get(s, timeout=60) == pytest.approx(data.sum())
+
+
+class TestLineageReconstruction:
+    def test_lost_primary_recomputed_on_get(self, plane_cluster):
+        """Kill the node pinning a task's output: get() transparently
+        re-executes the creating task from pinned lineage
+        (test_reconstruction.py model)."""
+        proc = plane_cluster.add_node(num_cpus=1, resources={"frag": 1},
+                                      name="frag")
+
+        @ray_tpu.remote(max_retries=3)
+        def produce():
+            return np.full(300_000, 7.0, dtype=np.float32)
+
+        # First run lands on the fragile node (resource-pinned), but the
+        # recovery run must fit elsewhere — so demand is soft: use
+        # resources only for the first placement via affinity-by-resource.
+        ref = produce.options(resources={"frag": 1}).remote()
+        ray_tpu.get(ref, timeout=30)  # materialized once
+        rt = ray_tpu.get_runtime()
+        # Drop the materialized copy, keep only the location record —
+        # simulating a consumer that never pulled.
+        obj = rt.object_store.get_if_exists(ref.object_id())
+        assert obj.location is not None
+        obj.sealed = None
+        plane_cluster.kill_node(proc)
+        time.sleep(0.5)
+        with pytest.raises(Exception):
+            # "frag" died with the node: the reconstruction cannot place
+            # and the object resolves to an error...
+            ray_tpu.get(ref, timeout=60)
+
+    def test_lost_primary_recovers_on_survivor(self, plane_cluster):
+        proc = plane_cluster.add_node(num_cpus=1, resources={"eph2": 1},
+                                      name="eph2")
+
+        @ray_tpu.remote(max_retries=3)
+        def produce_anywhere():
+            return np.full(300_000, 5.0, dtype=np.float32)
+
+        # Schedule the first run onto the ephemeral node via affinity.
+        nodes = ray_tpu.get_runtime().cluster.list_nodes()
+        eph = [n for n in nodes if n["total"].get("eph2")][0]
+        from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+        # Soft affinity: lands on the (alive) ephemeral node now, but
+        # the reconstruction may fall back to a survivor.
+        ref = produce_anywhere.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=eph["node_id"], soft=True)).remote()
+        rt = ray_tpu.get_runtime()
+        obj = rt.object_store.wait_and_get(ref.object_id(), 30.0)
+        assert obj.location is not None and obj.location[0] == eph["node_id"]
+        before = rt.task_manager.num_reconstructions()
+        plane_cluster.kill_node(proc)
+        time.sleep(0.5)
+        out = ray_tpu.get(ref, timeout=120)
+        assert float(out[0]) == 5.0 and out.shape == (300_000,)
+        assert rt.task_manager.num_reconstructions() > before
+
+    def test_recursive_recovery_mid_pipeline(self, plane_cluster):
+        """b = f(); c = g(b): kill the node holding BOTH primaries
+        mid-pipeline; getting c reconstructs g, whose missing arg b
+        reconstructs f recursively."""
+        proc = plane_cluster.add_node(num_cpus=2, resources={"eph3": 2},
+                                      name="eph3")
+        nodes = ray_tpu.get_runtime().cluster.list_nodes()
+        eph = [n for n in nodes if n["total"].get("eph3")][0]
+        from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+        strat = NodeAffinitySchedulingStrategy(node_id=eph["node_id"],
+                                               soft=True)
+
+        @ray_tpu.remote(max_retries=3)
+        def stage1():
+            return np.full(300_000, 2.0, dtype=np.float32)
+
+        @ray_tpu.remote(max_retries=3)
+        def stage2(x):
+            return np.asarray(x) + 1.0
+
+        b = stage1.options(scheduling_strategy=strat).remote()
+        c = stage2.options(scheduling_strategy=strat).remote(b)
+        rt = ray_tpu.get_runtime()
+        objc = rt.object_store.wait_and_get(c.object_id(), 30.0)
+        assert objc.location is not None
+        plane_cluster.kill_node(proc)
+        time.sleep(0.5)
+        out = ray_tpu.get(c, timeout=120)
+        assert float(out[0]) == 3.0
+
+
+class TestCrossNodeStreaming:
+    def test_remote_task_generator(self, plane_cluster):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * 10
+
+        g = gen.options(resources={"w0": 1}).remote(5)
+        vals = [ray_tpu.get(r) for r in g]
+        assert vals == [0, 10, 20, 30, 40]
+
+    def test_remote_generator_big_items(self, plane_cluster):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen_arrays():
+            for i in range(3):
+                yield np.full(200_000, float(i), dtype=np.float32)
+
+        g = gen_arrays.options(resources={"w1": 1}).remote()
+        sums = [float(np.asarray(ray_tpu.get(r)).sum()) for r in g]
+        assert sums == [0.0, 200_000.0, 400_000.0]
+
+    def test_remote_generator_error_mid_stream(self, plane_cluster):
+        @ray_tpu.remote(num_returns="streaming")
+        def flaky():
+            yield 1
+            raise ValueError("boom mid-stream")
+
+        g = flaky.options(resources={"w0": 1}).remote()
+        it = iter(g)
+        assert ray_tpu.get(next(it)) == 1
+        with pytest.raises(Exception, match="boom"):
+            ray_tpu.get(next(it))
+
+    def test_remote_actor_streaming_call(self, plane_cluster):
+        @ray_tpu.remote
+        class Streamer:
+            def feed(self, n):
+                for i in range(n):
+                    yield f"chunk-{i}"
+
+        a = Streamer.options(resources={"w1": 1}).remote()
+        g = a.feed.options(num_returns="streaming").remote(4)
+        out = [ray_tpu.get(r) for r in g]
+        assert out == [f"chunk-{i}" for i in range(4)]
