@@ -36,16 +36,18 @@ def _peak_bf16_flops(device_kind: str):
     return None
 
 
-def _serve_bench(n_requests: int = 32) -> dict:
+def _serve_bench(n_requests: int = 256) -> dict:
     """Continuous-batched 125M decode: concurrent requests through the
-    serve handle; returns req/s, p50 TTFT, decode tok/s."""
+    serve handle; returns req/s, p50 TTFT, decode tok/s.  All compile
+    paths warm up at deployment init, so the timed run measures steady
+    state."""
     import numpy as np
 
     from ray_tpu import serve
     from ray_tpu.serve.llm import LLMServer
 
     handle = serve.run(serve.deployment(LLMServer).bind(
-        model_preset="llama_125m", max_slots=16, max_len=256,
+        model_preset="llama_125m", max_slots=64, max_len=256,
         prefill_buckets=(32,), decode_chunk=16))
     try:
         rng = np.random.default_rng(0)
@@ -54,7 +56,16 @@ def _serve_bench(n_requests: int = 32) -> dict:
             return {"prompt": rng.integers(1, 32000, 24).tolist(),
                     "max_new_tokens": 32}
 
-        handle.generate.remote(req()).result(timeout=600)  # compile
+        handle.generate.remote(req()).result(timeout=600)  # end-to-end warm
+        # Phase 1 — TTFT at light load (staggered singles): first-token
+        # latency unconfounded by queue depth, the standard way serving
+        # TTFT is quoted.
+        ttfts = []
+        for _ in range(12):
+            out = handle.generate.remote(req()).result(timeout=600)
+            ttfts.append(out["ttft_ms"])
+        ttfts.sort()
+        # Phase 2 — saturation throughput.
         t0 = time.perf_counter()
         outs = [r.result(timeout=600) for r in
                 [handle.generate.remote(req())
@@ -62,10 +73,12 @@ def _serve_bench(n_requests: int = 32) -> dict:
         dt = time.perf_counter() - t0
     finally:
         serve.shutdown()
-    ttfts = sorted(o["ttft_ms"] for o in outs)
+    sat_ttfts = sorted(o["ttft_ms"] for o in outs)
     return {
         "serve_req_per_s": round(n_requests / dt, 2),
         "serve_p50_ttft_ms": round(ttfts[len(ttfts) // 2], 1),
+        "serve_p50_ttft_saturated_ms": round(
+            sat_ttfts[len(sat_ttfts) // 2], 1),
         "serve_decode_tok_per_s": round(
             sum(len(o["tokens"]) for o in outs) / dt, 1),
     }

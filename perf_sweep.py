@@ -25,7 +25,34 @@ CASES = {
     "flash-full-b24": dict(kw={}, batch=24),
     "dot-full-b16": dict(kw={"attention_impl": "dot"},
                          batch=16),
+    # bf16 adam moments free ~1.8 GB → less (or no) remat fits.
+    "bf16mu-full-b8": dict(kw={}, batch=8, bf16_mu=True),
+    "bf16mu-noremat-b8": dict(kw={"remat": False}, batch=8,
+                              bf16_mu=True),
+    "bf16mu-noremat-b12": dict(kw={"remat": False}, batch=12,
+                               bf16_mu=True),
+    "bf16mu-dotssave-b8": dict(kw={"remat_policy": "dots_saveable"},
+                               batch=8, bf16_mu=True),
+    "bf16mu-dotssave-b16": dict(kw={"remat_policy": "dots_saveable"},
+                                batch=16, bf16_mu=True),
+    "attnout-b8": dict(kw={"remat_policy": "attn_out"}, batch=8),
+    "attnout-b16": dict(kw={"remat_policy": "attn_out"}, batch=16),
+    "bf16mu-attnout-b8": dict(kw={"remat_policy": "attn_out"},
+                              batch=8, bf16_mu=True),
+    "bf16mu-attnout-b16": dict(kw={"remat_policy": "attn_out"},
+                               batch=16, bf16_mu=True),
 }
+
+
+def _optimizer(case):
+    if not case.get("bf16_mu"):
+        return None
+    import jax.numpy as jnp
+    import optax
+
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16))
 
 
 def run_one(tag: str) -> float:
@@ -38,8 +65,10 @@ def run_one(tag: str) -> float:
     case = CASES[tag]
     cfg = llama.LlamaConfig.llama_440m(**case["kw"])
     batch, seq, steps, warmup = case["batch"], 2048, 6, 2
-    state = llama.init_train_state(jax.random.key(0), cfg)
-    step = llama.make_train_step(cfg)
+    opt = _optimizer(case)
+    state = llama.init_train_state(jax.random.key(0), cfg,
+                                   optimizer=opt)
+    step = llama.make_train_step(cfg, optimizer=opt)
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                                 cfg.vocab_size, dtype=jnp.int32)
     b = {"tokens": tokens}

@@ -24,7 +24,7 @@ import time
 import traceback
 from typing import Any, Dict, Optional, Tuple
 
-from .rpc import ClientPool, Deferred, RpcClient, RpcServer
+from .rpc import ClientPool, Deferred, ReconnectingClient, RpcServer
 from .serialization import dumps, from_wire, loads, to_wire
 
 _HEARTBEAT_S = 1.0
@@ -36,7 +36,9 @@ class ClusterClient:
     def __init__(self, runtime, head_address: str,
                  node_name: str = "", labels: Optional[Dict] = None):
         self.runtime = runtime
-        self.head = RpcClient(head_address)
+        # Reconnecting: a head restarting at the same address (GCS FT,
+        # file-backed tables) resumes service for this node.
+        self.head = ReconnectingClient(head_address)
         self.head_address = head_address
         self.pool = ClientPool()
         self.node_id = runtime.node_id.hex()
@@ -65,11 +67,12 @@ class ClusterClient:
 
         self.server = NodeServer(runtime, self)
         self.address = self.server.address
+        self._labels = dict(labels or {})
         self.head.call("register_node", {
             "node_id": self.node_id,
             "address": self.address,
             "resources": dict(runtime.node_resources.total),
-            "labels": dict(labels or {}), "name": node_name,
+            "labels": self._labels, "name": node_name,
         })
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
@@ -80,10 +83,22 @@ class ClusterClient:
     def _heartbeat_loop(self):
         while not self._stopped.wait(_HEARTBEAT_S):
             try:
-                self.head.call("heartbeat", {
+                resp = self.head.call("heartbeat", {
                     "node_id": self.node_id,
                     "available": self.runtime.node_resources.available(),
                 }, timeout=5.0)
+                if resp.get("reregister"):
+                    # The head restarted and lost (or never had) this
+                    # node: re-attach (reference: raylets re-register
+                    # with a recovered GCS, gcs_init_data replay).
+                    self.head.call("register_node", {
+                        "node_id": self.node_id,
+                        "address": self.address,
+                        "resources": dict(
+                            self.runtime.node_resources.total),
+                        "labels": self._labels,
+                        "name": self.node_name,
+                    }, timeout=5.0)
             except (ConnectionError, TimeoutError):
                 if self._stopped.is_set():
                     return

@@ -65,7 +65,15 @@ class NodeEntry:
 
 
 class HeadServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    """``storage_path`` enables GCS fault tolerance (reference:
+    Redis-backed table storage, store_client/redis_store_client.h:106 +
+    gcs_init_data.h replay): durable tables (KV, actor registry, named
+    actors, PGs) snapshot to disk on mutation and replay on restart at
+    the same address; nodes reattach through the heartbeat
+    ``reregister`` handshake."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_path: Optional[str] = None):
         self._lock = threading.Lock()
         self._nodes: Dict[str, NodeEntry] = {}
         # actor_id(bytes) -> {node_id, address, name, namespace, klass}
@@ -75,6 +83,12 @@ class HeadServer:
         # pg_id -> {bundles: [...], nodes: [node_id per bundle]}
         self._pgs: Dict[str, Dict[str, Any]] = {}
         self._spread_rr = 0
+        self._storage_path = storage_path
+        # After a restart, actors replay before their nodes reattach:
+        # give nodes a grace window before declaring them dead.
+        self._replay_grace_until = 0.0
+        if storage_path:
+            self._load_snapshot()
         self._server = RpcServer({
             "register_node": self._register_node,
             "heartbeat": self._heartbeat,
@@ -106,6 +120,51 @@ class HeadServer:
         self._restarter.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+
+    # ---------------------------------------------------- persistence
+    def _mark_dirty(self):
+        """Persist SYNCHRONOUSLY before the mutation's RPC reply: an
+        acknowledged write must survive a crash (the reference Redis
+        store is synchronous on mutation).  Caller holds the lock."""
+        if not self._storage_path:
+            return
+        import pickle
+
+        blob = pickle.dumps({
+            "kv": dict(self._kv),
+            "named": dict(self._named),
+            "actors": {aid: dict(info)
+                       for aid, info in self._actors.items()},
+            "pgs": dict(self._pgs),
+        })
+        tmp = self._storage_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            import os
+
+            os.replace(tmp, self._storage_path)
+        except OSError:
+            pass
+
+    def _load_snapshot(self):
+        import os
+        import pickle
+
+        if not os.path.exists(self._storage_path):
+            return
+        try:
+            with open(self._storage_path, "rb") as f:
+                blob = pickle.load(f)
+        except Exception:
+            return
+        self._kv = dict(blob.get("kv", {}))
+        self._named = dict(blob.get("named", {}))
+        self._actors = dict(blob.get("actors", {}))
+        self._pgs = dict(blob.get("pgs", {}))
+        for info in self._actors.values():
+            info.pop("restart_deadline", None)
+        self._replay_grace_until = time.monotonic() + 15.0
 
     # ------------------------------------------------------------- nodes
     def _register_node(self, p):
@@ -224,6 +283,7 @@ class HeadServer:
                         info.get("restarts_used", 0) + 1
                     info["state"] = "ALIVE"
                     info.pop("restart_deadline", None)
+                    self._mark_dirty()
                 elif time.monotonic() < deadline:
                     # Transient placement/RPC failure: keep trying —
                     # the reference GCS reschedules while the restart
@@ -255,6 +315,20 @@ class HeadServer:
                     if e.alive and e.last_heartbeat < cutoff:
                         e.alive = False
                         self._forget_actors_on(e.node_id)
+                if (self._replay_grace_until
+                        and time.monotonic() > self._replay_grace_until):
+                    # Post-restart sweep: replayed actors whose node
+                    # never reattached get the node-death treatment
+                    # (restart on a survivor or drop).
+                    self._replay_grace_until = 0.0
+                    known = set(self._nodes)
+                    orphan_nodes = {
+                        info["node_id"]
+                        for info in self._actors.values()
+                        if info["node_id"] not in known
+                        and info.get("state", "ALIVE") == "ALIVE"}
+                    for nid in orphan_nodes:
+                        self._forget_actors_on(nid)
 
     # ---------------------------------------------------------- placement
     def _place(self, p):
@@ -351,6 +425,7 @@ class HeadServer:
             exists = key in self._kv
             if p.get("overwrite", True) or not exists:
                 self._kv[key] = p["value"]
+                self._mark_dirty()
                 return {"ok": True, "added": not exists}
         return {"ok": True, "added": False}
 
@@ -362,8 +437,11 @@ class HeadServer:
 
     def _kv_del(self, p):
         with self._lock:
-            return {"deleted": self._kv.pop(
-                (p.get("ns", ""), p["key"]), None) is not None}
+            deleted = self._kv.pop(
+                (p.get("ns", ""), p["key"]), None) is not None
+            if deleted:
+                self._mark_dirty()
+            return {"deleted": deleted}
 
     def _kv_keys(self, p):
         prefix = p.get("prefix", "")
@@ -399,6 +477,7 @@ class HeadServer:
                                          "already taken",
                                 "existing": existing}
                 self._named[key] = p["actor_id"]
+            self._mark_dirty()
         return {"ok": True}
 
     @staticmethod
@@ -428,6 +507,8 @@ class HeadServer:
             if info and info.get("name"):
                 self._named.pop(
                     (info.get("namespace", ""), info["name"]), None)
+            if info is not None:
+                self._mark_dirty()
         return {"ok": info is not None}
 
     def _list_actors_rpc(self, _p):
@@ -480,13 +561,17 @@ class HeadServer:
                                      f"any node (strategy={strategy})"}
                 assignment.append(placed)
             self._pgs[pg_id] = {"bundles": bundles, "nodes": assignment}
+            self._mark_dirty()
             addr = {e.node_id: e.address for e in alive}
         return {"ok": True, "nodes": assignment,
                 "addresses": [addr[n] for n in assignment]}
 
     def _remove_pg(self, p):
         with self._lock:
-            return {"ok": self._pgs.pop(p["pg_id"], None) is not None}
+            removed = self._pgs.pop(p["pg_id"], None) is not None
+            if removed:
+                self._mark_dirty()
+            return {"ok": removed}
 
     def shutdown(self):
         self._server.shutdown()

@@ -437,6 +437,50 @@ class _PendingCall:
         return self._event.is_set()
 
 
+class ReconnectingClient:
+    """RpcClient wrapper that re-dials on a lost connection (reference:
+    the retryable gRPC client every daemon keeps toward the GCS,
+    retryable_grpc_client.h) — the peer surviving a restart at the same
+    address resumes service transparently."""
+
+    def __init__(self, address: str, connect_timeout: float = 10.0):
+        self.address = address
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._client = RpcClient(address, connect_timeout)
+
+    def _reconnect(self) -> RpcClient:
+        with self._lock:
+            client = self._client
+            if client._sock is not None:
+                return client  # another caller already re-dialed
+            client.close()
+            self._client = RpcClient(self.address,
+                                     max(2.0, self._connect_timeout))
+            return self._client
+
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None) -> Any:
+        try:
+            return self._client.call(method, payload, timeout)
+        except ConnectionError:
+            return self._reconnect().call(method, payload, timeout)
+
+    def call_async(self, method: str, payload: Any = None,
+                   callback: Optional[Callable[[Any, bool], None]] = None):
+        try:
+            return self._client.call_async(method, payload, callback)
+        except ConnectionError:
+            return self._reconnect().call_async(method, payload, callback)
+
+    @property
+    def _sock(self):
+        return self._client._sock
+
+    def close(self):
+        self._client.close()
+
+
 class ClientPool:
     """Caches one RpcClient per address (worker↔worker object fetches,
     driver↔many-nodes pushes)."""

@@ -163,6 +163,10 @@ class _ActorCore:
                 "ok" if self._creation_error is None else "error")
             return
         self._call_started(spec)
+        # With max_concurrency > 1 another pool thread may still be
+        # running __init__: no method executes before creation settles
+        # (reference: tasks queue behind actor creation).
+        self._creation_done.wait()
         if self.info.state == ActorState.DEAD:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)
@@ -180,6 +184,12 @@ class _ActorCore:
                 "ok" if self._creation_error is None else "error")
             return
         self._call_started(spec)
+        if not self._creation_done.is_set():
+            # Creation runs synchronously on this loop, so normally it
+            # finished before any method task started; guard anyway
+            # without blocking the loop.
+            await self._loop.run_in_executor(
+                None, self._creation_done.wait)
         if self.info.state == ActorState.DEAD:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)

@@ -26,14 +26,18 @@ _head_server = None
 _head_lock = threading.Lock()
 
 
-def start_head(host: str = "127.0.0.1", port: int = 0) -> str:
-    """Start an in-process head server; returns its address."""
+def start_head(host: str = "127.0.0.1", port: int = 0,
+               storage_path: Optional[str] = None) -> str:
+    """Start an in-process head server; returns its address.
+    ``storage_path`` enables GCS fault tolerance (tables persist and
+    replay on restart at the same address)."""
     global _head_server
     from ..cluster.head import HeadServer
 
     with _head_lock:
         if _head_server is None:
-            _head_server = HeadServer(host, port)
+            _head_server = HeadServer(host, port,
+                                      storage_path=storage_path)
         return _head_server.address
 
 

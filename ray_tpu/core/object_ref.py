@@ -10,10 +10,45 @@ when Python drops the last local reference.
 
 from __future__ import annotations
 
+import collections
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from .ids import ObjectID
+
+# Deferred release queue: ``__del__`` runs inside the garbage collector
+# at ARBITRARY points — including while the collecting thread holds the
+# object-store lock (a dict insert in ``put`` can trigger GC) — so the
+# release path (store free, plasma free, borrower-release RPCs!) must
+# never run inline there.  __del__ only appends to this deque
+# (GIL-atomic, lock-free); a reaper thread drains it.
+_pending_releases: "collections.deque" = collections.deque()
+
+
+def _release_loop():
+    while True:
+        try:
+            rt, oid = _pending_releases.popleft()
+        except IndexError:
+            # Plain polling on purpose: an Event/Condition set from
+            # __del__ could re-enter its own (non-reentrant) lock if GC
+            # fires inside a notify — the very deadlock this thread
+            # exists to avoid.  50 ms idle latency is invisible to the
+            # GC-driven release path.
+            time.sleep(0.05)
+            continue
+        if rt.is_shutdown:
+            continue
+        try:
+            rt.reference_counter.remove_local_reference(oid)
+        except Exception:
+            pass
+
+
+_reaper = threading.Thread(target=_release_loop, daemon=True,
+                           name="raytpu-ref-reaper")
+_reaper.start()
 
 
 class ObjectRef:
@@ -58,10 +93,7 @@ class ObjectRef:
     def __del__(self):
         rt = self._runtime
         if rt is not None and not rt.is_shutdown:
-            try:
-                rt.reference_counter.remove_local_reference(self._id)
-            except Exception:
-                pass
+            _pending_releases.append((rt, self._id))
 
     # Futures protocol -------------------------------------------------------
     def future(self) -> "threading.Event":

@@ -1,0 +1,197 @@
+"""Job submission: run driver scripts as supervised subprocesses.
+
+Reference: the dashboard job module — JobManager
+(dashboard/modules/job/job_manager.py:59) starts a per-job
+``JobSupervisor`` actor (job_supervisor.py:54) which runs the
+entrypoint as a subprocess, tracks its status in the GCS job table,
+and captures its logs; the SDK (modules/job/sdk.py:35) submits/polls/
+stops.  Same shape here minus the REST layer: ``submit_job`` creates a
+detached supervisor actor on the cluster, job metadata lives in the
+head KV under the "jobs" namespace, and logs land in a per-job file
+the supervisor can stream back.
+
+Runtime env: ``working_dir`` (the subprocess cwd) and ``env_vars`` are
+materialized; pip/conda envs are out of scope for this image (no
+network installs) and raise.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_KV_NS = "jobs"
+
+VALID_STATUSES = ("PENDING", "RUNNING", "SUCCEEDED", "FAILED", "STOPPED")
+
+
+class JobSupervisor:
+    """Detached actor owning one job subprocess
+    (job_supervisor.py:54)."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 runtime_env: Optional[Dict[str, Any]] = None,
+                 log_dir: Optional[str] = None):
+        import ray_tpu
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        runtime_env = runtime_env or {}
+        unsupported = set(runtime_env) - {"working_dir", "env_vars"}
+        if unsupported:
+            raise ValueError(
+                f"runtime_env keys {sorted(unsupported)} are not "
+                f"supported (no network installs in this environment)")
+        self._rt = ray_tpu.get_runtime()
+        log_dir = log_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "ray_tpu_jobs")
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_path = os.path.join(log_dir, f"{job_id}.log")
+        env = dict(os.environ)
+        env.update({str(k): str(v)
+                    for k, v in (runtime_env.get("env_vars") or {}).items()})
+        head = getattr(self._rt.cluster, "head_address", "")
+        if head:
+            env["RAY_TPU_HEAD_ADDRESS"] = head
+        cwd = runtime_env.get("working_dir") or None
+        self._update(status="RUNNING", start_time=time.time())
+        self._log = open(self.log_path, "wb")
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, cwd=cwd, env=env,
+            stdout=self._log, stderr=subprocess.STDOUT)
+        self._stopped = False
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _update(self, **fields):
+        cur = self._rt.cluster.kv_get(self.job_id, ns=_KV_NS) or {}
+        cur.update(fields)
+        cur.setdefault("job_id", self.job_id)
+        cur.setdefault("entrypoint", self.entrypoint)
+        cur["log_path"] = getattr(self, "log_path", "")
+        self._rt.cluster.kv_put(self.job_id, cur, ns=_KV_NS)
+
+    def _wait(self):
+        rc = self._proc.wait()
+        self._log.close()
+        if self._stopped:
+            status = "STOPPED"
+        else:
+            status = "SUCCEEDED" if rc == 0 else "FAILED"
+        self._update(status=status, return_code=rc,
+                     end_time=time.time())
+
+    def stop(self) -> bool:
+        self._stopped = True
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        return True
+
+    def logs(self, tail_bytes: int = 1 << 20) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def poll(self) -> Optional[int]:
+        return self._proc.poll()
+
+
+def submit_job(entrypoint: str, *,
+               runtime_env: Optional[Dict[str, Any]] = None,
+               submission_id: Optional[str] = None) -> str:
+    """Start a job; returns its id (reference: POST /api/jobs/,
+    job_head.py:329 → JobManager.submit_job)."""
+    import ray_tpu
+
+    rt = ray_tpu.get_runtime()
+    if rt.cluster is None:
+        raise RuntimeError("job submission needs a cluster "
+                           "(ray_tpu.init(address=...))")
+    job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+    rt.cluster.kv_put(job_id, {
+        "job_id": job_id, "entrypoint": entrypoint,
+        "status": "PENDING", "submit_time": time.time(),
+    }, ns=_KV_NS)
+    import ray_tpu as _r
+
+    _r.remote(JobSupervisor).options(
+        name=f"_job_supervisor:{job_id}", lifetime="detached",
+    ).remote(job_id, entrypoint, runtime_env)
+    return job_id
+
+
+def get_job_info(job_id: str) -> Dict[str, Any]:
+    import ray_tpu
+
+    info = ray_tpu.get_runtime().cluster.kv_get(job_id, ns=_KV_NS)
+    if info is None:
+        raise KeyError(f"no such job {job_id!r}")
+    return info
+
+
+def get_job_status(job_id: str) -> str:
+    return get_job_info(job_id)["status"]
+
+
+def get_job_logs(job_id: str) -> str:
+    import ray_tpu
+
+    try:
+        sup = ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+        return ray_tpu.get(sup.logs.remote(), timeout=30)
+    except Exception:
+        # Supervisor gone (job long finished): read the file directly
+        # if it is local.
+        info = get_job_info(job_id)
+        path = info.get("log_path")
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                return f.read().decode(errors="replace")
+        return ""
+
+
+def stop_job(job_id: str) -> bool:
+    import ray_tpu
+
+    try:
+        sup = ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+    except Exception:
+        return False
+    return ray_tpu.get(sup.stop.remote(), timeout=30)
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    cluster = ray_tpu.get_runtime().cluster
+    out = []
+    for key in cluster.kv_keys(ns=_KV_NS):
+        info = cluster.kv_get(key, ns=_KV_NS)
+        if info:
+            out.append(info)
+    return sorted(out, key=lambda j: j.get("submit_time", 0))
+
+
+def wait_job(job_id: str, timeout: float = 300.0,
+             poll_s: float = 0.25) -> str:
+    """Block until the job reaches a terminal status."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = get_job_status(job_id)
+        if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            return status
+        time.sleep(poll_s)
+    raise TimeoutError(f"job {job_id} still {status} after {timeout}s")

@@ -419,6 +419,12 @@ def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
                   attention_fn: Callable) -> jax.Array:
     q, k, v = _qkv_rope(x, layer, sin, cos, config)
     attn = attention_fn(q, k, v, positions)
+    # Named for the "attn_out" remat policy: saving ONLY this tensor
+    # (~B·S·H bf16 per layer) spares the backward pass a full flash-
+    # attention forward recompute while everything else remats.
+    from jax.ad_checkpoint import checkpoint_name
+
+    attn = checkpoint_name(attn, "attn_out")
     return _attn_out_mlp(x, attn, layer, config)
 
 
@@ -473,6 +479,12 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
                 "dots":
                     jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 "dots_saveable": jax.checkpoint_policies.dots_saveable,
+                # Save just the attention outputs (checkpoint_name in
+                # decoder_layer): the backward never re-runs flash
+                # attention, at ~B·S·H bf16 per layer of memory.
+                "attn_out":
+                    jax.checkpoint_policies.save_only_these_names(
+                        "attn_out"),
             }
             block = jax.checkpoint(block,
                                    policy=policies[c.remat_policy])
@@ -648,6 +660,70 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
     dt = dtype or c.dtype
     shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def prefill_forward(params: PyTree, tokens: jax.Array,
+                    lengths: jax.Array, config: LlamaConfig
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal forward over right-padded prompts for cache insertion.
+
+    tokens: (G, P) int32 right-padded prompts; lengths: (G,) real
+    lengths.  Runs plain causal attention WITHIN each prompt (no cache
+    read — massively cheaper than attending the full slot cache) and
+    returns (last_logits (G, V), ks, vs) where ks/vs are (L, G, P,
+    Hkv, D) ready to insert into slot caches and last_logits are the
+    logits at each prompt's final real token (so the first generated
+    token comes out of the prefill call itself — one less decode
+    round-trip of TTFT).  Padding rows produce garbage K/V beyond
+    lengths; the decode path overwrites each position before it first
+    attends it, so they are never observed."""
+    c = config
+    G, P = tokens.shape
+    dt = c.dtype
+    x = params["embed_tokens"].astype(dt)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :],
+                                 (G, P))
+    sin, cos = rope_table(positions, c.head_dim, c.rope_theta)
+
+    def body(x, layer):
+        q, k, v = _qkv_rope(x, layer, sin, cos, c)
+        attn = dot_attention(q, k, v, positions)
+        x = _attn_out_mlp(x, attn, layer, c)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(lambda x, l: body(x, l), x,
+                               params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)  # (G,1,H)
+    head = (params["embed_tokens"].astype(dt).T if c.tie_embeddings
+            else params["lm_head"].astype(dt))
+    last_logits = matmul(last, head)[:, 0]
+    return last_logits, ks, vs
+
+
+def insert_prefill(cache: Dict[str, jax.Array], ks: jax.Array,
+                   vs: jax.Array, slots: jax.Array) -> Dict[str, jax.Array]:
+    """Insert prefilled K/V rows into slot caches without per-slot
+    scatters (XLA TPU serializes those): a one-hot slot projection
+    spreads the group onto the batch axis, then a STATIC row-range
+    select writes rows [0, P).  slots: (G,) int32; a negative slot
+    drops that group member (partial-group padding)."""
+    B = cache["k"].shape[1]
+    P = ks.shape[2]
+    onehot = (slots[:, None] ==
+              jnp.arange(B, dtype=jnp.int32)[None, :])
+    proj = onehot.astype(cache["k"].dtype)
+    written = onehot.any(axis=0)[None, :, None, None, None]
+
+    def ins(full, rows):
+        spread = jnp.einsum("gb,lgphd->lbphd", proj,
+                            rows.astype(full.dtype))
+        cur = jax.lax.slice_in_dim(full, 0, P, axis=2)
+        new = jnp.where(written, spread, cur)
+        return jax.lax.dynamic_update_slice_in_dim(full, new, 0, axis=2)
+
+    return {"k": ins(cache["k"], ks), "v": ins(cache["v"], vs)}
 
 
 def _cache_attend(q, ck, cv, q_positions, scale):

@@ -1,0 +1,17 @@
+"""ray_tpu.rllib — reinforcement learning on the ray_tpu runtime.
+
+Reference: rllib/ (194k LoC).  The MVP covers the new-API-stack shape
+(SURVEY §2.7): an ``Algorithm`` driving an ``EnvRunnerGroup`` of
+sampling actors and a jitted mesh-parallel learner
+(rllib/algorithms/ppo/ppo.py:60, env/env_runner_group.py:70,
+core/learner/learner_group.py:81) — TPU-first: the learner update is
+one XLA program whose gradients psum over the mesh's data axis, not a
+torch DDP wrapper.
+"""
+
+from .algorithm import Algorithm
+from .env_runner import EnvRunner, EnvRunnerGroup
+from .algorithms.ppo import PPO, PPOConfig
+
+__all__ = ["Algorithm", "EnvRunner", "EnvRunnerGroup", "PPO",
+           "PPOConfig"]

@@ -1,0 +1,72 @@
+"""Algorithm base: the Tune-trainable-shaped driver object.
+
+Reference: rllib/algorithms/algorithm.py:229 — ``Algorithm`` is a Tune
+``Trainable`` whose ``train()`` runs one iteration (sample + learn)
+and returns a result dict; ``save/restore`` checkpoint the learner
+state.  The ray_tpu.tune Tuner consumes the same contract through a
+function trainable (``algo.as_trainable()``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+
+class Algorithm:
+    def __init__(self, config):
+        self.config = config
+        self.iteration = 0
+
+    # -- one sample+learn round; subclasses implement _step ---------------
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        result = self._step()
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    def _step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({"iteration": self.iteration,
+                         "state": self.get_state()}, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self.iteration = blob["iteration"]
+        self.set_state(blob["state"])
+
+    def stop(self) -> None:
+        pass
+
+    # -- Tune integration ---------------------------------------------------
+    def as_trainable(self, num_iterations: int,
+                     report_fn: Optional[Callable] = None):
+        """A ray_tpu.tune function trainable running this algorithm
+        (reference: Algorithm IS a Trainable; here the function API
+        wraps it)."""
+        algo = self
+
+        def trainable(config):
+            from ray_tpu import tune
+
+            for _ in range(num_iterations):
+                result = algo.train()
+                (report_fn or tune.report)(result)
+
+        return trainable

@@ -1,0 +1,156 @@
+"""Sampling actors: vectorized env rollouts under the current policy.
+
+Reference: rllib/env/env_runner_group.py:70 (EnvRunnerGroup) +
+env/single_agent_env_runner.py:64 (SingleAgentEnvRunner) — actors that
+hold environments, receive policy weights, and return sample batches.
+GAE advantages are computed runner-side (numpy over the fragment) so
+the learner consumes ready (obs, action, logp, advantage, return)
+tuples — the connector-pipeline role (rllib/connectors/) collapsed to
+its default math.
+
+Fault tolerance: the group restarts failed runners on the next sample
+round (reference: FaultAwareApply, env/env_runner.py:28).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+def _make_env(env_spec):
+    """env_spec: a creator callable, or a gymnasium env id string."""
+    if callable(env_spec):
+        return env_spec()
+    import gymnasium
+
+    return gymnasium.make(env_spec)
+
+
+class EnvRunner:
+    """One sampling actor: N vectorized envs stepped for T-step
+    fragments under the given policy params."""
+
+    def __init__(self, env_spec, num_envs: int, rollout_len: int,
+                 gamma: float, gae_lambda: float, seed: int,
+                 hidden=(64, 64)):
+        self.envs = [_make_env(env_spec) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self.hidden = tuple(hidden)
+        self._rng = np.random.default_rng(seed)
+        self._obs = np.stack([
+            env.reset(seed=seed + i)[0]
+            for i, env in enumerate(self.envs)]).astype(np.float32)
+        self._episode_return = np.zeros(num_envs, np.float64)
+        self._completed_returns: List[float] = []
+        self._apply = None
+
+    def _policy(self, params, obs):
+        import jax
+
+        from .models import apply_actor_critic
+
+        if self._apply is None:
+            self._apply = jax.jit(apply_actor_critic)
+        logits, value = self._apply(params, obs)
+        return np.asarray(logits), np.asarray(value)
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        """Collect one fragment; returns flattened (T*E, ...) arrays
+        with GAE advantages and value targets."""
+        T, E = self.rollout_len, self.num_envs
+        obs_buf = np.zeros((T, E) + self._obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, E), np.int32)
+        logp_buf = np.zeros((T, E), np.float32)
+        rew_buf = np.zeros((T, E), np.float32)
+        done_buf = np.zeros((T, E), np.float32)
+        val_buf = np.zeros((T + 1, E), np.float32)
+
+        for t in range(T):
+            logits, value = self._policy(params, self._obs)
+            # Gumbel-max categorical sample + exact log-prob.
+            z = logits - logits.max(-1, keepdims=True)
+            logp_all = z - np.log(np.exp(z).sum(-1, keepdims=True))
+            g = self._rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + g, axis=-1)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = np.take_along_axis(
+                logp_all, actions[:, None], axis=-1)[:, 0]
+            val_buf[t] = value
+            for e, env in enumerate(self.envs):
+                nobs, rew, term, trunc, _info = env.step(int(actions[e]))
+                rew_buf[t, e] = rew
+                self._episode_return[e] += rew
+                if term or trunc:
+                    done_buf[t, e] = 1.0
+                    self._completed_returns.append(
+                        float(self._episode_return[e]))
+                    self._episode_return[e] = 0.0
+                    nobs, _ = env.reset()
+                self._obs[e] = nobs
+        _logits, bootstrap = self._policy(params, self._obs)
+        val_buf[T] = bootstrap
+
+        # GAE (runner-side; truncation treated as termination — the
+        # standard CartPole-scale simplification).
+        adv = np.zeros((T, E), np.float32)
+        last = np.zeros(E, np.float32)
+        for t in reversed(range(T)):
+            nonterm = 1.0 - done_buf[t]
+            delta = (rew_buf[t] + self.gamma * val_buf[t + 1] * nonterm
+                     - val_buf[t])
+            last = delta + self.gamma * self.gae_lambda * nonterm * last
+            adv[t] = last
+        returns = adv + val_buf[:T]
+
+        completed, self._completed_returns = self._completed_returns, []
+        flat = lambda a: a.reshape((T * E,) + a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs_buf), "actions": flat(act_buf),
+            "logp": flat(logp_buf), "advantages": flat(adv),
+            "returns": flat(returns),
+            "episode_returns": np.asarray(completed, np.float64),
+        }
+
+
+class EnvRunnerGroup:
+    """Actor gang of EnvRunners (env_runner_group.py:70)."""
+
+    def __init__(self, env_spec, *, num_runners: int, num_envs: int,
+                 rollout_len: int, gamma: float, gae_lambda: float,
+                 seed: int = 0, hidden=(64, 64),
+                 runner_resources: Optional[Dict[str, float]] = None):
+        self._factory = lambda i: ray_tpu.remote(EnvRunner).options(
+            **(dict(num_cpus=1, resources=runner_resources)
+               if runner_resources else {})).remote(
+            env_spec, num_envs, rollout_len, gamma, gae_lambda,
+            seed + 1000 * i, hidden)
+        self.runners = [self._factory(i) for i in range(num_runners)]
+
+    def sample_all(self, params) -> List[Dict[str, np.ndarray]]:
+        """One fragment from every runner (parallel).  A failed runner
+        is replaced and skipped this round (FaultAwareApply)."""
+        refs = [r.sample.remote(params) for r in self.runners]
+        out = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(ray_tpu.get(ref, timeout=600))
+            except Exception:
+                self.runners[i] = self._factory(i)
+        if not out:
+            raise RuntimeError("every env runner failed this round")
+        return out
+
+    def shutdown(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

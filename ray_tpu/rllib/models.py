@@ -1,0 +1,49 @@
+"""Actor-critic model for discrete-action policies.
+
+Reference analogue: the RLModule abstraction
+(rllib/core/rl_module/rl_module.py:258) with the default MLP catalog
+(core/models/catalog.py).  Here a model is a pure (init, apply) pair —
+jax pytrees + functions, jittable and mesh-shardable, instead of a
+torch nn.Module.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_actor_critic(rng: jax.Array, obs_dim: int, n_actions: int,
+                      hidden: Sequence[int] = (64, 64)) -> Dict:
+    """Shared-trunk MLP with policy-logit and value heads."""
+    sizes = [obs_dim, *hidden]
+    keys = jax.random.split(rng, len(sizes) + 1)
+    trunk = []
+    for i in range(len(sizes) - 1):
+        w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
+                              jnp.float32)
+        w = w * (2.0 / sizes[i]) ** 0.5
+        trunk.append({"w": w, "b": jnp.zeros(sizes[i + 1], jnp.float32)})
+    d = sizes[-1]
+    return {
+        "trunk": trunk,
+        "pi": {"w": jax.random.normal(keys[-2], (d, n_actions),
+                                      jnp.float32) * 0.01,
+               "b": jnp.zeros(n_actions, jnp.float32)},
+        "vf": {"w": jax.random.normal(keys[-1], (d, 1),
+                                      jnp.float32) * 1.0,
+               "b": jnp.zeros(1, jnp.float32)},
+    }
+
+
+def apply_actor_critic(params: Dict, obs: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """obs (..., obs_dim) → (logits (..., A), value (...))."""
+    x = obs
+    for layer in params["trunk"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value

@@ -1,0 +1,204 @@
+"""The ops CLI: ``python -m ray_tpu <command>``.
+
+Reference: python/ray/scripts/scripts.py (ray start/stop/status/
+timeline/memory, A.4) + the state CLI (util/state/state_cli.py:
+``ray list ...``) + the job CLI (dashboard/modules/job/cli.py).
+
+Commands:
+  start --head [--port P] [--storage PATH]      run a head (blocking)
+  start --address H:P [--num-cpus N] [...]      run a worker node
+  status --address H:P                          cluster summary
+  list (nodes|actors|jobs) --address H:P        state listings
+  timeline --address H:P -o trace.json          Chrome-trace export
+  memory --address H:P                          object-store stats
+  job (submit|status|logs|stop|list) ...        job control
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    return ray_tpu.init(address=address, num_cpus=0)
+
+
+def cmd_start(args) -> int:
+    if args.head:
+        from ray_tpu.cluster.head import HeadServer
+
+        head = HeadServer(args.host, args.port,
+                          storage_path=args.storage or None)
+        print(f"RAY_TPU_HEAD_ADDRESS={head.address}", flush=True)
+        print("To connect: ray_tpu.init(address="
+              f"\"{head.address}\")", flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            return 0
+    if not args.address:
+        print("start needs --head or --address", file=sys.stderr)
+        return 2
+    from ray_tpu.cluster import worker_main
+
+    argv = ["--head", args.address]
+    if args.num_cpus is not None:
+        argv += ["--num-cpus", str(args.num_cpus)]
+    if args.resources:
+        argv += ["--resources", args.resources]
+    if args.name:
+        argv += ["--name", args.name]
+    return worker_main.main(argv)
+
+
+def cmd_status(args) -> int:
+    rt = _connect(args.address)
+    nodes = rt.cluster.list_nodes()
+    alive = [n for n in nodes if n["alive"]]
+    print(f"{len(alive)}/{len(nodes)} nodes alive")
+    totals, avail = {}, {}
+    for n in alive:
+        for k, v in n["total"].items():
+            totals[k] = totals.get(k, 0) + v
+        for k, v in n["available"].items():
+            avail[k] = avail.get(k, 0) + v
+    for k in sorted(totals):
+        if k == "memory":
+            print(f"  {k}: {avail.get(k, 0)/1e9:.1f}/"
+                  f"{totals[k]/1e9:.1f} GB available")
+        elif "_group_" not in k:
+            print(f"  {k}: {avail.get(k, 0):g}/{totals[k]:g} available")
+    actors = rt.cluster.head.call("list_actors", {})
+    print(f"{len(actors)} registered actors")
+    return 0
+
+
+def cmd_list(args) -> int:
+    rt = _connect(args.address)
+    if args.what == "nodes":
+        rows = rt.cluster.list_nodes()
+    elif args.what == "actors":
+        rows = rt.cluster.head.call("list_actors", {})
+        for r in rows:
+            r["actor_id"] = r["actor_id"].hex()[:16]
+    elif args.what == "jobs":
+        from ray_tpu import job as job_mod
+
+        rows = job_mod.list_jobs()
+    else:
+        print(f"unknown listing {args.what!r}", file=sys.stderr)
+        return 2
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    _connect(args.address)
+    from ray_tpu.observability.timeline import export_timeline
+
+    path = export_timeline(args.output)
+    print(f"wrote {path}")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    rt = _connect(args.address)
+    print(json.dumps({
+        "local_store": rt.object_store.stats(),
+        "plasma": rt.plasma.stats(),
+    }, indent=2))
+    return 0
+
+
+def cmd_job(args) -> int:
+    from ray_tpu import job as job_mod
+
+    _connect(args.address)
+    if args.job_cmd == "submit":
+        runtime_env = json.loads(args.runtime_env) \
+            if args.runtime_env else None
+        job_id = job_mod.submit_job(args.entrypoint,
+                                    runtime_env=runtime_env)
+        print(job_id)
+        if args.wait:
+            status = job_mod.wait_job(job_id, timeout=args.timeout)
+            print(status)
+            return 0 if status == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "status":
+        print(job_mod.get_job_status(args.job_id))
+        return 0
+    if args.job_cmd == "logs":
+        print(job_mod.get_job_logs(args.job_id))
+        return 0
+    if args.job_cmd == "stop":
+        print(job_mod.stop_job(args.job_id))
+        return 0
+    if args.job_cmd == "list":
+        print(json.dumps(job_mod.list_jobs(), indent=2, default=str))
+        return 0
+    return 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--storage", default="",
+                   help="head: persistence file (GCS fault tolerance)")
+    p.add_argument("--address", default="")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default="")
+    p.add_argument("--name", default="")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="cluster summary")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("what", choices=["nodes", "actors", "jobs"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline", help="export Chrome trace")
+    p.add_argument("--address", required=True)
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("memory", help="object store stats")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("job", help="job control")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint")
+    js.add_argument("--address", required=True)
+    js.add_argument("--runtime-env", default="")
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=600.0)
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("job_id")
+        jp.add_argument("--address", required=True)
+    jl = jsub.add_parser("list")
+    jl.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_job)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

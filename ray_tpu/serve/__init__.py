@@ -39,11 +39,13 @@ class Deployment:
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
                 user_config: Any = None,
+                autoscaling_config: Optional[dict] = None,
                 ray_actor_options: Optional[dict] = None) -> "Deployment":
         cfg = dict(self._config)
         for k, v in (("num_replicas", num_replicas),
                      ("max_ongoing_requests", max_ongoing_requests),
                      ("user_config", user_config),
+                     ("autoscaling_config", autoscaling_config),
                      ("ray_actor_options", ray_actor_options)):
             if v is not None:
                 cfg[k] = v
@@ -61,14 +63,21 @@ class Deployment:
 def deployment(_callable=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 100,
                user_config: Any = None,
+               autoscaling_config: Optional[dict] = None,
                ray_actor_options: Optional[dict] = None):
-    """``@serve.deployment`` decorator (reference: serve/api.py:246)."""
+    """``@serve.deployment`` decorator (reference: serve/api.py:246).
+
+    ``autoscaling_config`` (reference: serve autoscaling_policy.py):
+    ``{"min_replicas", "max_replicas", "target_ongoing_requests",
+    "interval_s", "downscale_delay_s"}`` — queue-depth-driven replica
+    count between min and max."""
 
     def deco(cd):
         return Deployment(cd, name or cd.__name__, {
             "num_replicas": num_replicas,
             "max_ongoing_requests": max_ongoing_requests,
             "user_config": user_config,
+            "autoscaling_config": autoscaling_config,
             "ray_actor_options": ray_actor_options,
         })
 
@@ -90,8 +99,12 @@ def _get_controller(create: bool = True):
             raise
     from .controller import ServeController
 
+    # High concurrency: membership polls and status queries must stay
+    # answerable while a deploy/rolling update runs (state is guarded
+    # by the controller's own lock).
     return ray_tpu.remote(ServeController).options(
-        name=_CONTROLLER_NAME, lifetime="detached").remote()
+        name=_CONTROLLER_NAME, lifetime="detached",
+        max_concurrency=16).remote()
 
 
 def run(app: Application, *, name: Optional[str] = None,
@@ -105,12 +118,11 @@ def run(app: Application, *, name: Optional[str] = None,
     dep = app.deployment if name is None else \
         app.deployment.options(name=name)
     controller = _get_controller()
+    # user_config is applied to each replica at construction
+    # (_start_replica reconfigures) — no second pass here.
     ray_tpu.get(controller.deploy.remote(
         dep.name, dep._callable, app.init_args, app.init_kwargs,
         dep._config))
-    if dep._config.get("user_config") is not None:
-        ray_tpu.get(controller.reconfigure.remote(
-            dep.name, dep._config["user_config"]))
     handle = get_deployment_handle(dep.name)
     from . import http_proxy
 
@@ -134,8 +146,10 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     import ray_tpu
 
     controller = _get_controller(create=False)
-    replicas = ray_tpu.get(controller.get_replicas.remote(name))
-    return DeploymentHandle(name, replicas)
+    membership = ray_tpu.get(controller.get_membership.remote(name, -1))
+    return DeploymentHandle(name, membership["replicas"],
+                            controller=controller,
+                            version=membership["version"])
 
 
 def status() -> Dict[str, Any]:

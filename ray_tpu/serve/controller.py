@@ -2,13 +2,22 @@
 
 Reference: serve/_private/controller.py:84,719 (``ServeController``
 actor with reconciliation loops) + deployment_state.py:1245,2343
-(replica lifecycle / rolling updates).  MVP scope: deploy/upgrade
-(replace replicas when config changes), scale to ``num_replicas``,
-health-restart dead replicas on demand, handle construction.
+(replica lifecycle / rolling updates) + autoscaling_policy.py /
+autoscaling_state.py (queue-depth-driven replica count).
+
+Scope: deploy with ZERO-DOWNTIME rolling updates (new replica up and
+healthy before an old one drains and stops; falls back to
+stop-then-start when replicas hold exclusive hardware like the one
+TPU), queue-depth autoscaling between min/max replicas, lightweight
+reconfigure, health-gated construction, and membership versioning that
+handles poll to follow replica-set changes (the reference pushes these
+over LongPoll; the handles here poll the version at ~1 Hz).
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -16,62 +25,189 @@ class ServeController:
     """Runs as a detached named actor ("serve_controller")."""
 
     def __init__(self):
-        # name -> {config, replicas: [handles], version}
+        # name -> {config, replicas: [handles], version,
+        #          membership_version, next_replica_id,
+        #          callable, init_args, init_kwargs, autoscale state}
         self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._autoscaler = threading.Thread(
+            target=self._autoscale_loop, daemon=True)
+        self._autoscaler.start()
 
+    # ------------------------------------------------------------ deploy
     def deploy(self, name: str, callable_def, init_args: Tuple,
                init_kwargs: Dict[str, Any], config: Dict[str, Any]):
+        """Slow work (replica construction, health gates, drains) runs
+        OUTSIDE the lock so membership polls and status queries stay
+        live throughout a deploy (the controller actor itself runs with
+        high max_concurrency for the same reason)."""
+        num = max(1, int(config.get("num_replicas", 1)))
+        auto = config.get("autoscaling_config")
+        if auto:
+            num = max(int(auto.get("min_replicas", 1)),
+                      min(num, int(auto.get("max_replicas", num))))
+        spec = {"config": dict(config), "callable": callable_def,
+                "init_args": init_args, "init_kwargs": init_kwargs}
+        with self._lock:
+            existing = self._deployments.get(name)
+            version = (existing["version"] + 1) if existing else 1
+            if existing is None:
+                self._deployments[name] = {
+                    **spec, "replicas": [], "version": version,
+                    "membership_version": 0, "next_replica_id": 0,
+                    "last_downscale_ok": time.monotonic()}
+        if existing is None:
+            for _ in range(num):
+                self._start_replica(name)
+            with self._lock:
+                n = len(self._deployments[name]["replicas"])
+            return {"name": name, "version": version,
+                    "num_replicas": n}
+
+        # Redeploy: CANARY the new version before committing it — a
+        # broken version must not replace the stored spec (the
+        # reference marks the deployment UNHEALTHY and keeps serving
+        # the old version).
+        if self._exclusive_resources(config):
+            # Replicas hold exclusive hardware (e.g. THE TPU): a
+            # rolling overlap deadlocks on the resource, so old
+            # replicas stop before new ones start (brief downtime —
+            # and no canary is possible for the same reason).
+            with self._lock:
+                d = self._deployments[name]
+                old = list(d["replicas"])
+                d["replicas"] = []
+                d.update(**spec, version=version)
+                self._bump_membership(name)
+            self._stop_replicas(old)
+            for _ in range(num):
+                self._start_replica(name)
+        else:
+            canary = self._construct_replica(name, spec, version, 0)
+            with self._lock:
+                d = self._deployments[name]
+                old = list(d["replicas"])
+                d.update(**spec, version=version)
+                d["next_replica_id"] = max(d["next_replica_id"], 1)
+                d["replicas"].append(canary)
+                self._bump_membership(name)
+            # Rolling update (deployment_state.py:1245): one new
+            # replica up and healthy, then one old drained and
+            # stopped — traffic always has a live target.
+            for i in range(num):
+                if i > 0:
+                    self._start_replica(name)
+                if old:
+                    victim = old.pop(0)
+                    with self._lock:
+                        d = self._deployments[name]
+                        if victim in d["replicas"]:
+                            d["replicas"].remove(victim)
+                            self._bump_membership(name)
+                    self._drain_and_stop(victim)
+            if old:
+                with self._lock:
+                    d = self._deployments[name]
+                    d["replicas"] = [r for r in d["replicas"]
+                                     if r not in old]
+                    self._bump_membership(name)
+                self._stop_replicas(old)
+        with self._lock:
+            n = len(self._deployments[name]["replicas"])
+        return {"name": name, "version": version, "num_replicas": n}
+
+    @staticmethod
+    def _exclusive_resources(config: Dict[str, Any]) -> bool:
+        opts = config.get("ray_actor_options") or {}
+        if opts.get("num_tpus"):
+            return True
+        return bool((opts.get("resources") or {}).get("TPU"))
+
+    def _construct_replica(self, name: str, spec: Dict[str, Any],
+                           version: int, rid: int):
+        """Create + health-gate one replica from an explicit spec (no
+        lock held; the caller publishes it)."""
         import ray_tpu
 
         from .replica import Replica
 
-        existing = self._deployments.pop(name, None)
-        version = (existing["version"] + 1) if existing else 1
-        if existing:
-            # Old replicas go down BEFORE new ones come up: a rolling
-            # overlap deadlocks when replicas hold exclusive resources
-            # (e.g. the one TPU) that the new version needs to
-            # initialize.  Brief downtime is the MVP trade.
-            self._stop_replicas(existing["replicas"])
-        num = max(1, int(config.get("num_replicas", 1)))
+        config = spec["config"]
         ray_actor_options = config.get("ray_actor_options") or {}
-        replicas = []
         RemoteReplica = ray_tpu.remote(Replica)
-        for i in range(num):
-            replicas.append(
-                RemoteReplica.options(
-                    name=f"SERVE_{name}#{version}_{i}",
-                    max_concurrency=int(config.get(
-                        "max_ongoing_requests", 100)),
-                    **ray_actor_options,
-                ).remote(name, callable_def, init_args, init_kwargs))
-        # Wait for replica construction before routing traffic
-        # (reference: replicas must pass initialization before the
-        # deployment transitions HEALTHY).
-        for r in replicas:
-            ray_tpu.get(r.health_check.remote())
-        self._deployments[name] = {
-            "config": dict(config), "replicas": replicas,
-            "version": version,
-        }
-        return {"name": name, "version": version,
-                "num_replicas": len(replicas)}
+        replica = RemoteReplica.options(
+            name=f"SERVE_{name}#{version}_{rid}",
+            max_concurrency=int(config.get("max_ongoing_requests", 100)),
+            **ray_actor_options,
+        ).remote(name, spec["callable"], spec["init_args"],
+                 spec["init_kwargs"])
+        # Health-gate before routing traffic (reference: replicas must
+        # pass initialization before the deployment goes HEALTHY).
+        ray_tpu.get(replica.health_check.remote())
+        if config.get("user_config") is not None:
+            ray_tpu.get(replica.reconfigure.remote(
+                config["user_config"]))
+        return replica
 
+    def _start_replica(self, name: str):
+        """Create one replica of the deployment's CURRENT spec, wait
+        for health (outside the lock), publish it."""
+        import ray_tpu
+
+        with self._lock:
+            d = self._deployments[name]
+            spec = {k: d[k] for k in ("config", "callable", "init_args",
+                                      "init_kwargs")}
+            version = d["version"]
+            rid = d["next_replica_id"]
+            d["next_replica_id"] += 1
+        replica = self._construct_replica(name, spec, version, rid)
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None or d["version"] != version:
+                # Deleted or redeployed while we were constructing.
+                self._stop_replicas([replica])
+                return None
+            d["replicas"].append(replica)
+            self._bump_membership(name)
+        return replica
+
+    def _bump_membership(self, name: str):
+        self._deployments[name]["membership_version"] += 1
+
+    # --------------------------------------------------------- membership
     def get_replicas(self, name: str) -> List[Any]:
-        d = self._deployments.get(name)
-        if d is None:
-            raise KeyError(f"no deployment named {name!r} "
-                           f"(have {list(self._deployments)})")
-        return d["replicas"]
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                raise KeyError(f"no deployment named {name!r} "
+                               f"(have {list(self._deployments)})")
+            return list(d["replicas"])
+
+    def get_membership(self, name: str,
+                       known_version: int = -1) -> Optional[Dict]:
+        """None if unchanged since ``known_version``; else the current
+        replica set (the handles' poll-based stand-in for the
+        reference's LongPoll channel)."""
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                raise KeyError(f"no deployment named {name!r}")
+            if d["membership_version"] == known_version:
+                return None
+            return {"version": d["membership_version"],
+                    "replicas": list(d["replicas"])}
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
-        return {
-            name: {"version": d["version"],
-                   "num_replicas": len(d["replicas"]),
-                   "config": d["config"]}
-            for name, d in self._deployments.items()
-        }
+        with self._lock:
+            return {
+                name: {"version": d["version"],
+                       "num_replicas": len(d["replicas"]),
+                       "config": d["config"]}
+                for name, d in self._deployments.items()
+            }
 
+    # -------------------------------------------------------- reconfigure
     def reconfigure(self, name: str, user_config: Any):
         """Push a lightweight config update to live replicas without
         restarting them (reference: deployment_state version diffing)."""
@@ -79,7 +215,101 @@ class ServeController:
 
         for r in self.get_replicas(name):
             ray_tpu.get(r.reconfigure.remote(user_config))
-        self._deployments[name]["config"]["user_config"] = user_config
+        with self._lock:
+            self._deployments[name]["config"]["user_config"] = user_config
+
+    # -------------------------------------------------------- autoscaling
+    def _autoscale_loop(self):
+        """Queue-depth-driven replica count (reference:
+        autoscaling_policy.py): desired = ceil(total_ongoing / target),
+        clamped to [min, max].  Upscale immediately; downscale only
+        after the load has stayed low for ``downscale_delay_s``."""
+        import math
+
+        while not self._stop.wait(0.1):
+            with self._lock:
+                names = [n for n, d in self._deployments.items()
+                         if d["config"].get("autoscaling_config")]
+            for name in names:
+                try:
+                    self._autoscale_one(name, math)
+                except Exception:
+                    pass
+
+    def _autoscale_one(self, name: str, math):
+        import ray_tpu
+
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                return
+            auto = d["config"].get("autoscaling_config") or {}
+            interval = float(auto.get("interval_s", 1.0))
+            last = d.get("last_autoscale_check", 0.0)
+            if time.monotonic() - last < interval:
+                return
+            d["last_autoscale_check"] = time.monotonic()
+            replicas = list(d["replicas"])
+        if not replicas:
+            return
+        total = 0
+        for r in replicas:
+            try:
+                total += ray_tpu.get(r.num_ongoing_requests.remote(),
+                                     timeout=5.0)
+            except Exception:
+                pass
+        target = max(1.0, float(auto.get("target_ongoing_requests", 2)))
+        lo = int(auto.get("min_replicas", 1))
+        hi = int(auto.get("max_replicas", len(replicas)))
+        desired = max(lo, min(hi, math.ceil(total / target)))
+        no_downscale = False
+        scale_up = 0
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None or d["replicas"] != replicas:
+                return  # membership changed under us; resample next tick
+            cur = len(replicas)
+            if desired >= cur:
+                d["last_downscale_ok"] = time.monotonic()
+                scale_up = desired - cur
+                no_downscale = True
+        if no_downscale:
+            for _ in range(scale_up):
+                self._start_replica(name)  # constructs outside the lock
+            return
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None or d["replicas"] != replicas:
+                return
+            delay = float(auto.get("downscale_delay_s", 30.0))
+            if time.monotonic() - d["last_downscale_ok"] < delay:
+                return
+            victims = d["replicas"][desired:]
+            d["replicas"] = d["replicas"][:desired]
+            self._bump_membership(name)
+        for v in victims:
+            self._drain_and_stop(v)
+
+    # ------------------------------------------------------------ teardown
+    def _drain_and_stop(self, replica, timeout: float = 30.0):
+        """Wait for in-flight requests to finish (handles stop routing
+        here once they observe the membership bump), then stop."""
+        import ray_tpu
+
+        # Handles poll membership at ~1 Hz: linger past one period so
+        # in-flight routing decisions against the old set land first.
+        time.sleep(1.2)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if ray_tpu.get(replica.num_ongoing_requests.remote(),
+                               timeout=5.0) == 0:
+                    break
+            except Exception:
+                break
+            time.sleep(0.1)
+        self._stop_replicas([replica])
 
     @staticmethod
     def _stop_replicas(replicas):
@@ -90,7 +320,7 @@ class ServeController:
             # the actor's threads but not background threads the user
             # callable started (e.g. LLMServer's scheduler).
             try:
-                ray_tpu.get(r.shutdown_user.remote(), timeout=10)
+                ray_tpu.get(r.shutdown_user.remote(), timeout=60)
             except Exception:
                 pass
             try:
@@ -99,12 +329,14 @@ class ServeController:
                 pass
 
     def delete(self, name: str):
-        d = self._deployments.pop(name, None)
+        with self._lock:
+            d = self._deployments.pop(name, None)
         if d:
             self._stop_replicas(d["replicas"])
         return d is not None
 
     def shutdown(self):
+        self._stop.set()
         for name in list(self._deployments):
             self.delete(name)
         return True

@@ -4,32 +4,54 @@ Reference: serve/_private/handle.py:619 (``DeploymentHandle``) →
 router.py:334/:559 (``AsyncioRouter.assign_request``) →
 replica_scheduler/pow_2_scheduler.py:52 (power-of-two-choices over
 replica queue lengths).  The reference probes replicas over RPC; here
-the handle tracks its own outstanding count per replica (what the
+the router tracks its own outstanding count per replica (what the
 reference uses as its first-tier signal) — with single-digit
 millisecond actor calls, client-local counts converge on the same
 balance without probe round-trips.
+
+Membership: the router re-checks the controller's membership version
+at ~1 Hz (the reference's LongPoll channel, poll-based), so autoscaled
+and rolling-updated replica sets take effect on live handles without
+re-fetching them.
 """
 
 from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional
+
+_REFRESH_PERIOD_S = 1.0
 
 
 class DeploymentResponse:
     """Future-like result of ``handle.remote()`` (reference:
     handle.py:326)."""
 
-    def __init__(self, ref, on_done):
+    def __init__(self, ref, on_done, retry=None):
         self._ref = ref
         self._on_done = on_done
         self._done = False
+        self._retry = retry
 
     def result(self, timeout: Optional[float] = None):
         import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
 
-        return ray_tpu.get(self._ref, timeout=timeout)
+        attempts = 0
+        while True:
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout)
+            except ActorDiedError:
+                # The replica was stopped (autoscale-down / rolling
+                # update) between our membership snapshot and the call:
+                # re-route over the refreshed set (reference: the
+                # router retries failed replicas).
+                attempts += 1
+                if self._retry is None or attempts > 3:
+                    raise
+                self._ref = self._retry()
 
     def _settle(self):
         # Called exactly once, from the ref's completion callback —
@@ -43,61 +65,143 @@ class DeploymentResponse:
         return self._ref
 
 
-class DeploymentHandle:
-    def __init__(self, deployment_name: str, replicas: List[Any],
-                 method_name: str = ""):
-        self.deployment_name = deployment_name
-        self._replicas = list(replicas)
-        self._method = method_name
-        self._lock = threading.Lock()
-        self._outstanding: Dict[int, int] = {
-            i: 0 for i in range(len(self._replicas))}
+class _Router:
+    """Shared routing state for every view of one deployment's handle:
+    replica set, per-replica outstanding counts, membership version."""
 
-    # -- routing -----------------------------------------------------------
-    def _pick(self) -> int:
-        """Power-of-two-choices on outstanding counts."""
+    def __init__(self, deployment_name: str, replicas: List[Any],
+                 controller=None, version: int = -1):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._version = version
+        self._lock = threading.Lock()
+        self._replicas = list(replicas)
+        # Keyed by replica actor id so counts survive membership swaps.
+        self._outstanding: Dict[Any, int] = {
+            self._key(r): 0 for r in self._replicas}
+        self._last_refresh = time.monotonic()
+
+    @staticmethod
+    def _key(replica):
+        return getattr(replica, "_actor_id", id(replica))
+
+    def force_refresh(self):
+        self._last_refresh = 0.0
+        self._maybe_refresh()
+
+    def _maybe_refresh(self):
+        if self._controller is None:
+            return
+        now = time.monotonic()
+        if now - self._last_refresh < _REFRESH_PERIOD_S:
+            return
+        self._last_refresh = now
+        import ray_tpu
+
+        try:
+            update = ray_tpu.get(self._controller.get_membership.remote(
+                self.deployment_name, self._version), timeout=10.0)
+        except Exception:
+            return  # keep routing over the known set
+        if update is None:
+            return
+        with self._lock:
+            self._version = update["version"]
+            self._replicas = list(update["replicas"])
+            fresh = {}
+            for r in self._replicas:
+                k = self._key(r)
+                fresh[k] = self._outstanding.get(k, 0)
+            self._outstanding = fresh
+
+    def pick(self):
+        """Power-of-two-choices on outstanding counts; returns
+        (replica, key)."""
+        self._maybe_refresh()
         with self._lock:
             n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no live "
+                    f"replicas")
             if n == 1:
                 idx = 0
             else:
                 a, b = random.sample(range(n), 2)
-                idx = a if self._outstanding[a] <= self._outstanding[b] \
-                    else b
-            self._outstanding[idx] += 1
-            return idx
+                ka = self._key(self._replicas[a])
+                kb = self._key(self._replicas[b])
+                idx = a if self._outstanding.get(ka, 0) <= \
+                    self._outstanding.get(kb, 0) else b
+            replica = self._replicas[idx]
+            k = self._key(replica)
+            self._outstanding[k] = self._outstanding.get(k, 0) + 1
+            return replica, k
 
-    def _release(self, idx: int):
+    def release(self, key):
         with self._lock:
-            self._outstanding[idx] -= 1
+            if key in self._outstanding:
+                self._outstanding[key] -= 1
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, replicas: List[Any],
+                 method_name: str = "", controller=None,
+                 version: int = -1, _router: Optional[_Router] = None):
+        self.deployment_name = deployment_name
+        self._router = _router or _Router(deployment_name, replicas,
+                                          controller, version)
+        self._method = method_name
 
     # -- calls -------------------------------------------------------------
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        idx = self._pick()
-        actor = self._replicas[idx]
-        try:
-            ref = actor.handle_request.remote(self._method, args, kwargs)
-        except BaseException:
-            # e.g. PendingCallsLimitExceededError: give the slot back or
-            # the router is permanently biased away from this replica.
-            self._release(idx)
-            raise
-        resp = DeploymentResponse(ref, on_done=lambda: self._release(idx))
+        ref, release = self._issue(args, kwargs)
+
+        def retry():
+            # The failed attempt's slot was already released by its
+            # completion callback (error seals fire it too) — releasing
+            # here again would drive the dead replica's count negative
+            # and bias the router TOWARD it.
+            self._router.force_refresh()
+            new_ref, new_release = self._issue(args, kwargs)
+            resp._on_done = new_release
+            new_ref._on_completed(lambda _o: new_release())
+            return new_ref
+
+        resp = DeploymentResponse(ref, on_done=release, retry=retry)
         # Release the slot when the result lands even if .result() is
         # never called (completion callback keeps counts truthful).
         ref._on_completed(lambda _o: resp._settle())
         return resp
 
+    def _issue(self, args, kwargs):
+        replica, key = self._router.pick()
+        try:
+            ref = replica.handle_request.remote(self._method, args,
+                                                kwargs)
+        except BaseException:
+            # e.g. PendingCallsLimitExceededError: give the slot back or
+            # the router is permanently biased away from this replica.
+            self._router.release(key)
+            raise
+        fired = [False]
+
+        def release_once():
+            # Single-fire: both the completion callback and explicit
+            # paths may call this; the count must drop exactly once.
+            if not fired[0]:
+                fired[0] = True
+                self._router.release(key)
+
+        return ref, release_once
+
     def options(self, *, method_name: Optional[str] = None
                 ) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self._replicas,
-                             method_name if method_name is not None
-                             else self._method)
-        # Share the outstanding-count table so balance is global across
-        # method-scoped views of the same handle.
-        h._outstanding = self._outstanding
-        h._lock = self._lock
-        return h
+        # Views share the router, so balance and membership are global
+        # across method-scoped views of the same handle.
+        return DeploymentHandle(
+            self.deployment_name, [],
+            method_name if method_name is not None else self._method,
+            _router=self._router)
 
     @property
     def method(self):

@@ -92,8 +92,15 @@ class PlacementGroup:
                 f"bundle index {bundle_index} out of range "
                 f"(PG has {len(self.bundles)} bundles)")
         if self._cluster_assignment is not None and bundle_index >= 0:
-            return {self.group_resource_name(k, bundle_index): v
-                    for k, v in demand.items()}
+            # Demand BOTH the indexed and the wildcard name (reference:
+            # indexed consumers debit the wildcard pool too,
+            # placement_group_resource_manager.h) — otherwise an
+            # indexed and a wildcard consumer double-spend one bundle.
+            out: Dict[str, float] = {}
+            for k, v in demand.items():
+                out[self.group_resource_name(k, bundle_index)] = v
+                out[self.group_resource_name(k)] = v
+            return out
         return {self.group_resource_name(k): v for k, v in demand.items()}
 
     def synthetic_capacity(self) -> Dict[str, float]:

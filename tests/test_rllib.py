@@ -1,0 +1,71 @@
+"""RLlib MVP: PPO over actor env-runners reaches the CartPole reward
+threshold (reference model: rllib/algorithms/ppo + the tuned-example
+convergence tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig
+
+
+def test_ppo_cartpole_learns(ray_start_regular):
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                         rollout_fragment_length=128)
+            .training(lr=1e-3, num_epochs=6, minibatch_size=256,
+                      entropy_coeff=0.01, seed=3)
+            .build())
+    best = 0.0
+    for i in range(40):
+        result = algo.train()
+        ret = result["episode_return_mean"]
+        if np.isfinite(ret):
+            best = max(best, ret)
+        if best >= 150.0:
+            break
+    algo.stop()
+    assert best >= 150.0, f"PPO failed to learn CartPole (best={best})"
+    assert result["training_iteration"] == i + 1
+
+
+def test_ppo_checkpoint_roundtrip(ray_start_regular, tmp_path):
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                         rollout_fragment_length=32)
+            .training(num_epochs=1, minibatch_size=64)
+            .build())
+    algo.train()
+    path = algo.save(str(tmp_path / "ckpt"))
+    algo2 = (PPOConfig().environment("CartPole-v1")
+             .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                          rollout_fragment_length=32)
+             .training(num_epochs=1, minibatch_size=64).build())
+    algo2.restore(path)
+    assert algo2.iteration == 1
+    import jax
+
+    a = jax.device_get(algo.params["pi"]["w"])
+    b = jax.device_get(algo2.params["pi"]["w"])
+    np.testing.assert_allclose(a, b)
+    algo.stop()
+    algo2.stop()
+
+
+def test_ppo_mesh_learner_smoke(ray_start_regular):
+    """The learner update compiles and runs over an 8-device mesh
+    (gradient psums inserted by XLA from the shardings)."""
+    from ray_tpu.parallel import MeshSpec
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                         rollout_fragment_length=64)
+            .training(num_epochs=1, minibatch_size=128,
+                      learner_mesh=MeshSpec(data=8))
+            .build())
+    result = algo.train()
+    assert np.isfinite(result["total_loss"])
+    algo.stop()
