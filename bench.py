@@ -149,6 +149,7 @@ def main():
     import ray_tpu
     from ray_tpu import data as rd
 
+    print("bench: train phase start", file=sys.stderr, flush=True)
     state = llama.init_train_state(jax.random.key(0), cfg)
     step = llama.make_train_step(cfg)
 
@@ -204,11 +205,13 @@ def main():
         # the train state is freed.  Failures must not cost the train
         # metric.
         del state
+        print("bench: serve phase start", file=sys.stderr, flush=True)
         try:
             extra.update(_serve_bench())
         except Exception as e:  # noqa: BLE001
             extra["serve_error"] = f"{type(e).__name__}: {e}"
 
+    print("bench: object plane phase start", file=sys.stderr, flush=True)
     try:
         extra.update(_object_plane_bench(
             1024 * 1024 * 1024 if on_tpu else 64 * 1024 * 1024))

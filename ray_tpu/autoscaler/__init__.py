@@ -1,0 +1,177 @@
+"""Autoscaler: demand-driven node provisioning.
+
+Reference: python/ray/autoscaler — the v1 monitor loop
+(_private/autoscaler.py + monitor.py) sizes the cluster from pending
+resource demands via resource_demand_scheduler.py; v2 restates it as a
+declarative reconciler (v2/instance_manager/reconciler.py) over cloud
+``NodeProvider``s; fake_multi_node provides a local provider for tests.
+
+Shape here: the head keeps a ledger of infeasible placements
+(pending_demand RPC); the ``Autoscaler`` reconciler polls it, bin-packs
+the unmet demands against the configured node type, launches nodes
+through a ``NodeProvider``, and terminates idle nodes past
+``idle_timeout_s`` down to ``min_nodes``.  ``LocalNodeProvider``
+launches real worker subprocesses (the fake_multi_node analogue —
+and exactly how a single-host TPU pod slice is carved up); cloud
+providers implement the same 3-method interface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract (reference: autoscaler NodeProvider):
+    create / terminate / list."""
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_tag: str) -> None:
+        raise NotImplementedError
+
+    def live_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Worker subprocesses on this host (reference:
+    autoscaler/_private/fake_multi_node)."""
+
+    def __init__(self, head_address: str,
+                 env: Optional[Dict[str, str]] = None):
+        self.head_address = head_address
+        self._env = env
+        self._procs: Dict[str, Any] = {}
+        self._n = 0
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        from ..core.node import start_worker_process
+
+        res = dict(resources)
+        cpus = res.pop("CPU", 1.0)
+        tag = f"auto-{self._n}"
+        self._n += 1
+        self._procs[tag] = start_worker_process(
+            self.head_address, num_cpus=cpus, resources=res or None,
+            node_name=tag, env=self._env)
+        return tag
+
+    def terminate_node(self, node_tag: str) -> None:
+        proc = self._procs.pop(node_tag, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
+
+    def live_nodes(self) -> List[str]:
+        return [t for t, p in self._procs.items() if p.poll() is None]
+
+    def shutdown(self):
+        for tag in list(self._procs):
+            self.terminate_node(tag)
+
+
+class Autoscaler:
+    """Reconciler loop (reference v2/instance_manager/reconciler.py):
+    observe demand → compute target → converge the provider."""
+
+    def __init__(self, head_address: str, provider: NodeProvider, *,
+                 node_resources: Optional[Dict[str, float]] = None,
+                 min_nodes: int = 0, max_nodes: int = 4,
+                 idle_timeout_s: float = 60.0,
+                 poll_interval_s: float = 1.0):
+        from ..cluster.rpc import ReconnectingClient
+
+        self.provider = provider
+        self.node_resources = dict(node_resources or {"CPU": 1.0})
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self._head = ReconnectingClient(head_address)
+        self._stop = threading.Event()
+        self._idle_since: Dict[str, float] = {}
+        self.num_launched = 0
+        self.num_terminated = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ loop
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self._reconcile()
+            except Exception:
+                pass
+
+    def _reconcile(self):
+        demands = self._head.call("pending_demand",
+                                  {"window_s": 10.0}, timeout=5.0)
+        live = self.provider.live_nodes()
+        # Scale up: bin-pack unmet demands onto hypothetical nodes of
+        # the configured type (reference:
+        # resource_demand_scheduler.py get_nodes_to_launch).
+        want = self._nodes_needed(demands)
+        can_add = min(want, self.max_nodes - len(live))
+        for _ in range(max(0, can_add)):
+            self.provider.create_node(self.node_resources)
+            self.num_launched += 1
+        if want > 0:
+            return  # busy cluster: reset idle tracking next pass
+        # Scale down: terminate nodes idle past the timeout, keeping
+        # min_nodes (reference: NodeIdleTerminationPolicy).
+        nodes = self._head.call("list_nodes", {}, timeout=5.0)
+        busy_names = set()
+        for n in nodes:
+            used = {
+                k: n["total"].get(k, 0) - n["available"].get(k, 0)
+                for k in n["total"]}
+            if any(v > 1e-9 for k, v in used.items() if k != "memory"):
+                busy_names.add(n.get("name") or "")
+        now = time.monotonic()
+        live = self.provider.live_nodes()
+        for tag in live:
+            if tag in busy_names:
+                self._idle_since.pop(tag, None)
+                continue
+            since = self._idle_since.setdefault(tag, now)
+            if (now - since >= self.idle_timeout_s
+                    and len(self.provider.live_nodes()) > self.min_nodes):
+                self.provider.terminate_node(tag)
+                self._idle_since.pop(tag, None)
+                self.num_terminated += 1
+
+    def _nodes_needed(self, demands: List[Dict[str, float]]) -> int:
+        """First-fit-decreasing bin pack of unmet demands into nodes of
+        the configured shape; demands that can never fit are skipped."""
+        shape = self.node_resources
+        feasible = [d for d in demands
+                    if all(shape.get(k, 0) >= v for k, v in d.items())]
+        if not feasible:
+            return 0
+        feasible.sort(key=lambda d: -sum(d.values()))
+        bins: List[Dict[str, float]] = []
+        for d in feasible:
+            placed = False
+            for b in bins:
+                if all(b.get(k, 0) >= v for k, v in d.items()):
+                    for k, v in d.items():
+                        b[k] = b.get(k, 0) - v
+                    placed = True
+                    break
+            if not placed:
+                b = dict(shape)
+                for k, v in d.items():
+                    b[k] = b.get(k, 0) - v
+                bins.append(b)
+        return len(bins)
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

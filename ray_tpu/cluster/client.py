@@ -78,6 +78,14 @@ class ClusterClient:
             target=self._heartbeat_loop, daemon=True,
             name=f"cluster-hb-{self.node_id[:8]}")
         self._hb_thread.start()
+        # Pubsub subscriber: ONE outstanding long-poll against the head
+        # (pubsub/README.md) replaces per-event point-to-point fanout —
+        # node deaths propagate to every node within one poll cycle.
+        self.observed_dead_nodes: set = set()
+        self._sub_thread = threading.Thread(
+            target=self._pubsub_loop, daemon=True,
+            name=f"cluster-sub-{self.node_id[:8]}")
+        self._sub_thread.start()
 
     # ---------------------------------------------------------- heartbeat
     def _heartbeat_loop(self):
@@ -107,6 +115,44 @@ class ClusterClient:
                 time.sleep(_HEARTBEAT_S)
             except Exception:
                 traceback.print_exc()
+
+    # ------------------------------------------------------------- pubsub
+    def _pubsub_loop(self):
+        cursors = {"node_death": 0}
+        while not self._stopped.is_set():
+            try:
+                out = self.head.call(
+                    "pubsub_poll",
+                    {"cursors": cursors, "timeout_s": 15.0},
+                    timeout=25.0)
+            except (ConnectionError, TimeoutError):
+                if self._stopped.wait(1.0):
+                    return
+                continue
+            except Exception:
+                continue
+            ch = (out or {}).get("node_death")
+            if not ch:
+                continue
+            cursors["node_death"] = ch["seq"]
+            for event in ch["events"]:
+                nid = event.get("node_id", "")
+                addr = event.get("address", "")
+                if nid == self.node_id:
+                    continue  # our own (false-positive) death report
+                self.observed_dead_nodes.add(nid)
+                # Proactive cleanup instead of lazy on-access discovery:
+                # drop cached actor locations and the dead node's
+                # borrower holds at this owner.
+                with self._loc_lock:
+                    stale = [a for a, (n, ad) in
+                             self._actor_locations.items()
+                             if n == nid or (addr and ad == addr)]
+                    for aid in stale:
+                        del self._actor_locations[aid]
+                if addr:
+                    self.runtime.reference_counter.remove_borrower_node(
+                        addr)
 
     # ------------------------------------------------------------- tasks
     def placement_params(self, spec) -> dict:

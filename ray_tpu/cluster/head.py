@@ -83,6 +83,9 @@ class HeadServer:
         # pg_id -> {bundles: [...], nodes: [node_id per bundle]}
         self._pgs: Dict[str, Dict[str, Any]] = {}
         self._spread_rr = 0
+        # (monotonic_ts, demand) of recent infeasible placements — the
+        # autoscaler's scale-up signal.
+        self._unmet_demands: List[Tuple[float, Dict[str, float]]] = []
         self._storage_path = storage_path
         # After a restart, actors replay before their nodes reattach:
         # give nodes a grace window before declaring them dead.
@@ -107,8 +110,16 @@ class HeadServer:
             "create_pg": self._create_pg,
             "remove_pg": self._remove_pg,
             "report_node_failure": self._report_node_failure,
+            "pubsub_poll": self._pubsub_poll,
+            "pending_demand": self._pending_demand,
             "ping": lambda p: "pong",
         }, host=host, port=port)
+        # Batched long-poll pubsub: node deaths and actor FSM
+        # transitions fan out through one outstanding poll per
+        # subscriber (src/ray/pubsub/README.md:1-44).
+        from .pubsub import Publisher
+
+        self._publisher = Publisher()
         self.address = self._server.address
         # Actor restart machinery (reference: gcs_actor_manager.h:308
         # FSM — ALIVE → RESTARTING → ALIVE/DEAD with max_restarts).
@@ -120,6 +131,11 @@ class HeadServer:
         self._restarter.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        resume = getattr(self, "_resume_restarting", None)
+        if resume:
+            with self._restart_cond:
+                self._restart_pending.extend(resume)
+                self._restart_cond.notify_all()
 
     # ---------------------------------------------------- persistence
     def _mark_dirty(self):
@@ -162,8 +178,13 @@ class HeadServer:
         self._named = dict(blob.get("named", {}))
         self._actors = dict(blob.get("actors", {}))
         self._pgs = dict(blob.get("pgs", {}))
-        for info in self._actors.values():
+        self._resume_restarting = []
+        for aid, info in self._actors.items():
             info.pop("restart_deadline", None)
+            if info.get("state") == "RESTARTING":
+                # Mid-restart at crash time: re-enqueue once the
+                # restart loop exists (gcs_init_data replay semantics).
+                self._resume_restarting.append(aid)
         self._replay_grace_until = time.monotonic() + 15.0
 
     # ------------------------------------------------------------- nodes
@@ -197,16 +218,42 @@ class HeadServer:
         with self._lock:
             entry = self._nodes.pop(p["node_id"], None)
             self._forget_actors_on(p["node_id"])
+        if entry is not None:
+            self._publish_node_death(p["node_id"], entry.address)
         return {"ok": entry is not None}
 
     def _report_node_failure(self, p):
         """A peer observed a broken connection to this node."""
         with self._lock:
             entry = self._nodes.get(p["node_id"])
+            was_alive = entry is not None and entry.alive
             if entry is not None:
                 entry.alive = False
             dead_actors = self._forget_actors_on(p["node_id"])
+        if was_alive:
+            self._publish_node_death(p["node_id"], entry.address)
         return {"ok": True, "dead_actors": dead_actors}
+
+    def _pending_demand(self, p):
+        """Unmet placement demands within the last ``window_s`` seconds
+        (autoscaler input; reference: GcsAutoscalerStateManager's
+        cluster resource state)."""
+        window = float(p.get("window_s", 10.0))
+        cutoff = time.monotonic() - window
+        with self._lock:
+            self._unmet_demands = [
+                (t, d) for t, d in self._unmet_demands if t > cutoff]
+            return [d for _t, d in self._unmet_demands]
+
+    def _pubsub_poll(self, p):
+        return self._publisher.poll(p.get("cursors", {}),
+                                    timeout_s=min(60.0, float(
+                                        p.get("timeout_s", 30.0))))
+
+    def _publish_node_death(self, node_id: str, address: str = ""):
+        self._publisher.publish("node_death",
+                                {"node_id": node_id,
+                                 "address": address})
 
     def _forget_actors_on(self, node_id: str) -> List[bytes]:
         """Actors on a dead node either enter RESTARTING (spec kept and
@@ -225,6 +272,8 @@ class HeadServer:
                 info["state"] = "RESTARTING"
                 self._restart_pending.append(aid)
                 self._restart_cond.notify_all()
+                self._publisher.publish("actor_state", {
+                    "actor_id": aid, "state": "RESTARTING"})
             else:
                 self._actors.pop(aid)
                 if info.get("name"):
@@ -284,6 +333,10 @@ class HeadServer:
                     info["state"] = "ALIVE"
                     info.pop("restart_deadline", None)
                     self._mark_dirty()
+                    self._publisher.publish("actor_state", {
+                        "actor_id": aid, "state": "ALIVE",
+                        "node_id": placed["node_id"],
+                        "address": placed["address"]})
                 elif time.monotonic() < deadline:
                     # Transient placement/RPC failure: keep trying —
                     # the reference GCS reschedules while the restart
@@ -311,10 +364,12 @@ class HeadServer:
             time.sleep(_DEAD_AFTER_S / 4)
             cutoff = time.monotonic() - _DEAD_AFTER_S
             with self._lock:
+                dead = []
                 for e in self._nodes.values():
                     if e.alive and e.last_heartbeat < cutoff:
                         e.alive = False
                         self._forget_actors_on(e.node_id)
+                        dead.append((e.node_id, e.address))
                 if (self._replay_grace_until
                         and time.monotonic() > self._replay_grace_until):
                     # Post-restart sweep: replayed actors whose node
@@ -329,6 +384,8 @@ class HeadServer:
                         and info.get("state", "ALIVE") == "ALIVE"}
                     for nid in orphan_nodes:
                         self._forget_actors_on(nid)
+            for nid, addr in dead:
+                self._publish_node_death(nid, addr)
 
     # ---------------------------------------------------------- placement
     def _place(self, p):
@@ -396,6 +453,14 @@ class HeadServer:
                     if all(avail[e.node_id].get(k, 0) >= v
                            for k, v in demand.items())]
             if not candidates:
+                if not available_only:
+                    # Demand ledger for the autoscaler (reference:
+                    # pending resource demands feeding
+                    # resource_demand_scheduler.py): infeasible
+                    # placements are the scale-up signal.
+                    self._unmet_demands.append(
+                        (time.monotonic(), dict(demand)))
+                    del self._unmet_demands[:-200]
                 return {"ok": False, "available_only": available_only,
                         "error": f"no node can fit {demand} "
                                  f"(available_only={available_only}, "

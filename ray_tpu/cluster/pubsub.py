@@ -1,0 +1,60 @@
+"""Batched long-poll pubsub (head-side).
+
+Reference: src/ray/pubsub/README.md:1-44 — instead of one RPC per
+event per subscriber, each subscriber keeps ONE outstanding long-poll
+carrying its cursor; the publisher batches everything that arrived
+since and answers immediately when there is anything to deliver,
+otherwise parks the poll until an event or the poll timeout.  Channels
+here: ``node_death``, ``actor_state`` (restart FSM transitions) — the
+fanout paths that were ad-hoc point-to-point RPCs before.
+
+Retention is a bounded ring per channel: a subscriber further behind
+than the window gets the retained suffix (it re-syncs from authoritative
+state — the reference's snapshot-then-follow pattern).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Tuple
+
+_RETAIN = 1000
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # channel -> (next_seq, [(seq, payload), ...])
+        self._channels: Dict[str, Tuple[int, List[Tuple[int, Any]]]] = {}
+
+    def publish(self, channel: str, payload: Any) -> None:
+        with self._cond:
+            seq, events = self._channels.get(channel, (0, []))
+            events.append((seq, payload))
+            if len(events) > _RETAIN:
+                events = events[-_RETAIN:]
+            self._channels[channel] = (seq + 1, events)
+            self._cond.notify_all()
+
+    def poll(self, cursors: Dict[str, int],
+             timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Long-poll: returns {channel: {"events": [...], "seq": n}}
+        for every subscribed channel with news past the cursor; blocks
+        up to ``timeout_s`` when there is none."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                out = {}
+                for channel, since in cursors.items():
+                    seq, events = self._channels.get(channel, (0, []))
+                    fresh = [p for s, p in events if s >= since]
+                    if fresh:
+                        out[channel] = {"events": fresh, "seq": seq}
+                if out:
+                    return out
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return {}
+                self._cond.wait(left)

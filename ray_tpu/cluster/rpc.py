@@ -384,6 +384,11 @@ class RpcClient:
         except (ConnectionError, OSError) as e:
             with self._lock:
                 self._pending.pop(req_id, None)
+            # A failed write means the socket is dead: fail everything
+            # and clear _sock so reconnect wrappers re-dial instead of
+            # reusing this client (the read loop may not have noticed
+            # yet).
+            self._fail_all(e)
             raise ConnectionError(
                 f"send to {self.address} failed: {e}") from e
         except BaseException:

@@ -10,6 +10,7 @@ train/_internal/data_config.py).
 
 from .block import Block, BlockAccessor, BlockMetadata
 from .context import DataContext
+from .executor import ActorPoolStrategy
 from .dataset import (DataIterator, Dataset, from_arrow, from_blocks,
                       from_items, from_numpy, from_pandas, range,
                       read_csv, read_datasource, read_json, read_numpy,
@@ -17,6 +18,7 @@ from .dataset import (DataIterator, Dataset, from_arrow, from_blocks,
 from .datasource import Datasource, FileDatasource, ReadTask
 
 __all__ = [
+    "ActorPoolStrategy",
     "Block", "BlockAccessor", "BlockMetadata", "DataContext",
     "DataIterator", "Dataset", "Datasource", "FileDatasource",
     "ReadTask", "from_arrow", "from_blocks", "from_items", "from_numpy",

@@ -28,7 +28,8 @@ from .context import DataContext
 from .datasource import (BlocksDatasource, Datasource, ItemsDatasource,
                          RangeDatasource, csv_datasource, json_datasource,
                          numpy_datasource, parquet_datasource)
-from .executor import (AllToAll, Limit, LogicalOp, MapBlocks, PlanStats,
+from .executor import (ActorMapBlocks, ActorPoolStrategy, AllToAll,
+                       Exchange, Limit, LogicalOp, MapBlocks, PlanStats,
                        Read, execute_streaming)
 
 
@@ -44,12 +45,30 @@ class Dataset:
     def _with(self, op: LogicalOp) -> "Dataset":
         return Dataset(self._ops + [op])
 
-    def map_batches(self, fn: Callable[[Block], Block], *,
-                    batch_size: Optional[int] = None) -> "Dataset":
+    def map_batches(self, fn, *,
+                    batch_size: Optional[int] = None,
+                    compute: Optional[ActorPoolStrategy] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None
+                    ) -> "Dataset":
         """Apply ``fn`` to batches (reference dataset.map_batches).
         With ``batch_size=None`` the fn sees whole blocks (zero-copy);
         otherwise blocks are re-chunked to exactly ``batch_size`` rows
-        inside the task."""
+        inside the task.
+
+        ``compute=ActorPoolStrategy(size=n)`` makes this a stateful
+        actor-pool stage (reference actor_pool_map_operator.py:34):
+        ``fn`` must be a CLASS, instantiated once per pool actor with
+        ``fn_constructor_args``; each batch goes through
+        ``instance(batch)``."""
+        if compute is not None:
+            if not callable(fn) or not isinstance(fn, type):
+                raise TypeError(
+                    "compute=ActorPoolStrategy requires fn to be a "
+                    "class (instantiated once per pool actor)")
+            return self._with(ActorMapBlocks(
+                fn.__name__, fn, tuple(fn_constructor_args),
+                dict(fn_constructor_kwargs or {}), batch_size, compute))
         if batch_size is None:
             def tf(block: Block) -> List[Block]:
                 return [BlockAccessor.validate(fn(block))]
@@ -92,47 +111,100 @@ class Dataset:
         return self._with(Limit(n))
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        def fn(blocks: List[Block], ctx: DataContext) -> List[Block]:
-            whole = BlockAccessor.concat(blocks)
-            rows = BlockAccessor.num_rows(whole)
-            if rows == 0:
-                return []
-            bounds = np.linspace(0, rows, num_blocks + 1).astype(np.int64)
-            return [BlockAccessor.slice(whole, int(lo), int(hi))
-                    for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
-        return self._with(AllToAll("Repartition", fn))
+        """Distributed exchange: each input splits into ``num_blocks``
+        row ranges (partition tasks), one merge task concatenates each
+        range (reference: planner/exchange/ — no block values cross the
+        driver)."""
+        def partition(block: Block, n: int, spec, offset: int):
+            # Exact global row ranges from the sampled total: output
+            # partition j covers global rows [bounds[j], bounds[j+1]).
+            total = spec["total"]
+            bounds = np.linspace(0, total, n + 1).astype(np.int64)
+            rows = BlockAccessor.num_rows(block)
+            out = []
+            for j in builtins.range(n):
+                lo = max(int(bounds[j]) - offset, 0)
+                hi = min(int(bounds[j + 1]) - offset, rows)
+                if hi > lo:
+                    out.append((j, BlockAccessor.slice(block, lo, hi)))
+            return out
+
+        def merge(blocks: List[Block], _spec) -> List[Block]:
+            return [BlockAccessor.concat(blocks)] if blocks else []
+
+        return self._with(Exchange("Repartition", partition, merge,
+                                   n_out=num_blocks,
+                                   needs_offsets=True))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global shuffle (barrier).  Reference: push-based shuffle
-        (push_based_shuffle_task_scheduler.py:590); single-host MVP does
-        a driver-side permutation, preserving the blocks' row count
-        distribution."""
-        def fn(blocks: List[Block], ctx: DataContext) -> List[Block]:
-            whole = BlockAccessor.concat(blocks)
-            rows = BlockAccessor.num_rows(whole)
-            if rows == 0:
+        """Distributed shuffle (reference: push-based shuffle,
+        push_based_shuffle_task_scheduler.py:590): partition tasks deal
+        rows to random output partitions; each merge task concatenates
+        its parts and permutes locally.  Values move node-to-node."""
+        def partition(block: Block, n: int, _spec, offset: int):
+            rows = BlockAccessor.num_rows(block)
+            # Fold the global offset into the stream so blocks don't
+            # share one assignment pattern under a fixed seed.
+            rng = np.random.default_rng(
+                None if seed is None else (seed, offset))
+            assign = rng.integers(0, n, rows)
+            return [(j, BlockAccessor.take(block,
+                                           np.nonzero(assign == j)[0]))
+                    for j in builtins.range(n)]
+
+        def merge(blocks: List[Block], _spec) -> List[Block]:
+            if not blocks:
                 return []
+            whole = BlockAccessor.concat(blocks)
             rng = np.random.default_rng(seed)
-            perm = rng.permutation(rows)
-            shuffled = BlockAccessor.take(whole, perm)
-            sizes = [BlockAccessor.num_rows(b) for b in blocks]
-            out, lo = [], 0
-            for s in sizes:
-                out.append(BlockAccessor.slice(shuffled, lo, lo + s))
-                lo += s
-            return out
-        return self._with(AllToAll("RandomShuffle", fn))
+            perm = rng.permutation(BlockAccessor.num_rows(whole))
+            return [BlockAccessor.take(whole, perm)]
+
+        return self._with(Exchange("RandomShuffle", partition, merge))
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
-        def fn(blocks: List[Block], ctx: DataContext) -> List[Block]:
-            whole = BlockAccessor.concat(blocks)
-            if BlockAccessor.num_rows(whole) == 0:
+        """Distributed range sort (reference SortTaskSpec,
+        sort_task_spec.py:94): sample tasks pick range bounds, partition
+        tasks split by range, merge tasks sort each range locally; the
+        ordered ranges concatenate into the global order."""
+        def sample(blocks: List[Block]):
+            vals = np.concatenate([np.asarray(b[key]) for b in blocks]) \
+                if blocks else np.asarray([])
+            if len(vals) > 100:
+                idx = np.linspace(0, len(vals) - 1, 100).astype(np.int64)
+                vals = np.sort(vals)[idx]
+            return vals
+
+        def bounds(samples, n: int):
+            allv = np.sort(np.concatenate(
+                [np.asarray(s) for s in samples if len(s)]))
+            if len(allv) == 0:
+                return np.asarray([])
+            qs = np.linspace(0, len(allv) - 1, n + 1).astype(np.int64)
+            return allv[qs[1:-1]]
+
+        def partition(block: Block, n: int, spec, _offset: int):
+            spec = spec["spec"]
+            vals = np.asarray(block[key])
+            idx = np.searchsorted(spec, vals, side="right") \
+                if len(spec) else np.zeros(len(vals), np.int64)
+            if descending:
+                idx = (n - 1) - idx
+            return [(j, BlockAccessor.take(block,
+                                           np.nonzero(idx == j)[0]))
+                    for j in builtins.range(n)]
+
+        def merge(blocks: List[Block], _spec) -> List[Block]:
+            if not blocks:
                 return []
-            order = np.argsort(whole[key], kind="stable")
+            whole = BlockAccessor.concat(blocks)
+            order = np.argsort(np.asarray(whole[key]), kind="stable")
             if descending:
                 order = order[::-1]
             return [BlockAccessor.take(whole, order)]
-        return self._with(AllToAll("Sort", fn))
+
+        return self._with(Exchange("Sort", partition, merge,
+                                   sample_fn=sample, bounds_fn=bounds))
 
     # -- execution ----------------------------------------------------------
     def iter_blocks(self) -> Iterator[Block]:

@@ -3,24 +3,33 @@
 Reference shape (SURVEY.md §2.4): Dataset facade holds a lazy logical plan
 (data/_internal/logical/interfaces/logical_plan.py:10), an optimizer fuses
 adjacent map stages (logical/rules/operator_fusion.py), the planner lowers
-to physical operators, and a ``StreamingExecutor`` scheduling loop
-(execution/streaming_executor.py:47,219,269 +
-streaming_executor_state.py:395,533) dispatches block tasks with
-backpressure.
+to physical operators — task-pool maps (execution/operators/
+map_operator.py:55), ACTOR-pool maps (actor_pool_map_operator.py:34), and
+distributed exchanges (planner/exchange/ — the push-based shuffle,
+push_based_shuffle_task_scheduler.py:590) — and a ``StreamingExecutor``
+scheduling loop dispatches block tasks with backpressure.
 
-TPU-first redesign: the executor is a *pull-based generator* rather than a
-push-loop thread — the consumer (batcher / device-prefetch iterator) pulls,
-and dispatch happens exactly as fast as consumption allows, which is the
-backpressure policy (bounded in-flight tasks + bounded ordered-output
-buffer).  Map chains are fused into a single ``ray_tpu`` task per input
-block, so a read→map_batches→filter pipeline costs one task per block.
+TPU-first redesign:
+- The executor is a *pull-based generator* rather than a push-loop
+  thread — the consumer pulls, and dispatch happens exactly as fast as
+  consumption allows (bounded in-flight tasks + bounded ordered-output
+  buffer = the backpressure policy).
+- Blocks stream BY REFERENCE: a map task's output block groups stay
+  pinned on the executing node (object-plane primary copies); the
+  driver holds location records and hands refs straight to downstream
+  tasks, which pull node-to-node over the chunk protocol.  Values only
+  materialize at the final consumption point.  Exchanges (shuffle /
+  sort / repartition) are two distributed stages — partition tasks with
+  ``num_returns=n`` and merge tasks taking the parts as ref args — so
+  no intermediate data crosses the driver.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 import numpy as np
 
@@ -30,6 +39,18 @@ from .datasource import Datasource, ReadTask
 
 # A transform maps one block to zero-or-more blocks.
 Transform = Callable[[Block], List[Block]]
+
+
+class ActorPoolStrategy:
+    """Stateful compute for map_batches (reference:
+    ActorPoolMapOperator, actor_pool_map_operator.py:34): the map fn is
+    a CLASS, instantiated once per pool actor; batches round-robin over
+    the least-loaded actors."""
+
+    def __init__(self, size: int = 2):
+        if size < 1:
+            raise ValueError("actor pool size must be >= 1")
+        self.size = size
 
 
 # --------------------------------------------------------------------------
@@ -64,9 +85,45 @@ class MapBlocks(LogicalOp):
         return self.transform
 
 
+class ActorMapBlocks(LogicalOp):
+    """Actor-pool map stage: fn_class instantiated per pool actor."""
+
+    def __init__(self, name: str, fn_class: type, fn_args: Tuple,
+                 fn_kwargs: Dict[str, Any], batch_size: Optional[int],
+                 compute: ActorPoolStrategy):
+        self.name = name
+        self.fn_class = fn_class
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs
+        self.batch_size = batch_size
+        self.compute = compute
+
+
+class Exchange(LogicalOp):
+    """Distributed all-to-all: a partition stage (one task per input
+    group, ``num_returns=n_out``) followed by a merge stage (one task
+    per output partition) — reference planner/exchange/.  ``sample_fn``
+    (optional) runs per input group first; ``bounds_fn`` reduces the
+    samples driver-side into the small partition spec (e.g. sort range
+    bounds)."""
+
+    def __init__(self, name: str, partition_fn, merge_fn, n_out: int = -1,
+                 sample_fn=None, bounds_fn=None,
+                 needs_offsets: bool = False):
+        self.name = name
+        self.partition_fn = partition_fn
+        self.merge_fn = merge_fn
+        self.n_out = n_out
+        self.sample_fn = sample_fn
+        self.bounds_fn = bounds_fn
+        # True when partition_fn consumes exact global row offsets /
+        # totals (repartition); forces the sample round even without a
+        # sample_fn.
+        self.needs_offsets = needs_offsets or sample_fn is not None
+
+
 class AllToAll(LogicalOp):
-    """Barrier op: needs every upstream block at once
-    (reference: _internal/planner/exchange/ — repartition, shuffle, sort)."""
+    """Driver-side barrier op (small data / tests); prefer Exchange."""
 
     def __init__(self, name: str,
                  fn: Callable[[List[Block], DataContext], List[Block]]):
@@ -111,16 +168,26 @@ class PlanStats:
 
 
 # --------------------------------------------------------------------------
-# Remote task bodies
+# Remote task bodies.  Map tasks return (group, meta): the group (the
+# heavy payload) stays remote; meta is tiny and inlines to the driver.
 # --------------------------------------------------------------------------
-def _run_read(read_task: ReadTask, transforms: Sequence[Transform]
-              ) -> List[Block]:
-    blocks = read_task()
-    return _apply(blocks, transforms)
+def _run_read(read_task: ReadTask, transforms: Sequence[Transform]):
+    blocks = _apply(read_task(), transforms)
+    return blocks, _meta(blocks)
 
 
-def _run_map(block: Block, transforms: Sequence[Transform]) -> List[Block]:
-    return _apply([block], transforms)
+def _run_map(upstream, transforms: Sequence[Transform]):
+    # ``upstream`` is the resolved (group, meta) result of the feeding
+    # task (the ref was passed as an arg; the runtime materialized it
+    # here, node-to-node).
+    group = upstream[0] if isinstance(upstream, tuple) else upstream
+    blocks = _apply(list(group), transforms)
+    return blocks, _meta(blocks)
+
+
+def _meta(blocks: List[Block]) -> Dict[str, int]:
+    return {"blocks": len(blocks),
+            "rows": sum(BlockAccessor.num_rows(b) for b in blocks)}
 
 
 def _apply(blocks: List[Block], transforms: Sequence[Transform]
@@ -133,8 +200,58 @@ def _apply(blocks: List[Block], transforms: Sequence[Transform]
     return [b for b in blocks if BlockAccessor.num_rows(b) > 0]
 
 
+def _run_partition(group: List[Block], n_out: int, partition_fn,
+                   spec, offset: int) -> List[List[Block]]:
+    """Split a group's rows into n_out part-lists (one per output
+    partition).  ``offset`` is this group's global starting row (from
+    the sample stage), letting partition functions compute exact
+    global row ranges."""
+    parts: List[List[Block]] = [[] for _ in range(n_out)]
+    for block in group:
+        for idx, piece in partition_fn(block, n_out, spec, offset):
+            if BlockAccessor.num_rows(piece):
+                parts[idx].append(piece)
+        offset += BlockAccessor.num_rows(block)
+    return parts
+
+
+def _run_merge(merge_fn, spec, *part_lists):
+    blocks: List[Block] = []
+    for pl in part_lists:
+        blocks.extend(pl)
+    merged = merge_fn(blocks, spec)
+    return merged, _meta(merged)
+
+
+def _run_sample(group: List[Block], sample_fn):
+    return sample_fn(group)
+
+
+class _PoolWorker:
+    """Actor-pool map worker: holds one instance of the user's class."""
+
+    def __init__(self, fn_class, fn_args, fn_kwargs):
+        self.fn = fn_class(*fn_args, **fn_kwargs)
+
+    def run(self, group, batch_size: Optional[int]):
+        if isinstance(group, _RefGroup):
+            group = group.resolve()
+        out: List[Block] = []
+        for block in group:
+            if batch_size is None:
+                out.append(BlockAccessor.validate(self.fn(block)))
+                continue
+            n = BlockAccessor.num_rows(block)
+            for lo in range(0, n, batch_size):
+                piece = BlockAccessor.slice(block, lo,
+                                            min(lo + batch_size, n))
+                out.append(BlockAccessor.validate(self.fn(piece)))
+        out = [b for b in out if BlockAccessor.num_rows(b) > 0]
+        return out, _meta(out)
+
+
 # --------------------------------------------------------------------------
-# Physical plan: alternating [inputs] -> map chain -> barrier -> map chain...
+# Physical plan: alternating map-chain / barrier phases
 # --------------------------------------------------------------------------
 class _MapPhase:
     def __init__(self, names: List[str], transforms: List[Transform]):
@@ -144,9 +261,10 @@ class _MapPhase:
 
 def compile_plan(ops: Sequence[LogicalOp]
                  ) -> Tuple[Read, List[Any], Optional[int]]:
-    """Fuse the op chain into phases.  Returns (read, phases, limit) where
-    phases alternate _MapPhase / AllToAll; a trailing Limit is lifted into
-    a streaming row cap (reference: limit pushdown rule)."""
+    """Fuse the op chain into phases.  Returns (read, phases, limit):
+    phases alternate _MapPhase with barrier ops (Exchange / AllToAll /
+    ActorMapBlocks); a trailing Limit is lifted into a streaming row cap
+    (reference: limit pushdown rule)."""
     if not ops or not isinstance(ops[0], Read):
         raise ValueError("plan must start with a Read op")
     read = ops[0]
@@ -154,6 +272,12 @@ def compile_plan(ops: Sequence[LogicalOp]
     cur_names: List[str] = []
     cur_tfs: List[Transform] = []
     limit: Optional[int] = None
+
+    def flush():
+        nonlocal cur_names, cur_tfs
+        phases.append(_MapPhase(cur_names, cur_tfs))
+        cur_names, cur_tfs = [], []
+
     for op in ops[1:]:
         tf = op.fused_transform()
         if tf is not None:
@@ -166,17 +290,15 @@ def compile_plan(ops: Sequence[LogicalOp]
                 limit = op.n
             else:
                 n = op.n
-                phases.append(_MapPhase(cur_names, cur_tfs))
-                cur_names, cur_tfs = [], []
+                flush()
                 phases.append(AllToAll(
                     "Limit", lambda blocks, ctx, n=n: _truncate(blocks, n)))
-        elif isinstance(op, AllToAll):
-            phases.append(_MapPhase(cur_names, cur_tfs))
-            cur_names, cur_tfs = [], []
+        elif isinstance(op, (AllToAll, Exchange, ActorMapBlocks)):
+            flush()
             phases.append(op)
         else:
             raise TypeError(f"unknown logical op {op!r}")
-    phases.append(_MapPhase(cur_names, cur_tfs))
+    flush()
     return read, phases, limit
 
 
@@ -197,63 +319,73 @@ def _truncate(blocks: List[Block], n: int) -> List[Block]:
 
 
 # --------------------------------------------------------------------------
-# Streaming executor
+# Streaming executor (refs end to end)
 # --------------------------------------------------------------------------
 def execute_streaming(ops: Sequence[LogicalOp],
                       ctx: Optional[DataContext] = None,
                       stats: Optional[PlanStats] = None
                       ) -> Iterator[Block]:
-    """Run the plan, yielding output blocks in order as they are produced.
+    """Run the plan, yielding output blocks in order as they complete.
+    Intermediate results stream between phases as ObjectRefs — block
+    values materialize only here, at final consumption."""
+    import ray_tpu
 
-    Backpressure: at most ``ctx.max_concurrency`` tasks in flight and at
-    most ``ctx.output_buffer_blocks`` completed blocks buffered; when the
-    consumer stops pulling, dispatch stops (reference:
-    streaming_executor_state.py:533 select_operator_to_run).
-    """
+    gen = _execute_refs(ops, ctx, stats)
+    rows_cap = gen.send(None)  # prime; first yield carries the limit
+    rows_out = 0
+    try:
+        for ref in gen:
+            group, _meta_ignored = ray_tpu.get(ref)
+            for block in group:
+                if rows_cap is not None:
+                    rows = BlockAccessor.num_rows(block)
+                    if rows_out + rows >= rows_cap:
+                        yield BlockAccessor.slice(block, 0,
+                                                  rows_cap - rows_out)
+                        gen.close()
+                        return
+                    rows_out += rows
+                yield block
+    finally:
+        gen.close()
+        if stats is not None:
+            stats.total_s = time.perf_counter() - stats.start
+
+
+def _execute_refs(ops, ctx, stats):
+    """Generator: first yield is the streaming row cap (or None), then
+    one ObjectRef per output group, in order."""
     import ray_tpu
 
     ctx = ctx or DataContext.get_current()
     read, phases, limit = compile_plan(ops)
+    yield limit
+
     read_tasks = read.source.read_tasks(
         read.parallelism if read.parallelism > 0 else
         _default_parallelism(read, ctx))
 
     # First map phase fuses with the read (reference fuses Read+Map).
     first = phases[0]
-    source: Iterator[Block] = _stream_phase(
+    source = _stream_phase(
         [("read", rt) for rt in read_tasks], first, ctx, stats,
         name="Read+" + "+".join(first.names) if first.names else "Read")
     i = 1
     while i < len(phases):
-        barrier: AllToAll = phases[i]
+        barrier = phases[i]
         map_phase: _MapPhase = phases[i + 1]
-        blocks = list(source)  # materialize at the barrier
-        t0 = time.perf_counter()
-        shuffled = barrier.fn(blocks, ctx)
-        if stats is not None:
-            s = OpStats(barrier.name)
-            s.num_tasks = 1
-            s.num_blocks = len(shuffled)
-            s.num_rows = sum(BlockAccessor.num_rows(b) for b in shuffled)
-            s.wall_s = time.perf_counter() - t0
-            stats.ops.append(s)
-        source = _stream_phase(
-            [("block", b) for b in shuffled], map_phase, ctx, stats,
-            name="+".join(map_phase.names) or "identity")
+        if isinstance(barrier, ActorMapBlocks):
+            source = _stream_actor_pool(source, barrier, ctx, stats)
+        elif isinstance(barrier, Exchange):
+            source = _stream_exchange(source, barrier, ctx, stats)
+        else:
+            source = _run_driver_barrier(source, barrier, ctx, stats)
+        if map_phase.transforms:
+            source = _stream_phase(
+                [("ref", r) for r in source], map_phase, ctx, stats,
+                name="+".join(map_phase.names))
         i += 2
-
-    rows_out = 0
-    for block in source:
-        if limit is not None:
-            rows = BlockAccessor.num_rows(block)
-            if rows_out + rows >= limit:
-                yield BlockAccessor.slice(block, 0, limit - rows_out)
-                source.close()
-                break
-            rows_out += rows
-        yield block
-    if stats is not None:
-        stats.total_s = time.perf_counter() - stats.start
+    yield from source
 
 
 def _default_parallelism(read: Read, ctx: DataContext) -> int:
@@ -264,10 +396,13 @@ def _default_parallelism(read: Read, ctx: DataContext) -> int:
                       -(-n // ctx.target_block_rows)))
 
 
-def _stream_phase(items: List[Tuple[str, Any]], phase: _MapPhase,
-                  ctx: DataContext, stats: Optional[PlanStats],
-                  name: str) -> Iterator[Block]:
-    """Stream one fused map phase over its inputs as ray_tpu tasks."""
+def _stream_phase(items, phase: _MapPhase, ctx: DataContext,
+                  stats: Optional[PlanStats], name: str):
+    """Stream one fused map phase: yields one ref per input item, in
+    order, with bounded in-flight dispatch.  ``items`` entries are
+    ("read", ReadTask) or ("ref", upstream group ref); upstream refs
+    are handed to the task as ARGS, so the block values move node to
+    node, never through the driver."""
     import ray_tpu
 
     op_stats = OpStats(name)
@@ -275,49 +410,59 @@ def _stream_phase(items: List[Tuple[str, Any]], phase: _MapPhase,
         stats.ops.append(op_stats)
 
     transforms = phase.transforms
-    if not transforms and all(kind == "block" for kind, _ in items):
-        # Identity phase over in-memory blocks: no tasks needed.
-        def passthrough():
-            for _, b in items:
-                op_stats.num_blocks += 1
-                op_stats.num_rows += BlockAccessor.num_rows(b)
-                yield b
-        return passthrough()
 
     remote_read = ray_tpu.remote(_run_read)
     remote_map = ray_tpu.remote(_run_map)
 
-    def gen() -> Iterator[Block]:
+    def gen():
+        # Lazy upstream consumption: a map phase behind a barrier
+        # starts dispatching as soon as the FIRST upstream result
+        # exists instead of draining the whole barrier — the
+        # pipelining this executor exists for.
         t_start = time.perf_counter()
+        it = iter(items)
+        exhausted = False
         in_flight: Dict[Any, int] = {}   # ref -> seq
-        done: Dict[int, List[Block]] = {}  # seq -> blocks awaiting yield
+        group_refs: Dict[int, Any] = {}  # seq -> group ref
+        done: Dict[int, Any] = {}        # seq -> completion flag
         next_dispatch = 0
         next_yield = 0
         try:
-            while next_yield < len(items):
-                while (next_dispatch < len(items)
+            while True:
+                while (not exhausted
                        and len(in_flight) < ctx.max_concurrency
                        and len(done) < ctx.output_buffer_blocks):
-                    kind, payload = items[next_dispatch]
+                    try:
+                        kind, payload = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
                     if kind == "read":
                         ref = remote_read.remote(payload, transforms)
                     else:
                         ref = remote_map.remote(payload, transforms)
+                    # The task returns (group, meta); the driver waits
+                    # on the combined ref but only materializes meta at
+                    # yield time — big groups stay remote primaries.
                     in_flight[ref] = next_dispatch
+                    group_refs[next_dispatch] = ref
                     next_dispatch += 1
                     op_stats.num_tasks += 1
+                if exhausted and not in_flight and next_yield >= \
+                        next_dispatch:
+                    return
                 if in_flight:
                     ready, _ = ray_tpu.wait(
                         list(in_flight), num_returns=1,
                         timeout=ctx.wait_timeout_s)
                     for ref in ready:
-                        done[in_flight.pop(ref)] = ray_tpu.get(ref)
+                        done[in_flight.pop(ref)] = True
                 while next_yield in done:
-                    for block in done.pop(next_yield):
-                        op_stats.num_blocks += 1
-                        op_stats.num_rows += BlockAccessor.num_rows(block)
-                        yield block
+                    done.pop(next_yield)
+                    ref = group_refs.pop(next_yield)
+                    op_stats.num_blocks += 1
                     next_yield += 1
+                    yield ref
         finally:
             op_stats.wall_s = time.perf_counter() - t_start
             for ref in in_flight:
@@ -327,3 +472,181 @@ def _stream_phase(items: List[Tuple[str, Any]], phase: _MapPhase,
                     pass
 
     return gen()
+
+
+def _stream_actor_pool(source, op: ActorMapBlocks, ctx, stats):
+    """Actor-pool map: a pool of stateful workers; groups dispatch to
+    the least-loaded worker (actor_pool_map_operator.py:34)."""
+    import ray_tpu
+
+    op_stats = OpStats(f"ActorMap[{op.name}]")
+    if stats is not None:
+        stats.ops.append(op_stats)
+    Worker = ray_tpu.remote(_PoolWorker)
+    pool = [Worker.remote(op.fn_class, op.fn_args, op.fn_kwargs)
+            for _ in range(op.compute.size)]
+    load = [0] * len(pool)
+
+    def gen():
+        t0 = time.perf_counter()
+        pending: List[Tuple[Any, int]] = []  # (ref, worker) in order
+        try:
+            upstream = iter(source)
+            exhausted = False
+            next_up = None
+            while True:
+                while (not exhausted
+                       and len(pending) < ctx.max_concurrency):
+                    try:
+                        next_up = next(upstream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    w = load.index(min(load))
+                    load[w] += 1
+                    # Pass the UPSTREAM result ref; the worker unwraps
+                    # the group itself (values fetch node-to-node).
+                    ref = pool[w].run.remote(
+                        _RefGroup(next_up), op.batch_size)
+                    pending.append((ref, w))
+                    op_stats.num_tasks += 1
+                if not pending:
+                    return
+                ref, w = pending.pop(0)
+                # Wait for completion (ordered yield).
+                ray_tpu.wait([ref], num_returns=1, timeout=None)
+                load[w] -= 1
+                op_stats.num_blocks += 1
+                yield ref
+        finally:
+            op_stats.wall_s = time.perf_counter() - t0
+            for w in pool:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+
+    return gen()
+
+
+class _RefGroup:
+    """Marker wrapper: an upstream (group, meta) ref whose group the
+    receiving task unwraps (keeps worker signatures uniform)."""
+
+    def __init__(self, ref):
+        self.ref = ref
+
+    def resolve(self) -> List[Block]:
+        import ray_tpu
+
+        group, _m = ray_tpu.get(self.ref)
+        return group
+
+
+def _resolve_groups(args):
+    return [a.resolve() if isinstance(a, _RefGroup) else a for a in args]
+
+
+def _stream_exchange(source, op: Exchange, ctx, stats):
+    """Two-stage distributed exchange: partition tasks (num_returns =
+    n_out) then merge tasks taking the parts as ref args.  Part values
+    move node-to-node (object-plane primaries); the driver only routes
+    refs (reference: planner/exchange/ push-based shuffle)."""
+    import ray_tpu
+
+    op_stats = OpStats(op.name)
+    if stats is not None:
+        stats.ops.append(op_stats)
+    t0 = time.perf_counter()
+    input_refs = list(source)
+    if not input_refs:
+        op_stats.wall_s = time.perf_counter() - t0
+        return iter(())
+    n_out = op.n_out if op.n_out > 0 else len(input_refs)
+
+    if op.needs_offsets:
+        # Sample stage: group row counts (for exact global offsets)
+        # plus the op's own samples (e.g. sort range bounds).
+        remote_sample = ray_tpu.remote(_run_sample_wrapped)
+        sampled = ray_tpu.get(
+            [remote_sample.remote(_RefGroup(r), op.sample_fn)
+             for r in input_refs])
+        rows_per_group = [s[0] for s in sampled]
+        offsets = list(np.cumsum([0] + rows_per_group[:-1]))
+        spec = None
+        if op.sample_fn is not None:
+            spec = op.bounds_fn([s[1] for s in sampled], n_out)
+        if op.n_out <= 0 and sum(rows_per_group) == 0:
+            op_stats.wall_s = time.perf_counter() - t0
+            return iter(())
+        spec = {"spec": spec, "total": int(sum(rows_per_group))}
+    else:
+        # No sampling needed (shuffle): the "offset" handed to the
+        # partition fn is the group INDEX — enough to decorrelate
+        # per-group randomness under a fixed seed.
+        offsets = list(range(len(input_refs)))
+        spec = {"spec": None, "total": -1}
+
+    remote_part = ray_tpu.remote(_run_partition_wrapped)
+    remote_merge = ray_tpu.remote(_run_merge_wrapped)
+    part_refs = [
+        remote_part.options(num_returns=n_out).remote(
+            _RefGroup(r), n_out, op.partition_fn, spec, int(off))
+        for r, off in zip(input_refs, offsets)]
+    op_stats.num_tasks += len(input_refs)
+    if n_out == 1:
+        part_refs = [[r] for r in part_refs]
+    merge_refs = []
+    for j in range(n_out):
+        merge_refs.append(remote_merge.remote(
+            op.merge_fn, spec, *[parts[j] for parts in part_refs]))
+        op_stats.num_tasks += 1
+
+    def gen():
+        try:
+            for ref in merge_refs:
+                ray_tpu.wait([ref], num_returns=1, timeout=None)
+                op_stats.num_blocks += 1
+                yield ref
+        finally:
+            op_stats.wall_s = time.perf_counter() - t0
+
+    return gen()
+
+
+def _run_sample_wrapped(group, sample_fn):
+    blocks = _resolve_groups([group])[0]
+    rows = sum(BlockAccessor.num_rows(b) for b in blocks)
+    return rows, (sample_fn(blocks) if sample_fn is not None else None)
+
+
+def _run_partition_wrapped(group, n_out, partition_fn, spec, offset):
+    blocks = _resolve_groups([group])[0]
+    parts = _run_partition(blocks, n_out, partition_fn, spec, offset)
+    if n_out == 1:
+        return parts[0]
+    return parts
+
+
+def _run_merge_wrapped(merge_fn, spec, *part_lists):
+    return _run_merge(merge_fn, spec, *part_lists)
+
+
+def _run_driver_barrier(source, barrier: AllToAll, ctx, stats):
+    """Legacy driver-side barrier: materializes, applies, re-puts."""
+    import ray_tpu
+
+    op_stats = OpStats(barrier.name)
+    if stats is not None:
+        stats.ops.append(op_stats)
+    t0 = time.perf_counter()
+    blocks: List[Block] = []
+    for ref in source:
+        group, _m = ray_tpu.get(ref)
+        blocks.extend(group)
+    out = barrier.fn(blocks, ctx)
+    op_stats.num_tasks = 1
+    op_stats.num_blocks = len(out)
+    op_stats.num_rows = sum(BlockAccessor.num_rows(b) for b in out)
+    op_stats.wall_s = time.perf_counter() - t0
+    return iter([ray_tpu.put(([b], _meta([b]))) for b in out])

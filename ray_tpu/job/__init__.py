@@ -189,6 +189,7 @@ def wait_job(job_id: str, timeout: float = 300.0,
              poll_s: float = 0.25) -> str:
     """Block until the job reaches a terminal status."""
     deadline = time.monotonic() + timeout
+    status = get_job_status(job_id)
     while time.monotonic() < deadline:
         status = get_job_status(job_id)
         if status in ("SUCCEEDED", "FAILED", "STOPPED"):

@@ -419,12 +419,6 @@ def decoder_layer(x: jax.Array, layer: Dict[str, jax.Array],
                   attention_fn: Callable) -> jax.Array:
     q, k, v = _qkv_rope(x, layer, sin, cos, config)
     attn = attention_fn(q, k, v, positions)
-    # Named for the "attn_out" remat policy: saving ONLY this tensor
-    # (~B·S·H bf16 per layer) spares the backward pass a full flash-
-    # attention forward recompute while everything else remats.
-    from jax.ad_checkpoint import checkpoint_name
-
-    attn = checkpoint_name(attn, "attn_out")
     return _attn_out_mlp(x, attn, layer, config)
 
 
@@ -479,12 +473,6 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
                 "dots":
                     jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 "dots_saveable": jax.checkpoint_policies.dots_saveable,
-                # Save just the attention outputs (checkpoint_name in
-                # decoder_layer): the backward never re-runs flash
-                # attention, at ~B·S·H bf16 per layer of memory.
-                "attn_out":
-                    jax.checkpoint_policies.save_only_these_names(
-                        "attn_out"),
             }
             block = jax.checkpoint(block,
                                    policy=policies[c.remat_policy])

@@ -90,6 +90,89 @@ def metrics_summary() -> Dict[str, Dict]:
     return out
 
 
+def prometheus_text() -> str:
+    """Prometheus text exposition of every registered metric
+    (reference: the node metrics agent's exposition endpoint,
+    dashboard/modules/reporter/reporter_agent.py:336 +
+    _private/metrics_agent.py)."""
+    with _lock:
+        metrics = dict(_registry)
+    lines: List[str] = []
+    for name, m in sorted(metrics.items()):
+        if m.description:
+            lines.append(f"# HELP {name} {m.description}")
+        kind = ("counter" if isinstance(m, Counter)
+                else "histogram" if isinstance(m, Histogram)
+                else "gauge")
+        lines.append(f"# TYPE {name} {kind}")
+
+        def labelstr(key: Tuple) -> str:
+            pairs = [f'{k}="{v}"' for k, v in zip(m.tag_keys, key) if v]
+            return "{" + ",".join(pairs) + "}" if pairs else ""
+
+        if isinstance(m, Histogram):
+            with m._vlock:
+                counts = {k: list(v) for k, v in m._counts.items()}
+                sums = dict(m._values)
+            for key, buckets in counts.items():
+                cum = 0
+                for bound, c in zip(m.boundaries, buckets):
+                    cum += c
+                    extra = f'le="{bound}"'
+                    base = labelstr(key)
+                    ls = (base[:-1] + "," + extra + "}") if base \
+                        else "{" + extra + "}"
+                    lines.append(f"{name}_bucket{ls} {cum}")
+                cum += buckets[-1]
+                base = labelstr(key)
+                ls = (base[:-1] + ',le="+Inf"}') if base \
+                    else '{le="+Inf"}'
+                lines.append(f"{name}_bucket{ls} {cum}")
+                lines.append(f"{name}_count{labelstr(key)} {cum}")
+                lines.append(
+                    f"{name}_sum{labelstr(key)} {sums.get(key, 0.0)}")
+        else:
+            for key, v in m.snapshot().items():
+                lines.append(f"{name}{labelstr(key)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+_exposition_server = None
+
+
+def start_metrics_server(port: int = 0) -> str:
+    """Serve ``prometheus_text`` at ``GET /metrics`` (stdlib http;
+    returns the bound address).  One per process."""
+    global _exposition_server
+    if _exposition_server is not None:
+        return _exposition_server
+    import http.server
+    import threading as _threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    t = _threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    _exposition_server = f"127.0.0.1:{srv.server_address[1]}"
+    return _exposition_server
+
+
 def reset_metrics():
     with _lock:
         _registry.clear()

@@ -30,6 +30,11 @@ class ServeController:
         #          callable, init_args, init_kwargs, autoscale state}
         self._deployments: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.RLock()
+        # One deploy at a time per deployment NAME (the controller
+        # actor itself runs concurrent calls so membership polls stay
+        # live): without this, two racing deploys both read the same
+        # version and mix two replica sets.
+        self._deploy_locks: Dict[str, threading.Lock] = {}
         self._stop = threading.Event()
         self._autoscaler = threading.Thread(
             target=self._autoscale_loop, daemon=True)
@@ -42,6 +47,15 @@ class ServeController:
         OUTSIDE the lock so membership polls and status queries stay
         live throughout a deploy (the controller actor itself runs with
         high max_concurrency for the same reason)."""
+        with self._lock:
+            name_lock = self._deploy_locks.setdefault(
+                name, threading.Lock())
+        with name_lock:
+            return self._deploy_locked(name, callable_def, init_args,
+                                       init_kwargs, config)
+
+    def _deploy_locked(self, name, callable_def, init_args,
+                       init_kwargs, config):
         num = max(1, int(config.get("num_replicas", 1)))
         auto = config.get("autoscaling_config")
         if auto:

@@ -16,7 +16,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from .schedulers import CONTINUE, STOP, ASHAScheduler, FIFOScheduler
+from .schedulers import (CONTINUE, STOP, ASHAScheduler, FIFOScheduler,
+                         PopulationBasedTraining)
 from .search import (choice, generate_variants, grid_search, loguniform,
                      randint, uniform)
 
@@ -30,14 +31,27 @@ class _TrialSession(threading.local):
 _session = _TrialSession()
 
 
-def report(metrics: Dict[str, Any]):
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[str] = None):
     """Report one iteration's metrics from inside a trainable
-    (reference: tune.report).  Raises ``_StopTrial`` when the scheduler
-    has decided against this trial — the trainable unwinds."""
+    (reference: tune.report).  ``checkpoint`` is a directory the
+    trainable just saved (shared-fs path on clusters); the controller
+    tracks it per trial and PBT exploits clone from it.  Raises
+    ``_StopTrial`` when the scheduler has decided against this trial —
+    the trainable unwinds."""
     runner = _session.runner
     if runner is None:
         raise RuntimeError("tune.report() outside a trial")
-    runner._record(dict(metrics))
+    runner._record(dict(metrics), checkpoint)
+
+
+def get_checkpoint() -> Optional[str]:
+    """Checkpoint directory this trial should resume from (set when the
+    controller restarts a trial — PBT exploit or failure retry), else
+    None (reference: tune.get_checkpoint)."""
+    runner = _session.runner
+    if runner is None:
+        raise RuntimeError("tune.get_checkpoint() outside a trial")
+    return runner._restore_from
 
 
 class _StopTrial(Exception):
@@ -49,13 +63,17 @@ class _TrialRunner:
     actor thread while ``poll``/``request_stop`` service the controller
     on others (threaded actor, reference: tune trial actors)."""
 
-    def __init__(self, fn, config):
+    def __init__(self, fn, config, restore_from: Optional[str] = None,
+                 iteration_offset: int = 0):
         self._fn = fn
         self._config = dict(config)
         self._results: List[Dict[str, Any]] = []
         self._cursor = 0
         self._stop = False
         self._lock = threading.Lock()
+        self._restore_from = restore_from
+        self._iteration_offset = iteration_offset
+        self._latest_checkpoint = restore_from
 
     def run(self):
         _session.runner = self
@@ -67,13 +85,22 @@ class _TrialRunner:
         finally:
             _session.runner = None
 
-    def _record(self, metrics: Dict[str, Any]):
+    def _record(self, metrics: Dict[str, Any],
+                checkpoint: Optional[str] = None):
         with self._lock:
-            metrics.setdefault("training_iteration",
-                               len(self._results) + 1)
+            metrics.setdefault(
+                "training_iteration",
+                self._iteration_offset + len(self._results) + 1)
+            if checkpoint is not None:
+                self._latest_checkpoint = checkpoint
+                metrics["checkpoint"] = checkpoint
             self._results.append(metrics)
             if self._stop:
                 raise _StopTrial()
+
+    def latest_checkpoint(self):
+        with self._lock:
+            return self._latest_checkpoint
 
     def poll(self):
         with self._lock:
@@ -170,7 +197,9 @@ class Tuner:
             ray_tpu.init()
         cfg = self._cfg
         scheduler = cfg.scheduler or FIFOScheduler()
-        if isinstance(scheduler, ASHAScheduler) and not scheduler.metric:
+        if isinstance(scheduler, (ASHAScheduler,
+                                  PopulationBasedTraining)) \
+                and not scheduler.metric:
             scheduler.metric = cfg.metric or ""
             scheduler.mode = cfg.mode
 
@@ -189,6 +218,7 @@ class Tuner:
                     self._trainable, config)
                 running[trial_id] = {
                     "actor": actor, "config": config,
+                    "trainable": self._trainable,
                     "done_ref": actor.run.remote(),
                     "history": [], "stopped": False,
                 }
@@ -209,6 +239,16 @@ class Tuner:
                     if scheduler.reevaluate(trial_id) == STOP:
                         t["stopped"] = True
                         t["actor"].request_stop.remote()
+                if (not t["stopped"]
+                        and isinstance(scheduler,
+                                       PopulationBasedTraining)):
+                    decision = scheduler.maybe_exploit(trial_id)
+                    if decision is not None:
+                        src_id, mutate = decision
+                        src = running.get(src_id)
+                        if src is not None:
+                            self._pbt_restart(trial_id, t, src, mutate,
+                                              Runner)
                 ready, _ = ray_tpu.wait([t["done_ref"]], num_returns=1,
                                         timeout=0)
                 if ready:
@@ -217,12 +257,16 @@ class Tuner:
                         status = ray_tpu.get(t["done_ref"])["status"]
                     except Exception as e:  # noqa: BLE001
                         status, error = "ERROR", f"{type(e).__name__}: {e}"
-                    history = t["history"]
+                    # Drain the tail with one last cursor poll; the
+                    # accumulated history spans actor replacements (a
+                    # PBT restart's new actor only holds post-restart
+                    # results, so all_results() would truncate).
                     try:
-                        history = ray_tpu.get(
-                            t["actor"].all_results.remote())
+                        t["history"].extend(ray_tpu.get(
+                            t["actor"].poll.remote()))
                     except Exception:
                         pass
+                    history = t["history"]
                     done.append(TrialResult(
                         trial_id=trial_id, config=t["config"],
                         metrics=history[-1] if history else {},
@@ -237,13 +281,43 @@ class Tuner:
         done.sort(key=lambda r: r.trial_id)
         return ResultGrid(done, cfg.metric, cfg.mode)
 
+    @staticmethod
+    def _pbt_restart(trial_id, t, src, mutate, Runner):
+        """PBT exploit: stop the lagging trial's actor and relaunch it
+        from the source trial's latest checkpoint with a mutated config
+        (reference pbt.py _exploit → trial restore)."""
+        import ray_tpu
+
+        try:
+            ckpt = ray_tpu.get(
+                src["actor"].latest_checkpoint.remote(), timeout=30)
+        except Exception:
+            return
+        if ckpt is None:
+            # Source never checkpointed: an exploit would restart the
+            # lagging trial from scratch — strictly worse than nothing.
+            return
+        new_config = mutate(dict(src["config"]))
+        iters = len(t["history"])
+        try:
+            t["actor"].request_stop.remote()
+            ray_tpu.wait([t["done_ref"]], num_returns=1, timeout=10)
+            ray_tpu.kill(t["actor"])
+        except Exception:
+            pass
+        t["config"] = new_config
+        t["actor"] = Runner.options(max_concurrency=3).remote(
+            t["trainable"], new_config, ckpt, iters)
+        t["done_ref"] = t["actor"].run.remote()
+
 
 def scheduler_metric(scheduler, cfg: TuneConfig) -> Optional[str]:
     return getattr(scheduler, "metric", None) or cfg.metric
 
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "ResultGrid", "TrialResult",
-    "TuneConfig", "Tuner", "choice", "grid_search", "loguniform",
-    "randint", "report", "uniform",
+    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
+    "get_checkpoint", "grid_search", "loguniform", "randint", "report",
+    "uniform",
 ]

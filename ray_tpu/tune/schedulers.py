@@ -78,3 +78,78 @@ class ASHAScheduler:
         if cutoff is not None and v < cutoff:
             return STOP
         return CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT (reference: tune/schedulers/pbt.py): every
+    ``perturbation_interval`` iterations, trials in the bottom quantile
+    EXPLOIT a top-quantile trial (clone its latest checkpoint + config)
+    and EXPLORE (perturb each hyperparam in ``hyperparam_mutations`` by
+    x1.2 / x0.8, or resample from a given list/callable).  The
+    controller restarts the exploiting trial's actor from the cloned
+    checkpoint with the mutated config."""
+
+    def __init__(self, *, metric: str = "", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations=None,
+                 quantile_fraction: float = 0.25, seed: int = 0):
+        import numpy as np
+
+        self.metric = metric
+        self.mode = mode
+        self.perturbation_interval = int(perturbation_interval)
+        self.hyperparam_mutations = dict(hyperparam_mutations or {})
+        self.quantile_fraction = quantile_fraction
+        self._rng = np.random.default_rng(seed)
+        # trial_id -> (iteration, score)
+        self._latest: dict = {}
+        self._last_perturb: dict = {}
+        self.num_exploits = 0
+
+    def _norm(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, iteration: int, value: float
+                  ) -> str:
+        self._latest[trial_id] = (iteration, self._norm(float(value)))
+        return CONTINUE
+
+    def maybe_exploit(self, trial_id: str):
+        """None, or (source_trial_id, mutate_fn) when this trial should
+        clone a better one.  Called by the controller per report."""
+        entry = self._latest.get(trial_id)
+        if entry is None:
+            return None
+        iteration, score = entry
+        if iteration - self._last_perturb.get(trial_id, 0) \
+                < self.perturbation_interval:
+            return None
+        self._last_perturb[trial_id] = iteration
+        pop = sorted(self._latest.items(), key=lambda kv: kv[1][1])
+        n = len(pop)
+        if n < 2:
+            return None
+        k = max(1, int(n * self.quantile_fraction))
+        bottom = [t for t, _ in pop[:k]]
+        top = [t for t, _ in pop[-k:]]
+        if trial_id not in bottom or trial_id in top:
+            return None
+        source = top[int(self._rng.integers(0, len(top)))]
+        if source == trial_id:
+            return None
+        self.num_exploits += 1
+        return source, self._mutate
+
+    def _mutate(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.hyperparam_mutations.items():
+            if key not in out:
+                continue
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, (list, tuple)):
+                out[key] = spec[int(self._rng.integers(0, len(spec)))]
+            else:  # numeric perturbation factor pair
+                factor = 1.2 if self._rng.random() < 0.5 else 0.8
+                out[key] = out[key] * factor
+        return out

@@ -1,0 +1,212 @@
+"""Durable workflows: DAG execution with per-step persistence + resume.
+
+Reference: python/ray/workflow (10.3k LoC) — ``workflow.run(dag,
+workflow_id=...)`` executes a bound task DAG with every step's output
+durably stored (workflow/api.py:123, workflow_executor.py,
+workflow_state_from_dag.py); a crashed or interrupted run resumes from
+storage, skipping completed steps (workflow_state_from_storage.py).
+
+Same shape here over ray_tpu.dag: steps are FunctionNodes; a step's
+output pickles under ``<storage>/<workflow_id>/steps/<step_id>.pkl``
+keyed by a deterministic DAG position; ``resume`` replays the persisted
+DAG and loads completed step outputs instead of re-executing them.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..dag.dag_node import DAGNode, FunctionNode, InputNode
+
+_lock = threading.Lock()
+_storage_dir: Optional[str] = None
+
+
+def init(storage: Optional[str] = None) -> str:
+    """Set (or default) the durable storage root."""
+    global _storage_dir
+    with _lock:
+        if storage is not None:
+            _storage_dir = storage
+        elif _storage_dir is None:
+            _storage_dir = os.path.join(
+                os.environ.get("TMPDIR", "/tmp"), "ray_tpu_workflows")
+        os.makedirs(_storage_dir, exist_ok=True)
+        return _storage_dir
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(init(), workflow_id)
+
+
+def _step_ids(node: DAGNode, prefix: str = "r") -> Dict[int, str]:
+    """Deterministic id per DAG node from its position (children are
+    ordered), so a resumed run maps steps to the same files."""
+    ids: Dict[int, str] = {}
+
+    def walk(n: DAGNode, path: str):
+        if id(n) in ids:
+            return
+        ids[id(n)] = path
+        for i, child in enumerate(n._children()):
+            walk(child, f"{path}.{i}")
+
+    walk(node, prefix)
+    return ids
+
+
+class _StepCheckpointer:
+    """Wraps each FunctionNode execution: completed steps load from
+    storage; fresh executions persist before the value flows on."""
+
+    def __init__(self, workflow_id: str, ids: Dict[int, str]):
+        self.dir = os.path.join(_wf_dir(workflow_id), "steps")
+        os.makedirs(self.dir, exist_ok=True)
+        self.ids = ids
+        self.steps_run = 0
+        self.steps_restored = 0
+
+    def path(self, node) -> str:
+        return os.path.join(self.dir, f"{self.ids[id(node)]}.pkl")
+
+    def run(self, node: DAGNode, cache, input_value):
+        import ray_tpu
+
+        path = self.path(node)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                self.steps_restored += 1
+                return ray_tpu.put(pickle.load(f))
+        ref = node._submit(cache, input_value)
+        value = ray_tpu.get(ref)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)
+        self.steps_run += 1
+        return ref
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        args: Any = None) -> Any:
+    """Execute a bound DAG durably; returns the terminal value
+    (reference: workflow.run, api.py:123).  Re-running (or resuming)
+    the same workflow_id skips steps whose outputs are stored."""
+    import ray_tpu
+
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    wf = _wf_dir(workflow_id)
+    os.makedirs(wf, exist_ok=True)
+    # Persist the DAG itself so resume() needs only the id (reference
+    # stores the workflow state from the DAG).
+    dag_path = os.path.join(wf, "dag.pkl")
+    if not os.path.exists(dag_path):
+        import cloudpickle
+
+        with open(dag_path, "wb") as f:
+            cloudpickle.dump((dag, args), f)
+    _write_meta(workflow_id, {"status": "RUNNING",
+                              "start_time": time.time()})
+    ids = _step_ids(dag)
+    ckpt = _StepCheckpointer(workflow_id, ids)
+
+    # Hook the executor: FunctionNodes route through the checkpointer.
+    cache: Dict[int, Any] = {}
+
+    def execute(node: DAGNode):
+        if id(node) in cache:
+            return cache[id(node)]
+        if isinstance(node, InputNode):
+            value = args
+        elif isinstance(node, FunctionNode):
+            # Resolve children first (depth-first, persisted each).
+            for child in node._children():
+                execute(child)
+            value = ckpt.run(node, cache, args)
+        else:
+            value = node._execute_impl(cache, args)
+        cache[id(node)] = value
+        return value
+
+    try:
+        out = execute(dag)
+        result = ray_tpu.get(out) if _is_ref(out) else out
+        _write_meta(workflow_id, {"status": "SUCCEEDED",
+                                  "end_time": time.time(),
+                                  "steps_run": ckpt.steps_run,
+                                  "steps_restored": ckpt.steps_restored})
+        return result
+    except BaseException as e:
+        _write_meta(workflow_id, {"status": "FAILED",
+                                  "error": f"{type(e).__name__}: {e}",
+                                  "end_time": time.time()})
+        raise
+
+
+def _is_ref(v) -> bool:
+    from ..core.object_ref import ObjectRef
+
+    return isinstance(v, ObjectRef)
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-drive a workflow from storage: completed steps load, the rest
+    execute (reference: workflow_state_from_storage.py)."""
+    dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise KeyError(f"no stored workflow {workflow_id!r}")
+    with open(dag_path, "rb") as f:
+        dag, args = pickle.load(f)
+    return run(dag, workflow_id=workflow_id, args=args)
+
+
+def get_status(workflow_id: str) -> str:
+    return _read_meta(workflow_id).get("status", "UNKNOWN")
+
+
+def get_metadata(workflow_id: str) -> Dict[str, Any]:
+    return _read_meta(workflow_id)
+
+
+def list_all() -> List[Dict[str, Any]]:
+    root = init()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        meta = _read_meta(wid)
+        if meta:
+            out.append({"workflow_id": wid, **meta})
+    return out
+
+
+def delete(workflow_id: str) -> bool:
+    import shutil
+
+    wf = _wf_dir(workflow_id)
+    if not os.path.isdir(wf):
+        return False
+    shutil.rmtree(wf, ignore_errors=True)
+    return True
+
+
+def _write_meta(workflow_id: str, update: Dict[str, Any]):
+    meta = _read_meta(workflow_id)
+    meta.update(update)
+    path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    with open(path + ".tmp", "wb") as f:
+        pickle.dump(meta, f)
+    os.replace(path + ".tmp", path)
+
+
+def _read_meta(workflow_id: str) -> Dict[str, Any]:
+    path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception:
+        return {}

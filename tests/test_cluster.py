@@ -559,3 +559,37 @@ class TestBorrowerProtocol:
                 "owner never freed after the borrower released"
             time.sleep(0.1)
         ray_tpu.kill(h)
+
+
+class TestPubsub:
+    def test_node_death_fans_out_via_long_poll(self, cluster):
+        """Every node learns of a death through its single outstanding
+        pubsub poll (src/ray/pubsub/README.md batched long-poll), not
+        by touching the dead node itself."""
+        proc = cluster.add_node(num_cpus=1, resources={"pub": 1},
+                                name="pubvictim")
+        rt = ray_tpu.get_runtime()
+        nodes = rt.cluster.list_nodes()
+        victim = [n for n in nodes if n["total"].get("pub")][0]
+        cluster.kill_node(proc)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if victim["node_id"] in rt.cluster.observed_dead_nodes:
+                break
+            time.sleep(0.2)
+        assert victim["node_id"] in rt.cluster.observed_dead_nodes
+
+    def test_publisher_batches_and_cursors(self):
+        from ray_tpu.cluster.pubsub import Publisher
+
+        pub = Publisher()
+        for i in range(5):
+            pub.publish("c", {"i": i})
+        out = pub.poll({"c": 0}, timeout_s=1.0)
+        assert [e["i"] for e in out["c"]["events"]] == [0, 1, 2, 3, 4]
+        # Cursor advances: no replay of consumed events.
+        out2 = pub.poll({"c": out["c"]["seq"]}, timeout_s=0.2)
+        assert out2 == {}
+        pub.publish("c", {"i": 5})
+        out3 = pub.poll({"c": out["c"]["seq"]}, timeout_s=1.0)
+        assert [e["i"] for e in out3["c"]["events"]] == [5]

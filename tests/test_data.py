@@ -195,3 +195,46 @@ def test_executor_error_propagates(ray_start_regular):
     ds = rd.range(10).map_batches(boom)
     with pytest.raises(Exception, match="bad batch"):
         ds.take_all()
+
+
+def test_actor_pool_map_batches(ray_start_regular):
+    """Stateful actor-pool compute (reference
+    actor_pool_map_operator.py:34): the class is constructed once per
+    pool actor; batches flow through instances."""
+    class AddBase:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.base}
+
+    ds = rd.range(64, parallelism=4).map_batches(
+        AddBase, compute=rd.ActorPoolStrategy(size=2),
+        batch_size=8, fn_constructor_args=(1000,))
+    out = sorted(r["id"] for r in ds.take_all())
+    assert out == list(range(1000, 1064))
+
+
+def test_actor_pool_requires_class(ray_start_regular):
+    with pytest.raises(TypeError):
+        rd.range(8).map_batches(lambda b: b,
+                                compute=rd.ActorPoolStrategy(size=2))
+
+
+def test_distributed_sort_many_partitions(ray_start_regular):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(500)
+    ds = rd.from_items([{"k": int(v)} for v in vals]).sort("k")
+    out = [r["k"] for r in ds.take_all()]
+    assert out == sorted(out)
+    ds = rd.from_items([{"k": int(v)} for v in vals]).sort(
+        "k", descending=True)
+    out = [r["k"] for r in ds.take_all()]
+    assert out == sorted(out, reverse=True)
+
+
+def test_shuffle_preserves_multiset(ray_start_regular):
+    ds = rd.range(300, parallelism=5).random_shuffle(seed=7)
+    out = [r["id"] for r in ds.take_all()]
+    assert sorted(out) == list(range(300))
+    assert out != list(range(300))  # actually shuffled

@@ -107,3 +107,29 @@ def test_state_lists(ray_start_regular):
     summary = rt_state.summarize_tasks()
     assert summary["FINISHED"] >= 1
     del ref
+
+
+def test_prometheus_exposition(ray_start_regular):
+    """Counters/gauges/histograms render in Prometheus text format and
+    serve over HTTP (reference: node metrics agent exposition)."""
+    import urllib.request
+
+    from ray_tpu.observability import metrics as M
+
+    c = M.Counter("expo_requests", "requests", tag_keys=("route",))
+    c.inc(3, tags={"route": "a"})
+    g = M.Gauge("expo_depth", "queue depth")
+    g.set(7)
+    h = M.Histogram("expo_lat", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    text = M.prometheus_text()
+    assert '# TYPE expo_requests counter' in text
+    assert 'expo_requests{route="a"} 3.0' in text
+    assert "expo_depth 7.0" in text
+    assert 'expo_lat_bucket{le="0.1"} 1' in text
+    assert 'expo_lat_bucket{le="+Inf"} 2' in text
+    addr = M.start_metrics_server()
+    body = urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=10).read().decode()
+    assert "expo_requests" in body

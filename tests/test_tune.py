@@ -141,3 +141,51 @@ def test_tune_wraps_jax_trainer(ray_start_regular, tmp_path):
     ).fit()
     assert len(grid) == 4
     assert grid.get_best_result().config["lr"] == 0.1
+
+
+def test_pbt_exploit_and_explore(ray_start_regular, tmp_path):
+    """PBT clones a top trial's checkpoint into a lagging trial and
+    perturbs its hyperparams (reference tune/schedulers/pbt.py): after
+    enough intervals the best lr exceeds the initial population's max,
+    which only mutation can produce."""
+    import json
+    import os
+
+    from ray_tpu.tune import (PopulationBasedTraining, TuneConfig, Tuner,
+                              get_checkpoint)
+
+    def trainable(config):
+        from ray_tpu import tune
+
+        v = 0.0
+        ckpt = get_checkpoint()
+        if ckpt:
+            with open(os.path.join(ckpt, "state.json")) as f:
+                v = json.load(f)["v"]
+        for i in range(40):
+            v += config["lr"]
+            d = tmp_path / f"ckpt_{os.getpid()}_{id(config)}_{i}"
+            d.mkdir(parents=True, exist_ok=True)
+            with open(d / "state.json", "w") as f:
+                json.dump({"v": v}, f)
+            tune.report({"score": v, "lr": config["lr"]},
+                        checkpoint=str(d))
+            time.sleep(0.02)  # pace so the controller observes mid-run
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=5,
+        hyperparam_mutations={"lr": 1.0}, seed=0)
+    results = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.5, 1.0, 2.0, 4.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               num_samples=1, max_concurrent_trials=4,
+                               scheduler=pbt)).fit()
+    assert pbt.num_exploits >= 1
+    best = results.get_best_result()
+    # An exploited trial carries a cloned (high) score forward.
+    assert best.metrics["score"] > 0
+    lrs = {r.metrics.get("lr", 0) for r in results}
+    # Explore perturbed at least one trial off the initial grid
+    # (x1.2 or x0.8 of a population member).
+    assert lrs - {0.5, 1.0, 2.0, 4.0}, lrs

@@ -1,0 +1,91 @@
+"""Durable workflows: per-step persistence + resume (reference:
+python/ray/workflow — api.py:123, workflow_state_from_storage.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture
+def wf_storage(tmp_path, ray_start_regular):
+    workflow.init(str(tmp_path / "wf"))
+    yield
+
+
+def test_run_dag_and_metadata(wf_storage):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), double.bind(10))
+    out = workflow.run(dag, workflow_id="wf1", args=4)
+    assert out == 28
+    assert workflow.get_status("wf1") == "SUCCEEDED"
+    meta = workflow.get_metadata("wf1")
+    assert meta["steps_run"] == 3 and meta["steps_restored"] == 0
+    assert any(w["workflow_id"] == "wf1" for w in workflow.list_all())
+
+
+def test_resume_skips_completed_steps(wf_storage, tmp_path):
+    """A step that fails mid-workflow leaves earlier steps durable;
+    resume() re-runs only the missing ones."""
+    marker = tmp_path / "fail_once"
+    marker.write_text("fail")
+    calls = tmp_path / "calls"
+    calls.mkdir()
+
+    @ray_tpu.remote
+    def expensive(x):
+        n = len(list(calls.iterdir()))
+        (calls / f"c{n}").write_text("x")
+        return x + 100
+
+    @ray_tpu.remote
+    def flaky(x):
+        if marker.exists():
+            raise RuntimeError("transient failure")
+        return x * 2
+
+    with InputNode() as inp:
+        dag = flaky.bind(expensive.bind(inp))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf2", args=1)
+    assert workflow.get_status("wf2") == "FAILED"
+    n_calls_before = len(list(calls.iterdir()))
+    assert n_calls_before == 1  # expensive ran once and persisted
+
+    marker.unlink()  # the failure clears; resume
+    out = workflow.resume("wf2")
+    assert out == 202
+    assert workflow.get_status("wf2") == "SUCCEEDED"
+    # expensive did NOT rerun: its output came from storage.
+    assert len(list(calls.iterdir())) == n_calls_before
+    meta = workflow.get_metadata("wf2")
+    assert meta["steps_restored"] >= 1
+
+
+def test_rerun_same_id_is_idempotent(wf_storage, tmp_path):
+    hits = tmp_path / "hits"
+    hits.mkdir()
+
+    @ray_tpu.remote
+    def effect(x):
+        n = len(list(hits.iterdir()))
+        (hits / f"h{n}").write_text("x")
+        return x + 1
+
+    with InputNode() as inp:
+        dag = effect.bind(inp)
+    assert workflow.run(dag, workflow_id="wf3", args=1) == 2
+    assert workflow.run(dag, workflow_id="wf3", args=1) == 2
+    assert len(list(hits.iterdir())) == 1  # second run restored
+
+    workflow.delete("wf3")
+    assert workflow.get_status("wf3") == "UNKNOWN"
