@@ -1,50 +1,230 @@
 """Compiled DAG execution (aDAG equivalent).
 
 Reference semantics: python/ray/dag/compiled_dag_node.py:691 — a bound
-DAG is compiled once into per-actor static execution loops connected by
-pre-allocated channels, replacing per-call RPC with channel write/read.
+DAG compiles once into a static schedule over pre-resolved endpoints
+with pre-allocated channels, replacing per-call graph interpretation.
 
-Current implementation: caches the topological submission plan so
-``execute`` re-walks a precomputed order (no re-traversal / re-binding);
-channel-based execution over mutable objects + ICI p2p lands with the
-cluster runtime (ray_tpu.core.node).
+What compiling buys here (TPU-first reading of the same idea):
+- The graph is FLATTENED ONCE into a slot-indexed step plan: per
+  execute there is no DAG traversal, no per-node dict building, no
+  re-binding — each step is (endpoint, arg-slot template).
+- DAG actors are created eagerly at compile time with their endpoints
+  pre-resolved into the plan (the reference's per-actor execution
+  loops); constructor args must be static.
+- Executions PIPELINE: ``execute`` returns refs immediately and up to
+  ``max_in_flight`` executions overlap (submission backpressure via
+  completion callbacks) — the aDAG property that lets a pipeline
+  schedule keep every stage busy.
+- The channel role is played by the object plane: in-process consumers
+  share sealed values zero-copy; cross-node consumers pull primary
+  copies over the chunk protocol.  (jax arrays additionally move
+  device-to-device only at true process boundaries.)
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
-from .dag_node import DAGNode, InputNode
+from .dag_node import (ClassMethodNode, ClassNode, DAGNode, FunctionNode,
+                       InputNode, MultiOutputNode)
+
+
+class _Step:
+    __slots__ = ("submit", "arg_plan", "kw_plan", "out_slot")
+
+    def __init__(self, submit, arg_plan, kw_plan, out_slot):
+        self.submit = submit      # fn(*args, **kwargs) -> ref
+        self.arg_plan = arg_plan  # [("const", v) | ("slot", i) | ("input",)]
+        self.kw_plan = kw_plan    # {k: same}
+        self.out_slot = out_slot
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, **_options):
+    def __init__(self, root: DAGNode, max_in_flight: int = 8,
+                 **_options):
         self._root = root
-        self._order = self._toposort(root)
+        self._in_flight = threading.Semaphore(max(1, max_in_flight))
+        self._slots_of: Dict[int, int] = {}
+        self._steps: List[_Step] = []
+        self._multi_output: Optional[List[int]] = None
+        # (class_node, handle): teardown kills AND clears the node's
+        # cached handle so a recompile makes a fresh actor.
+        self._actors: List[Tuple[Any, Any]] = []
+        # Refs of in-flight executions: held until completion so a
+        # fire-and-forget caller can't free the tail object before its
+        # callback fires (a freed object drops pending callbacks and
+        # would leak the semaphore slot).
+        self._holding: set = set()
+        self._compile(root)
 
-    @staticmethod
-    def _toposort(root: DAGNode) -> List[DAGNode]:
-        seen: Dict[int, DAGNode] = {}
+    # ------------------------------------------------------------ compile
+    def _compile(self, root: DAGNode):
         order: List[DAGNode] = []
+        seen: Dict[int, bool] = {}
 
         def visit(node: DAGNode):
             if id(node) in seen:
                 return
-            seen[id(node)] = node
+            seen[id(node)] = True
             for child in node._children():
                 visit(child)
             order.append(node)
 
         visit(root)
-        return order
 
+        for node in order:
+            if isinstance(node, InputNode):
+                continue
+            if isinstance(node, ClassNode):
+                self._ensure_actor(node)
+                continue
+            if isinstance(node, MultiOutputNode):
+                self._multi_output = [
+                    self._plan_entry(o) for o in node._bound_args]
+                continue
+            arg_plan = [self._plan_entry(a) for a in node._bound_args]
+            kw_plan = {k: self._plan_entry(v)
+                       for k, v in node._bound_kwargs.items()}
+            out_slot = len(self._slots_of)
+            self._slots_of[id(node)] = out_slot
+            self._steps.append(_Step(
+                self._make_submit(node), arg_plan, kw_plan, out_slot))
+
+    def _plan_entry(self, v) -> Tuple:
+        if isinstance(v, InputNode):
+            return ("input",)
+        if isinstance(v, ClassNode):
+            # An actor handle passed as a task argument resolves to the
+            # compile-time actor (same as the interpreted path).
+            return ("const", self._ensure_actor(v))
+        if isinstance(v, DAGNode):
+            slot = self._slots_of.get(id(v))
+            if slot is None:
+                raise ValueError(
+                    "DAG argument is not in topological order "
+                    "(unsupported node kind in compiled mode?)")
+            return ("slot", slot)
+        return ("const", v)
+
+    def _static_args(self, node: DAGNode):
+        for a in list(node._bound_args) + \
+                list(node._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                raise ValueError(
+                    "compiled DAG actors must have static constructor "
+                    "args (reference aDAG constraint)")
+        return node._bound_args, node._bound_kwargs
+
+    def _ensure_actor(self, node: ClassNode):
+        """Long-lived DAG actor, created once at compile
+        (compiled_dag_node.py: actors own their loops).  Constructor
+        args must be static.  ClassNodes are only reachable through
+        their method nodes' _target (not _children), so creation is
+        on demand, under the node's own handle lock (the interpreted
+        path shares it)."""
+        with node._handle_lock:
+            if node._handle is None:
+                args, kwargs = self._static_args(node)
+                cls = (node._actor_class.options(**node._options)
+                       if node._options else node._actor_class)
+                node._handle = cls.remote(*args, **kwargs)
+                self._actors.append((node, node._handle))
+            return node._handle
+
+    def _make_submit(self, node: DAGNode):
+        if isinstance(node, FunctionNode):
+            handle = (node._remote_fn.options(**node._options)
+                      if node._options else node._remote_fn)
+            return handle.remote
+        if isinstance(node, ClassMethodNode):
+            target = node._target
+            if isinstance(target, ClassNode):
+                actor = self._ensure_actor(target)
+            else:
+                actor = target
+            return getattr(actor, node._method_name).remote
+        raise TypeError(f"cannot compile node {type(node).__name__}")
+
+    # ------------------------------------------------------------ execute
     def execute(self, *input_values) -> Any:
+        """Run one pass over the static plan; returns the terminal
+        ref(s) immediately.  Up to ``max_in_flight`` passes overlap."""
         input_value = input_values[0] if input_values else None
-        cache: Dict[int, Any] = {}
-        for node in self._order:
-            if not isinstance(node, InputNode):
-                node._execute_impl(cache, input_value)
-        return self._root._execute_impl(cache, input_value)
+        self._in_flight.acquire()
+        released = [False]
+        rel_lock = threading.Lock()
+
+        def release_all(refs):
+            with rel_lock:
+                if released[0]:
+                    return
+                released[0] = True
+            for r in refs:
+                self._holding.discard(r)
+            self._in_flight.release()
+
+        try:
+            slots: List[Any] = [None] * len(self._steps)
+
+            def resolve(entry):
+                kind = entry[0]
+                if kind == "const":
+                    return entry[1]
+                if kind == "slot":
+                    return slots[entry[1]]
+                return input_value
+
+            ref = None
+            for step in self._steps:
+                args = tuple(resolve(e) for e in step.arg_plan)
+                kwargs = {k: resolve(e)
+                          for k, e in step.kw_plan.items()}
+                ref = step.submit(*args, **kwargs)
+                slots[step.out_slot] = ref
+            if self._multi_output is not None:
+                out = [resolve(e) for e in self._multi_output]
+                tails = [o for o in out
+                         if hasattr(o, "_on_completed")]
+            else:
+                out = ref if ref is not None else input_value
+                tails = [ref] if ref is not None else []
+            if not tails:
+                release_all(())
+                return out
+            # Backpressure releases when EVERY output of this pass
+            # completes; the refs are held meanwhile so a
+            # fire-and-forget caller can't free them early (freed
+            # objects drop their pending completion callbacks).
+            pending = [len(tails)]
+
+            def one_done(_obj=None):
+                with rel_lock:
+                    pending[0] -= 1
+                    last = pending[0] == 0
+                if last:
+                    release_all(tails)
+
+            for t in tails:
+                self._holding.add(t)
+            for t in tails:
+                t._on_completed(one_done)
+            return out
+        except BaseException:
+            release_all(())
+            raise
 
     def teardown(self):
-        pass
+        import ray_tpu
+
+        for node, handle in self._actors:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+            # Clear the node's cached handle: a recompile (or the
+            # interpreted path) must not route to the killed actor.
+            with node._handle_lock:
+                if node._handle is handle:
+                    node._handle = None
+        self._actors = []
