@@ -835,6 +835,7 @@ class NodeServer:
             "stream_item": self._stream_item,
             "add_pg_capacity": self._add_pg_capacity,
             "remove_pg_capacity": self._remove_pg_capacity,
+            "tail_log": self._tail_log,
             "ping": lambda p: "pong",
         }, ordered={"actor_call"})
         self.address = self._server.address
@@ -1097,6 +1098,22 @@ class NodeServer:
         except Exception:
             pass
         return {"ok": True}
+
+    def _tail_log(self, p):
+        """Tail this node's log file (reference: the dashboard log
+        module serving per-process session logs)."""
+        import os
+
+        path = getattr(self.runtime, "log_path", None)
+        if not path or not os.path.exists(path):
+            return {"found": False, "data": ""}
+        n = int(p.get("bytes", 64 * 1024))
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - n))
+            return {"found": True,
+                    "data": f.read().decode(errors="replace")}
 
     def _report_object_lost(self, p):
         """A consumer failed to pull this object's primary copy: mark

@@ -37,6 +37,8 @@ def main(argv=None) -> int:
     ap.add_argument("--resources", type=str, default="")
     ap.add_argument("--name", type=str, default="")
     ap.add_argument("--labels", type=str, default="")
+    ap.add_argument("--log-dir", type=str,
+                    default=os.environ.get("RAY_TPU_LOG_DIR", ""))
     args = ap.parse_args(argv)
 
     import ray_tpu
@@ -49,6 +51,30 @@ def main(argv=None) -> int:
         node_name=args.name, labels=labels)
     print(f"ray_tpu worker node {rt.node_id.hex()[:12]} "
           f"@ {rt.address} (head {args.head})", flush=True)
+    if args.log_dir:
+        # Per-node log capture (reference: per-process files in the
+        # session dir + log_monitor routing, _private/log_monitor.py):
+        # task/actor prints on this node land in one tailable file,
+        # registered in the head KV and served by the node's tail_log
+        # RPC (CLI: `ray_tpu logs <node>`).
+        os.makedirs(args.log_dir, exist_ok=True)
+        log_path = os.path.join(
+            args.log_dir, f"node-{rt.node_id.hex()[:12]}.log")
+        f = open(log_path, "ab", buffering=0)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.dup2(f.fileno(), 1)
+        os.dup2(f.fileno(), 2)
+        # The existing sys.stdout wrapper now writes to the file but is
+        # BLOCK-buffered against it (8 KB): without line buffering,
+        # task prints sit invisible until the buffer fills and are lost
+        # on crash.
+        try:
+            sys.stdout.reconfigure(line_buffering=True)
+            sys.stderr.reconfigure(line_buffering=True)
+        except Exception:
+            pass
+        rt.log_path = log_path
 
     try:
         head_gone_since = None

@@ -98,6 +98,9 @@ def start_worker_process(head_address: str, *,
     child_env = dict(os.environ)
     if force_cpu_platform:
         child_env.setdefault("JAX_PLATFORMS", "cpu")
+    # Worker prints must reach the node log promptly (and survive a
+    # crash) — see worker_main's log capture.
+    child_env.setdefault("PYTHONUNBUFFERED", "1")
     child_env.update(env or {})
     return subprocess.Popen(cmd, env=child_env,
                             stdout=subprocess.PIPE,

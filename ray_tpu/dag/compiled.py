@@ -56,7 +56,13 @@ class CompiledDAG:
         # callback fires (a freed object drops pending callbacks and
         # would leak the semaphore slot).
         self._holding: set = set()
-        self._compile(root)
+        try:
+            self._compile(root)
+        except BaseException:
+            # A failed compile must not leak the actors it already
+            # created (there is no CompiledDAG object to teardown).
+            self.teardown()
+            raise
 
     # ------------------------------------------------------------ compile
     def _compile(self, root: DAGNode):

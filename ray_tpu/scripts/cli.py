@@ -116,6 +116,33 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    rt = _connect(args.address)
+    for n in rt.cluster.list_nodes():
+        if not (n["node_id"].startswith(args.node)
+                or n.get("name") == args.node):
+            continue
+        if not n["alive"]:
+            print(f"node {args.node!r} is dead; its log file lives on "
+                  f"that host's --log-dir", file=sys.stderr)
+            return 1
+        try:
+            resp = rt.cluster.pool.get(n["address"]).call(
+                "tail_log", {"bytes": args.bytes}, timeout=30.0)
+        except Exception as e:  # noqa: BLE001
+            print(f"node {args.node!r} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        if not resp.get("found"):
+            print("(node has no log file — started without "
+                  "--log-dir)", file=sys.stderr)
+            return 1
+        sys.stdout.write(resp["data"])
+        return 0
+    print(f"no node matching {args.node!r}", file=sys.stderr)
+    return 1
+
+
 def cmd_job(args) -> int:
     from ray_tpu import job as job_mod
 
@@ -179,6 +206,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("memory", help="object store stats")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("logs", help="tail a node's log file")
+    p.add_argument("node", help="node id prefix or name")
+    p.add_argument("--address", required=True)
+    p.add_argument("--bytes", type=int, default=64 * 1024)
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("job", help="job control")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

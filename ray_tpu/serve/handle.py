@@ -65,6 +65,49 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterates a streaming deployment response: yields VALUES as the
+    replica yields them (reference: DeploymentResponseGenerator)."""
+
+    def __init__(self, ref_generator, on_done):
+        self._gen = ref_generator
+        self._on_done = on_done
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._finish()
+            raise
+        try:
+            return ray_tpu.get(ref)
+        except BaseException:
+            self._finish()
+            raise
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            try:
+                self._on_done()
+            except Exception:
+                pass
+
+    def close(self):
+        """Release the routing slot without draining (early-exit
+        consumers must not leak outstanding counts)."""
+        self._finish()
+
+    def __del__(self):
+        self._finish()
+
+
 class _Router:
     """Shared routing state for every view of one deployment's handle:
     replica set, per-replica outstanding counts, membership version."""
@@ -146,14 +189,18 @@ class _Router:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, replicas: List[Any],
                  method_name: str = "", controller=None,
-                 version: int = -1, _router: Optional[_Router] = None):
+                 version: int = -1, _router: Optional[_Router] = None,
+                 stream: bool = False):
         self.deployment_name = deployment_name
         self._router = _router or _Router(deployment_name, replicas,
                                           controller, version)
         self._method = method_name
+        self._stream = stream
 
     # -- calls -------------------------------------------------------------
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return self._remote_streaming(args, kwargs)
         ref, release = self._issue(args, kwargs)
 
         def retry():
@@ -172,6 +219,23 @@ class DeploymentHandle:
         # never called (completion callback keeps counts truthful).
         ref._on_completed(lambda _o: resp._settle())
         return resp
+
+    def _remote_streaming(self, args, kwargs):
+        """Streaming response (reference: handle.options(stream=True),
+        handle.py:496): routes to the replica's generator endpoint;
+        returns a DeploymentResponseGenerator yielding values as the
+        replica yields them (cross-node: streaming-generator item
+        reporting)."""
+        replica, key = self._router.pick()
+        try:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(
+                self._method, args, kwargs)
+        except BaseException:
+            self._router.release(key)
+            raise
+        return DeploymentResponseGenerator(
+            gen, on_done=lambda: self._router.release(key))
 
     def _issue(self, args, kwargs):
         replica, key = self._router.pick()
@@ -194,14 +258,15 @@ class DeploymentHandle:
 
         return ref, release_once
 
-    def options(self, *, method_name: Optional[str] = None
-                ) -> "DeploymentHandle":
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         # Views share the router, so balance and membership are global
         # across method-scoped views of the same handle.
         return DeploymentHandle(
             self.deployment_name, [],
             method_name if method_name is not None else self._method,
-            _router=self._router)
+            _router=self._router,
+            stream=self._stream if stream is None else stream)
 
     @property
     def method(self):

@@ -45,6 +45,25 @@ class Replica:
         finally:
             self._num_ongoing -= 1
 
+    async def handle_request_streaming(self, method: str, args: Tuple,
+                                       kwargs: Dict[str, Any]):
+        """Generator endpoint: the user method yields items, forwarded
+        through the actor streaming-generator machinery (reference:
+        replica streaming + proxy_response_generator.py)."""
+        self._num_ongoing += 1
+        try:
+            fn = getattr(self._instance, method) if method \
+                else self._instance
+            out = fn(*args, **kwargs)
+            if inspect.isasyncgen(out):
+                async for item in out:
+                    yield item
+            else:
+                for item in out:
+                    yield item
+        finally:
+            self._num_ongoing -= 1
+
     async def num_ongoing_requests(self) -> int:
         """Queue-length probe (reference: pow-2 scheduler probes
         replicas for their ongoing count, pow_2_scheduler.py:52)."""

@@ -106,3 +106,44 @@ class TestCLI:
             capture_output=True, text=True, env=env, timeout=120)
         assert out.returncode == 0, out.stderr + out.stdout
         assert "SUCCEEDED" in out.stdout
+
+
+class TestNodeLogs:
+    def test_node_log_capture_and_cli(self, job_cluster, tmp_path):
+        """Worker task prints land in the node's log file, tailable
+        through the node RPC and `ray_tpu logs` (reference: session-dir
+        per-process logs + dashboard log module)."""
+        job_cluster.add_node(num_cpus=1, resources={"lw": 1},
+                             name="logw",
+                             env={"RAY_TPU_LOG_DIR": str(tmp_path)})
+        rt = ray_tpu.get_runtime()
+
+        @ray_tpu.remote(resources={"lw": 1})
+        def chatty():
+            print("hello-from-node-log")
+            return 1
+
+        assert ray_tpu.get(chatty.remote(), timeout=30) == 1
+        node = [n for n in rt.cluster.list_nodes()
+                if n["total"].get("lw")][0]
+        deadline = time.monotonic() + 15
+        data = ""
+        while time.monotonic() < deadline:
+            resp = rt.cluster.pool.get(node["address"]).call(
+                "tail_log", {}, timeout=10.0)
+            data = resp.get("data", "")
+            if "hello-from-node-log" in data:
+                break
+            time.sleep(0.3)
+        assert "hello-from-node-log" in data
+        import os
+        import subprocess
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "logs",
+             node["node_id"][:8], "--address",
+             job_cluster.head_address],
+            capture_output=True, text=True, env=env, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "hello-from-node-log" in out.stdout

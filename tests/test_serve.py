@@ -249,3 +249,28 @@ def test_rolling_update_zero_downtime(serve_session):
     assert set(results) <= {1, 2}
     assert results[-1] == 2  # traffic fully on the new version
     assert serve.status()["V"]["version"] == 2
+
+
+def test_streaming_handle_response(serve_session):
+    """handle.options(stream=True) yields values as the replica yields
+    them (reference: handle.py:496 generator responses)."""
+
+    @serve.deployment
+    class Tokens:
+        def stream_out(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+        async def astream(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0)
+                yield i * 2
+
+    handle = serve.run(Tokens.bind())
+    out = list(handle.options(method_name="stream_out",
+                              stream=True).remote(4))
+    assert out == ["tok0", "tok1", "tok2", "tok3"]
+    out = list(handle.astream.options(stream=True).remote(3))
+    assert out == [0, 2, 4]
