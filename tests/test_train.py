@@ -91,3 +91,56 @@ def test_trainer_dataset_multi_epoch(ray_start_regular, tmp_path):
             totals[e] += rows
     # Each epoch's shards cover all 32 rows across the two workers.
     assert totals == [32, 32]
+
+
+def test_failure_config_resumes_from_checkpoint(ray_start_regular,
+                                                tmp_path):
+    """A worker failure mid-run restarts the gang from the latest
+    checkpoint (reference: FailureConfig, air/config.py:394 — Tune
+    restarts the trainable from the last checkpoint).  The loop crashes
+    once at step 3 of 6; the retry resumes at the checkpointed step and
+    the final checkpoint carries the full run."""
+    crash_flag = tmp_path / "crash_once"
+    crash_flag.write_text("armed")
+    from ray_tpu.train import CheckpointConfig, FailureConfig
+
+    def loop(config):
+        import json
+        import os
+        import tempfile
+
+        from ray_tpu import train as T
+
+        start = 0
+        ckpt = T.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["step"]
+        for step in range(start, 6):
+            if step == 3 and os.path.exists(config["crash_flag"]):
+                os.unlink(config["crash_flag"])
+                raise RuntimeError("injected worker failure")
+            d = tempfile.mkdtemp(prefix=f"step{step}_")
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step + 1}, f)
+            T.report({"step": step + 1}, checkpoint=T.Checkpoint(d))
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"crash_flag": str(crash_flag)},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path / "results"),
+            failure_config=FailureConfig(max_failures=2),
+            checkpoint_config=CheckpointConfig(num_to_keep=3)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert not crash_flag.exists()  # the injected failure fired
+    assert result.metrics["step"] == 6
+    # The final checkpoint is the step-6 one.
+    import json as _json
+    import os as _os
+
+    with open(_os.path.join(result.checkpoint.path, "state.json")) as f:
+        assert _json.load(f)["step"] == 6
