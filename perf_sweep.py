@@ -35,6 +35,13 @@ CASES = {
                                batch=8, bf16_mu=True),
     "bf16mu-dotssave-b16": dict(kw={"remat_policy": "dots_saveable"},
                                 batch=16, bf16_mu=True),
+    "flash-dots-b8": dict(kw={"remat_policy": "dots"}, batch=8),
+    "flash-dotssave-b8": dict(kw={"remat_policy": "dots_saveable"},
+                              batch=8),
+    "bf16mu-dots-b8": dict(kw={"remat_policy": "dots"}, batch=8,
+                           bf16_mu=True),
+    "bf16mu-dots-b16": dict(kw={"remat_policy": "dots"}, batch=16,
+                            bf16_mu=True),
 }
 # Measured r4 (v5e): an "attn_out" save_only_these_names policy (save
 # attention outputs, remat the rest) came out SLOWER than full remat

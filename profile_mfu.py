@@ -1,0 +1,142 @@
+"""One-off MFU decomposition on the real chip (not part of the package).
+
+Times the pieces of the 440M train step separately so the gap between
+31.5% measured MFU and peak is attributable.  Each phase runs in its own
+subprocess (HBM buffers + jit caches would otherwise accumulate and OOM).
+
+Usage: python profile_mfu.py [batch] ['{"remat_policy":"dots"}']
+       python profile_mfu.py --one <phase> <batch> <cfg_json>
+"""
+import json
+import subprocess
+import sys
+import time
+
+PEAK = 197e12
+PHASES = ["fwd", "grad", "step", "attn_flash", "attn_dot", "head"]
+
+
+def timeit(fn, *args, warmup=2, steps=5):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    lv = jax.tree.leaves(out)
+    if lv:
+        _ = jax.device_get(lv[0])  # real sync on the axon platform
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    lv = jax.tree.leaves(out)
+    if lv:
+        _ = jax.device_get(lv[0])
+    return (time.perf_counter() - t0) / steps
+
+
+def run_one(phase: str, batch: int, cfg_kw: dict):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    seq = 2048
+    cfg = llama.LlamaConfig.llama_440m(**cfg_kw)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    b = {"tokens": tokens}
+
+    if phase in ("fwd", "grad", "step"):
+        if phase == "step":
+            state = llama.init_train_state(jax.random.key(0), cfg)
+            step = llama.make_train_step(cfg, donate=False)
+            t = timeit(lambda: step(state, b)[1]["loss"])
+        else:
+            params = llama.init_params(jax.random.key(0), cfg)
+            if phase == "fwd":
+                f = jax.jit(lambda p: llama.loss_fn(p, b, cfg))
+            else:
+                f = jax.jit(lambda p: jax.value_and_grad(llama.loss_fn)(
+                    p, b, cfg))
+            t = timeit(f, params)
+    elif phase in ("attn_flash", "attn_dot"):
+        B, S = batch, seq
+        Hq, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        q = jax.random.normal(jax.random.key(2), (B, S, Hq, D),
+                              jnp.bfloat16)
+        k = jax.random.normal(jax.random.key(3), (B, S, Hkv, D),
+                              jnp.bfloat16)
+        v = jax.random.normal(jax.random.key(4), (B, S, Hkv, D),
+                              jnp.bfloat16)
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        if phase == "attn_flash":
+            from ray_tpu.ops.flash_attention import flash_attention_causal
+            attn = flash_attention_causal
+        else:
+            attn = llama.dot_attention
+
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(attn(q, k, v, pos)
+                                    .astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        t = timeit(g, q, k, v) * cfg.n_layers  # scale to 24 layers
+    elif phase == "head":
+        params = llama.init_params(jax.random.key(0), cfg)
+        x = jax.random.normal(jax.random.key(5),
+                              (batch, seq, cfg.hidden_size), jnp.bfloat16)
+        emb = params["embed_tokens"]
+
+        def head_loss(x, emb):
+            logits = llama.matmul(x, emb.astype(cfg.dtype).T)[:, :-1]
+            logits = logits.astype(jnp.float32)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, tokens[:, 1:][..., None], axis=-1).squeeze(-1)
+            return jnp.mean(logz - gold)
+
+        g = jax.jit(jax.grad(head_loss, argnums=(0, 1)))
+        t = timeit(g, x, emb)
+    else:
+        raise SystemExit(f"unknown phase {phase}")
+    print(json.dumps({"phase": phase, "s": round(t, 4)}))
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    cfg_json = sys.argv[2] if len(sys.argv) > 2 else "{}"
+    res = {"batch": batch, "cfg": json.loads(cfg_json)}
+    for phase in PHASES:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--one", phase, str(batch),
+             cfg_json], capture_output=True, text=True, timeout=1200)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        if proc.returncode == 0 and lines:
+            res[phase + "_s"] = json.loads(lines[-1])["s"]
+        else:
+            err = (proc.stderr or "").strip().splitlines()
+            res[phase + "_err"] = err[-1][:120] if err else proc.returncode
+        print(json.dumps(res), flush=True)
+    if "step_s" in res:
+        from ray_tpu.models import llama
+        import jax
+
+        cfg = llama.LlamaConfig.llama_440m(**res["cfg"])
+        n = llama.param_count(jax.eval_shape(
+            lambda: llama.init_params(jax.random.key(0), cfg)))
+        toks = batch * 2047
+        res["tok_per_s"] = round(toks / res["step_s"], 1)
+        res["mfu_6n"] = round(toks / res["step_s"] * 6 * n / PEAK, 4)
+        if "grad_s" in res:
+            res["opt_overhead_s"] = round(res["step_s"] - res["grad_s"], 4)
+        if "fwd_s" in res and "grad_s" in res:
+            res["bwd_ratio"] = round(res["grad_s"] / res["fwd_s"], 2)
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        run_one(sys.argv[2], int(sys.argv[3]),
+                json.loads(sys.argv[4]) if len(sys.argv) > 4 else {})
+    else:
+        main()

@@ -85,7 +85,8 @@ class Autoscaler:
                  node_resources: Optional[Dict[str, float]] = None,
                  min_nodes: int = 0, max_nodes: int = 4,
                  idle_timeout_s: float = 60.0,
-                 poll_interval_s: float = 1.0):
+                 poll_interval_s: float = 1.0,
+                 boot_timeout_s: float = 120.0):
         from ..cluster.rpc import ReconnectingClient
 
         self.provider = provider
@@ -97,6 +98,15 @@ class Autoscaler:
         self._head = ReconnectingClient(head_address)
         self._stop = threading.Event()
         self._idle_since: Dict[str, float] = {}
+        # Launched-but-not-yet-registered nodes: tag -> launch time.
+        # Without this, an infeasible placement launches a node per poll
+        # tick until the demand ledger ages out (reference: v1
+        # autoscaler's pending-launch accounting in
+        # resource_demand_scheduler.py).
+        self._pending_launches: Dict[str, float] = {}
+        # Size to the provider's boot-to-register time (cloud TPU VMs
+        # take minutes); too short resurfaces the duplicate-launch storm.
+        self._boot_timeout_s = boot_timeout_s
         self.num_launched = 0
         self.num_terminated = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -113,20 +123,33 @@ class Autoscaler:
     def _reconcile(self):
         demands = self._head.call("pending_demand",
                                   {"window_s": 10.0}, timeout=5.0)
+        nodes = self._head.call("list_nodes", {}, timeout=5.0)
         live = self.provider.live_nodes()
+        # A launch is pending until the node registers with the head (by
+        # name) or the boot timeout lapses; pending launches count toward
+        # the target so repeated polls don't relaunch for the same demand.
+        registered = {n.get("name") or "" for n in nodes}
+        now = time.monotonic()
+        self._pending_launches = {
+            tag: t for tag, t in self._pending_launches.items()
+            if tag not in registered and now - t < self._boot_timeout_s
+            and tag in live}
         # Scale up: bin-pack unmet demands onto hypothetical nodes of
         # the configured type (reference:
         # resource_demand_scheduler.py get_nodes_to_launch).
-        want = self._nodes_needed(demands)
+        needed = self._nodes_needed(demands)
+        want = needed - len(self._pending_launches)
         can_add = min(want, self.max_nodes - len(live))
         for _ in range(max(0, can_add)):
-            self.provider.create_node(self.node_resources)
+            tag = self.provider.create_node(self.node_resources)
+            self._pending_launches[tag] = time.monotonic()
             self.num_launched += 1
-        if want > 0:
-            return  # busy cluster: reset idle tracking next pass
+        if needed > 0:
+            # Unmet demand (even if fully covered by pending launches):
+            # never scale down while nodes are booting to serve it.
+            return
         # Scale down: terminate nodes idle past the timeout, keeping
         # min_nodes (reference: NodeIdleTerminationPolicy).
-        nodes = self._head.call("list_nodes", {}, timeout=5.0)
         busy_names = set()
         for n in nodes:
             used = {
@@ -137,7 +160,9 @@ class Autoscaler:
         now = time.monotonic()
         live = self.provider.live_nodes()
         for tag in live:
-            if tag in busy_names:
+            if tag in busy_names or tag in self._pending_launches:
+                # Busy, or launched and still booting — a node that has
+                # not yet registered must not be reaped as "idle".
                 self._idle_since.pop(tag, None)
                 continue
             since = self._idle_since.setdefault(tag, now)

@@ -67,7 +67,11 @@ class ClusterClient:
 
         self.server = NodeServer(runtime, self)
         self.address = self.server.address
-        self._labels = dict(labels or {})
+        # Auto-detected TPU topology labels (slice / worker-index —
+        # core/tpu_topology.py) under explicit labels, which win.
+        from ..core.tpu_topology import detect_topology_labels
+
+        self._labels = {**detect_topology_labels(), **(labels or {})}
         self.head.call("register_node", {
             "node_id": self.node_id,
             "address": self.address,
@@ -130,6 +134,10 @@ class ClusterClient:
                     return
                 continue
             except Exception:
+                # Back off: an immediate head-side error (e.g. version
+                # skew) must not hot-spin RPCs against the head.
+                if self._stopped.wait(1.0):
+                    return
                 continue
             ch = (out or {}).get("node_death")
             if not ch:

@@ -33,12 +33,14 @@ _RESERVATION_TTL_S = 2.5  # ≥ 2 heartbeats: by then the placed task is
 
 class NodeEntry:
     __slots__ = ("node_id", "address", "total", "available",
-                 "last_heartbeat", "alive", "labels", "reserved")
+                 "last_heartbeat", "alive", "labels", "reserved", "name")
 
     def __init__(self, node_id: str, address: str,
-                 total: Dict[str, float], labels: Dict[str, str]):
+                 total: Dict[str, float], labels: Dict[str, str],
+                 name: str = ""):
         self.node_id = node_id
         self.address = address
+        self.name = name
         self.total = dict(total)
         self.available = dict(total)
         self.last_heartbeat = time.monotonic()
@@ -190,7 +192,7 @@ class HeadServer:
     # ------------------------------------------------------------- nodes
     def _register_node(self, p):
         entry = NodeEntry(p["node_id"], p["address"], p["resources"],
-                          p.get("labels", {}))
+                          p.get("labels", {}), p.get("name", ""))
         with self._lock:
             self._nodes[p["node_id"]] = entry
         return {"ok": True, "num_nodes": len(self._nodes)}
@@ -357,6 +359,7 @@ class HeadServer:
                 "node_id": e.node_id, "address": e.address,
                 "total": dict(e.total), "available": dict(e.available),
                 "alive": e.alive, "labels": dict(e.labels),
+                "name": e.name,
             } for e in self._nodes.values()]
 
     def _reap_loop(self):
@@ -597,6 +600,17 @@ class HeadServer:
             alive = [e for e in self._nodes.values() if e.alive]
             if not alive:
                 return {"ok": False, "error": "no alive nodes"}
+            if strategy in ("SLICE_PACK", "SLICE_SPREAD"):
+                result = self._place_pg_by_slice(bundles, strategy, alive)
+                if not result.get("ok"):
+                    return result
+                assignment = result["nodes"]
+                self._pgs[pg_id] = {"bundles": bundles,
+                                    "nodes": assignment}
+                self._mark_dirty()
+                addr = {e.node_id: e.address for e in alive}
+                return {"ok": True, "nodes": assignment,
+                        "addresses": [addr[n] for n in assignment]}
             assignment: List[str] = []
             # Track debits against a scratch copy; commit on success.
             scratch = {e.node_id: dict(e.available) for e in alive}
@@ -630,6 +644,98 @@ class HeadServer:
             addr = {e.node_id: e.address for e in alive}
         return {"ok": True, "nodes": assignment,
                 "addresses": [addr[n] for n in assignment]}
+
+    def _place_pg_by_slice(self, bundles, strategy, alive):
+        """ICI-topology-aware bundle placement over slice labels
+        (core/tpu_topology.py; reference TPU-pod detection:
+        _private/accelerators/tpu.py:14-42).
+
+        - ``SLICE_PACK``: all bundles onto the hosts of ONE slice, in
+          worker-index order — a train gang whose collectives must ride
+          ICI.  Prefers the smallest slice that fits (leaves big slices
+          for big gangs).
+        - ``SLICE_SPREAD``: bundle i onto slice i (distinct slices,
+          sorted by name) — cross-slice pipeline stages where only
+          stage boundaries cross DCN.  Within a slice the lowest
+          worker-index host that fits is used.
+
+        A node without a slice label forms its own single-node
+        pseudo-slice, so both strategies degrade gracefully on
+        unlabeled (CPU-sim / single-host) clusters."""
+        from ..core.tpu_topology import SLICE_LABEL, WORKER_INDEX_LABEL
+
+        def widx(e):
+            try:
+                return int(e.labels.get(WORKER_INDEX_LABEL, ""))
+            except ValueError:
+                return 1 << 30
+
+        slices: Dict[str, List[NodeEntry]] = {}
+        for e in alive:
+            key = e.labels.get(SLICE_LABEL) or f"node:{e.node_id}"
+            slices.setdefault(key, []).append(e)
+        for members in slices.values():
+            members.sort(key=lambda e: (widx(e), e.node_id))
+
+        def fit_on(members, wanted):
+            """Fit ``wanted`` bundles onto ``members`` in worker-index
+            order, one bundle per host round-robin (gang semantics:
+            bundle i ↔ slice worker i), falling back to any member with
+            capacity; None if infeasible."""
+            scratch = {e.node_id: dict(e.available) for e in members}
+            out = []
+            for i, bundle in enumerate(wanted):
+                placed = None
+                rotated = members[i % len(members):] + \
+                    members[:i % len(members)]
+                for e in rotated:
+                    if all(scratch[e.node_id].get(k, 0) >= v
+                           for k, v in bundle.items()):
+                        for k, v in bundle.items():
+                            scratch[e.node_id][k] = \
+                                scratch[e.node_id].get(k, 0) - v
+                        placed = e.node_id
+                        break
+                if placed is None:
+                    return None
+                out.append(placed)
+            return out
+
+        if strategy == "SLICE_PACK":
+            # Smallest adequate slice first; name as tiebreak for
+            # determinism.
+            for key in sorted(slices, key=lambda k: (len(slices[k]), k)):
+                got = fit_on(slices[key], bundles)
+                if got is not None:
+                    return {"ok": True, "nodes": got}
+            return {"ok": False,
+                    "error": f"no single slice fits all {len(bundles)} "
+                             f"bundles (SLICE_PACK; slices: "
+                             f"{sorted(slices)})"}
+        # SLICE_SPREAD: one distinct slice per bundle.
+        keys = sorted(slices)
+        if len(keys) < len(bundles):
+            return {"ok": False,
+                    "error": f"SLICE_SPREAD needs {len(bundles)} "
+                             f"slices, cluster has {len(keys)}"}
+        assignment = []
+        used = set()
+        for bundle in bundles:
+            placed = None
+            for key in keys:
+                if key in used:
+                    continue
+                got = fit_on(slices[key], [bundle])
+                if got is not None:
+                    placed = got[0]
+                    used.add(key)
+                    break
+            if placed is None:
+                return {"ok": False,
+                        "error": f"bundle {bundle} fits no unused "
+                                 f"slice (SLICE_SPREAD)"}
+            assignment.append(placed)
+        return {"ok": True, "nodes": assignment}
 
     def _remove_pg(self, p):
         with self._lock:

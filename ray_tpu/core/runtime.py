@@ -510,8 +510,26 @@ class Runtime:
             if isinstance(v, ObjectRef):
                 obj = self.object_store.get_if_exists(v.object_id())
                 if obj is None:
-                    raise RuntimeError(
-                        f"dependency {v!r} not local at dispatch time")
+                    # Actor tasks dispatch FIFO with no scheduler
+                    # dep-gating (submit_actor_task → core.submit), so a
+                    # ref produced by a concurrently-running task may
+                    # not be local yet: fetch remote-owned args, wait
+                    # out locally-produced ones (reference: actor tasks
+                    # execute in submission order with args resolved at
+                    # dispatch, dependency_manager.h:49).
+                    try:
+                        if self.cluster is not None:
+                            self.cluster.ensure_local(v)
+                        obj = self.object_store.wait_and_get(
+                            v.object_id(), timeout=600.0)
+                    except Exception as e:  # noqa: BLE001
+                        if error is None:
+                            error = TaskError(
+                                spec.repr_name(),
+                                RuntimeError(
+                                    f"dependency {v!r} unresolvable at "
+                                    f"dispatch: {e!r}"))
+                        return None
                 if obj.is_located_only():
                     obj = self._materialize_located(v.object_id())
                 if obj.is_error() and error is None:
@@ -578,7 +596,13 @@ class Runtime:
     async def execute_task_inline_async(self, spec: TaskSpec,
                                         bound_instance=None,
                                         actor_core=None):
-        args, kwargs, dep_error = self._resolve_args(spec)
+        import asyncio
+
+        # _resolve_args may block waiting for a not-yet-local dep; on
+        # the async actor's event loop that would freeze the coroutines
+        # producing it — offload the wait to a worker thread.
+        args, kwargs, dep_error = await asyncio.get_event_loop() \
+            .run_in_executor(None, self._resolve_args, spec)
         if dep_error is not None:
             self.task_manager.complete_error(spec, dep_error,
                                              allow_retry=False)

@@ -1,0 +1,74 @@
+"""TPU pod/slice topology detection → node placement labels.
+
+Reference: python/ray/_private/accelerators/tpu.py:14-42 — Ray detects
+the TPU pod environment from the metadata env vars the TPU runtime
+injects (accelerator type, worker id, worker hostnames) and advertises
+them so the autoscaler/scheduler can treat a pod slice as a gang unit
+(pod command runners: autoscaler/_private/gcp/tpu_command_runner.py:1-6).
+
+TPU-first reading: a *slice* is the ICI domain — collectives inside a
+slice ride ICI, across slices they ride DCN.  The head's placement
+strategies (cluster/head.py SLICE_PACK / SLICE_SPREAD) use these labels
+to (a) pack one train gang onto the hosts of a single slice in
+worker-index order (ICI-adjacent), and (b) spread pipeline stages one
+slice each so only stage boundaries cross DCN.
+
+Env contract (the TPU VM runtime sets these; tests set them manually):
+- ``TPU_ACCELERATOR_TYPE``  e.g. "v5litepod-16"
+- ``TPU_WORKER_ID``         this host's index within its slice
+- ``TPU_WORKER_HOSTNAMES``  comma-separated hosts of the slice
+- ``MEGASCALE_SLICE_ID``    slice index in a multislice deployment
+- ``TPU_NAME``              slice/queued-resource name
+``RAY_TPU_SLICE`` / ``RAY_TPU_WORKER_INDEX`` override for tests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+# Label keys (reference uses "ray.io/..." style node labels).
+SLICE_LABEL = "ray_tpu.io/slice"
+WORKER_INDEX_LABEL = "ray_tpu.io/worker-index"
+ACCELERATOR_TYPE_LABEL = "ray_tpu.io/accelerator-type"
+SLICE_HOSTS_LABEL = "ray_tpu.io/slice-host-count"
+
+
+def detect_topology_labels(env: Dict[str, str] = None) -> Dict[str, str]:
+    """Labels describing this host's position in the TPU topology.
+
+    Empty dict off-TPU (no env markers).  A multislice deployment gets
+    ``slice = <name>/<MEGASCALE_SLICE_ID>`` so slices of one queued
+    resource stay distinct.
+    """
+    e = os.environ if env is None else env
+    labels: Dict[str, str] = {}
+
+    slice_name = e.get("RAY_TPU_SLICE")
+    if slice_name is None:
+        base = e.get("TPU_NAME") or ""
+        mega = e.get("MEGASCALE_SLICE_ID")
+        if mega is not None:
+            slice_name = f"{base or 'slice'}/{mega}"
+        elif base:
+            slice_name = base
+        elif e.get("TPU_ACCELERATOR_TYPE"):
+            # Single unnamed slice: all its hosts share the hostname
+            # list, so the list itself identifies the slice.
+            slice_name = e.get("TPU_WORKER_HOSTNAMES", "slice")
+    if slice_name:
+        labels[SLICE_LABEL] = slice_name
+
+    widx = e.get("RAY_TPU_WORKER_INDEX", e.get("TPU_WORKER_ID"))
+    if widx is not None:
+        labels[WORKER_INDEX_LABEL] = str(widx)
+
+    acc = e.get("TPU_ACCELERATOR_TYPE")
+    if acc:
+        labels[ACCELERATOR_TYPE_LABEL] = acc
+
+    hosts = e.get("TPU_WORKER_HOSTNAMES")
+    if hosts:
+        labels[SLICE_HOSTS_LABEL] = str(len(hosts.split(",")))
+
+    return labels

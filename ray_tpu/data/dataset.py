@@ -129,7 +129,7 @@ class Dataset:
                     out.append((j, BlockAccessor.slice(block, lo, hi)))
             return out
 
-        def merge(blocks: List[Block], _spec) -> List[Block]:
+        def merge(blocks: List[Block], _spec, _idx) -> List[Block]:
             return [BlockAccessor.concat(blocks)] if blocks else []
 
         return self._with(Exchange("Repartition", partition, merge,
@@ -152,11 +152,14 @@ class Dataset:
                                            np.nonzero(assign == j)[0]))
                     for j in builtins.range(n)]
 
-        def merge(blocks: List[Block], _spec) -> List[Block]:
+        def merge(blocks: List[Block], _spec, part_idx) -> List[Block]:
             if not blocks:
                 return []
             whole = BlockAccessor.concat(blocks)
-            rng = np.random.default_rng(seed)
+            # Fold the merge partition index into the seed so output
+            # partitions don't share one permutation pattern.
+            rng = np.random.default_rng(
+                None if seed is None else (seed, part_idx))
             perm = rng.permutation(BlockAccessor.num_rows(whole))
             return [BlockAccessor.take(whole, perm)]
 
@@ -194,7 +197,7 @@ class Dataset:
                                            np.nonzero(idx == j)[0]))
                     for j in builtins.range(n)]
 
-        def merge(blocks: List[Block], _spec) -> List[Block]:
+        def merge(blocks: List[Block], _spec, _idx) -> List[Block]:
             if not blocks:
                 return []
             whole = BlockAccessor.concat(blocks)

@@ -215,11 +215,11 @@ def _run_partition(group: List[Block], n_out: int, partition_fn,
     return parts
 
 
-def _run_merge(merge_fn, spec, *part_lists):
+def _run_merge(merge_fn, spec, part_idx, *part_lists):
     blocks: List[Block] = []
     for pl in part_lists:
         blocks.extend(pl)
-    merged = merge_fn(blocks, spec)
+    merged = merge_fn(blocks, spec, part_idx)
     return merged, _meta(merged)
 
 
@@ -599,7 +599,7 @@ def _stream_exchange(source, op: Exchange, ctx, stats):
     merge_refs = []
     for j in range(n_out):
         merge_refs.append(remote_merge.remote(
-            op.merge_fn, spec, *[parts[j] for parts in part_refs]))
+            op.merge_fn, spec, j, *[parts[j] for parts in part_refs]))
         op_stats.num_tasks += 1
 
     def gen():
@@ -628,8 +628,8 @@ def _run_partition_wrapped(group, n_out, partition_fn, spec, offset):
     return parts
 
 
-def _run_merge_wrapped(merge_fn, spec, *part_lists):
-    return _run_merge(merge_fn, spec, *part_lists)
+def _run_merge_wrapped(merge_fn, spec, part_idx, *part_lists):
+    return _run_merge(merge_fn, spec, part_idx, *part_lists)
 
 
 def _run_driver_barrier(source, barrier: AllToAll, ctx, stats):

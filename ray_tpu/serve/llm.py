@@ -214,6 +214,10 @@ class LLMServer:
             self._warmup()
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # Request dequeued by the idle wait, consumed by the next
+        # _admit_wave ahead of the queue (re-enqueueing at the tail
+        # would reorder FIFO admission).
+        self._idle_stash: Optional[_Request] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -313,10 +317,13 @@ class LLMServer:
                 if self.slot_req[s] is None]
         wave: List[tuple] = []  # (slot, req, bucket)
         while free:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
+            if self._idle_stash is not None:
+                req, self._idle_stash = self._idle_stash, None
+            else:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
             slot = free.pop(0)
             # Claim the slot immediately: if a device call fails,
             # _fatal finds every dequeued request in slot_req.
@@ -393,6 +400,10 @@ class LLMServer:
             if req is not None:
                 req.error = e
                 self._finish(slot)
+        if self._idle_stash is not None:
+            req, self._idle_stash = self._idle_stash, None
+            req.error = e
+            req.finish_notify()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -413,10 +424,10 @@ class LLMServer:
                 pending = launched
                 if pending is None and not any(
                         r is not None for r in self.slot_req):
-                    # Idle: block for work instead of spinning.
+                    # Idle: block for work instead of spinning.  Stash
+                    # the dequeued request for the next _admit_wave.
                     try:
-                        req = self._queue.get(timeout=0.05)
-                        self._queue.put(req)
+                        self._idle_stash = self._queue.get(timeout=0.05)
                     except queue.Empty:
                         pass
         except BaseException as e:  # noqa: BLE001

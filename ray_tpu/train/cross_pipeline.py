@@ -1,0 +1,291 @@
+"""Cross-process pipeline parallelism: GPipe over stage gangs.
+
+The missing DCN half of the parallelism story (SURVEY §5.8, §7): the
+in-jit schedule (parallel/pipeline.py) covers pipe stages WITHIN one
+mesh/ICI domain; this module pipelines ACROSS processes — each stage is
+an actor owning one slice's mesh and its layer block, activations ride
+the object plane between stages (the compiled-DAG channel role,
+reference substrate python/ray/dag/dag_node_operation.py:506-539), and
+the head places one stage per TPU slice (SLICE_SPREAD,
+cluster/head.py), so only stage boundaries cross DCN.
+
+Schedule: per step, M microbatches flow all-forward then all-backward
+(GPipe).  Every call is an async actor call chained by object refs, so
+stage i runs microbatch m while stage i+1 runs m-1 — the pipeline
+overlap comes from per-actor FIFO execution + dataflow, with no central
+tick loop.  Backward is stage-granular recomputation: a stage keeps
+only its INPUT per in-flight microbatch and re-runs its forward under
+``jax.vjp`` when the output cotangent arrives.
+
+Optimizer parity with the single-process step (llama.default_optimizer:
+global-norm clip 1.0 + adamw) is kept exactly: stages accumulate
+microbatch grads, the driver sums the per-stage squared norms into the
+TRUE global norm, and each stage applies the same clip scale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.parallel.mesh import MeshSpec
+
+PyTree = Any
+
+
+@ray_tpu.remote
+class _StageWorker:
+    """One pipeline stage: owns its parameter slice, mesh, and the
+    jitted fwd / fwd-loss / vjp programs."""
+
+    def __init__(self, stage: int, n_stages: int, config: LlamaConfig,
+                 mesh_spec: Optional[MeshSpec], seed: int,
+                 learning_rate: float, weight_decay: float,
+                 clip_norm: float):
+        import jax
+        import optax
+
+        from ray_tpu.models import llama, llama_pipeline
+        from ray_tpu.parallel.mesh import build_mesh
+        from ray_tpu.parallel.sharding import use_mesh
+
+        self._jax = jax
+        self.stage, self.n = stage, n_stages
+        self.cfg = config
+        self.first = stage == 0
+        self.last = stage == n_stages - 1
+        self.clip_norm = clip_norm
+        self._mesh = (build_mesh(mesh_spec, jax.devices())
+                      if mesh_spec is not None else None)
+        self._use_mesh = use_mesh
+
+        # Identical init numerics to the single-process model: build the
+        # full tree from the same key, keep this stage's slice.
+        full = llama.init_params(jax.random.key(seed), config)
+        self.params = llama_pipeline.stage_slice(full, stage, n_stages)
+        del full
+        self._opt = optax.adamw(learning_rate,
+                                weight_decay=weight_decay)
+        self.opt_state = self._opt.init(self.params)
+
+        fwd = llama_pipeline.make_stage_fwd(config, self.first)
+        self._fwd = jax.jit(fwd)
+        if self.last:
+            fwd_loss = llama_pipeline.make_stage_fwd_loss(config)
+
+            def bwd_last(sl, h_in, tokens):
+                loss, vjp = jax.vjp(
+                    lambda p, h: fwd_loss(p, h, tokens), sl, h_in)
+                gp, gh = vjp(jax.numpy.ones((), jax.numpy.float32))
+                return loss, gp, gh
+
+            self._bwd = jax.jit(bwd_last)
+        elif self.first:
+            def bwd_first(sl, tokens, g):
+                _, vjp = jax.vjp(lambda p: fwd(p, tokens), sl)
+                (gp,) = vjp(g)
+                return gp
+
+            self._bwd = jax.jit(bwd_first)
+        else:
+            def bwd_mid(sl, h_in, g):
+                _, vjp = jax.vjp(fwd, sl, h_in)
+                gp, gh = vjp(g)
+                return gp, gh
+
+            self._bwd = jax.jit(bwd_mid)
+
+        self._inputs: Dict[int, Any] = {}   # mb_idx -> stage input
+        self._grad_acc: Optional[PyTree] = None
+        self._losses: List[float] = []
+        self._n_mb = 0
+
+    # ------------------------------------------------------------ helpers
+    def _run(self, fn, *args):
+        if self._mesh is not None:
+            with self._use_mesh(self._mesh):
+                return fn(*args)
+        return fn(*args)
+
+    def _acc(self, gp: PyTree):
+        jnp = self._jax.numpy
+        if self._grad_acc is None:
+            self._grad_acc = self._jax.tree.map(
+                lambda g: g.astype(jnp.float32), gp)
+        else:
+            self._grad_acc = self._jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32),
+                self._grad_acc, gp)
+        self._n_mb += 1
+
+    def _to_host(self, x):
+        return np.asarray(self._jax.device_get(x))
+
+    # ------------------------------------------------------------ schedule
+    def forward(self, mb_idx: int, inp: np.ndarray) -> np.ndarray:
+        """Stage 0..K-2 forward; keeps the input for recompute-bwd."""
+        jnp = self._jax.numpy
+        inp = jnp.asarray(inp)
+        self._inputs[mb_idx] = inp
+        return self._to_host(self._run(self._fwd, self.params, inp))
+
+    def fwd_bwd_last(self, mb_idx: int, h_in: np.ndarray,
+                     tokens: np.ndarray) -> np.ndarray:
+        """Last stage: loss forward + backward in one call (its output
+        cotangent is available immediately)."""
+        jnp = self._jax.numpy
+        loss, gp, gh = self._run(self._bwd, self.params,
+                                 jnp.asarray(h_in), jnp.asarray(tokens))
+        self._acc(gp)
+        self._losses.append(float(loss))
+        return self._to_host(gh)
+
+    def backward(self, mb_idx: int, g_out: np.ndarray) -> np.ndarray:
+        """Middle stage: recompute forward under vjp, return the input
+        cotangent for the upstream stage."""
+        jnp = self._jax.numpy
+        h_in = self._inputs.pop(mb_idx)
+        gp, gh = self._run(self._bwd, self.params, h_in,
+                           jnp.asarray(g_out))
+        self._acc(gp)
+        return self._to_host(gh)
+
+    def backward_first(self, mb_idx: int, g_out: np.ndarray) -> bool:
+        jnp = self._jax.numpy
+        tokens = self._inputs.pop(mb_idx)
+        gp = self._run(self._bwd, self.params, tokens,
+                       jnp.asarray(g_out))
+        self._acc(gp)
+        return True
+
+    # ------------------------------------------------------------ update
+    def grad_sqnorm(self) -> float:
+        """Σ g² of the microbatch-averaged grads (driver sums stages
+        into the true global norm)."""
+        jnp = self._jax.numpy
+        m = float(max(self._n_mb, 1))
+        return float(sum(
+            jnp.sum(jnp.square(g / m))
+            for g in self._jax.tree.leaves(self._grad_acc)))
+
+    def apply_update(self, global_sqnorm: float) -> Dict[str, float]:
+        jax, jnp = self._jax, self._jax.numpy
+        m = float(max(self._n_mb, 1))
+        gnorm = float(np.sqrt(global_sqnorm))
+        scale = 1.0 if gnorm <= self.clip_norm or gnorm == 0.0 \
+            else self.clip_norm / gnorm
+        grads = jax.tree.map(lambda g: (g / m) * scale, self._grad_acc)
+        updates, self.opt_state = self._opt.update(
+            grads, self.opt_state, self.params)
+        import optax
+
+        self.params = optax.apply_updates(self.params, updates)
+        out = {"grad_norm": gnorm}
+        if self._losses:
+            out["loss"] = float(np.mean(self._losses))
+        self._grad_acc = None
+        self._losses = []
+        self._n_mb = 0
+        self._inputs.clear()
+        return out
+
+
+class CrossSlicePipeline:
+    """Driver handle: K stage actors, one per slice.
+
+    ``resources_per_stage`` places stages through a placement group
+    with the given strategy (default SLICE_SPREAD — one stage per TPU
+    slice; unlabeled nodes degrade to one stage per node).  Without
+    resources the actors schedule wherever capacity exists (single-
+    process tests).
+    """
+
+    def __init__(self, config: LlamaConfig, n_stages: int,
+                 num_microbatches: int, *,
+                 mesh_spec: Optional[MeshSpec] = None,
+                 resources_per_stage: Optional[Dict[str, float]] = None,
+                 placement_strategy: str = "SLICE_SPREAD",
+                 seed: int = 0, learning_rate: float = 3e-4,
+                 weight_decay: float = 0.1, clip_norm: float = 1.0):
+        from ray_tpu.models.llama_pipeline import check_pipeline_config
+
+        check_pipeline_config(config, n_stages)
+        self.n_stages = n_stages
+        self.num_microbatches = num_microbatches
+        self._pg = None
+        opts_per_stage: List[Dict[str, Any]] = [{} for _ in range(n_stages)]
+        if resources_per_stage:
+            from ray_tpu.core.task_spec import (
+                PlacementGroupSchedulingStrategy)
+            from ray_tpu.util.placement_group import placement_group
+
+            self._pg = placement_group(
+                [dict(resources_per_stage) for _ in range(n_stages)],
+                strategy=placement_strategy)
+            self._pg.wait(timeout_seconds=60)
+            for i in range(n_stages):
+                res = dict(resources_per_stage)
+                opts_per_stage[i] = {
+                    "scheduling_strategy": PlacementGroupSchedulingStrategy(
+                        placement_group=self._pg,
+                        placement_group_bundle_index=i),
+                    "num_cpus": res.pop("CPU", None),
+                    "num_tpus": res.pop("TPU", None),
+                    "resources": res or None,
+                }
+        self.stages = [
+            _StageWorker.options(**opts_per_stage[i]).remote(
+                i, n_stages, config, mesh_spec, seed, learning_rate,
+                weight_decay, clip_norm)
+            for i in range(n_stages)]
+
+    def train_step(self, tokens: np.ndarray) -> Dict[str, float]:
+        """One GPipe step over ``tokens`` (B, S) int32.  B must divide
+        by num_microbatches."""
+        M = self.num_microbatches
+        B = tokens.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mbs = np.split(np.asarray(tokens), M, axis=0)
+
+        # All-forward: chained refs; actor FIFO pipelines the stages.
+        h = [self.stages[0].forward.remote(i, mb)
+             for i, mb in enumerate(mbs)]
+        for s in self.stages[1:-1]:
+            h = [s.forward.remote(i, r) for i, r in enumerate(h)]
+        # Last stage folds backward into forward; then all-backward
+        # in reverse microbatch order (frees newest inputs first).
+        g = [self.stages[-1].fwd_bwd_last.remote(i, r, mbs[i])
+             for i, r in enumerate(h)]
+        for s in reversed(self.stages[1:-1]):
+            g = [s.backward.remote(i, r) for i, r in enumerate(g)]
+        done = [self.stages[0].backward_first.remote(i, r)
+                for i, r in enumerate(g)]
+        ray_tpu.get(done)
+
+        sq = sum(ray_tpu.get(
+            [s.grad_sqnorm.remote() for s in self.stages]))
+        metrics = ray_tpu.get(
+            [s.apply_update.remote(sq) for s in self.stages])
+        out = dict(metrics[-1])  # last stage carries the loss
+        out["grad_norm"] = metrics[0]["grad_norm"]
+        return out
+
+    def shutdown(self):
+        for s in self.stages:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import (
+                remove_placement_group)
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+        self.stages = []

@@ -22,7 +22,11 @@ from ..core.ids import PlacementGroupID
 from ..core.runtime import get_runtime
 from ..core.task_spec import PlacementGroupSchedulingStrategy  # re-export
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                    # ICI-topology-aware (core/tpu_topology.py labels):
+                    # one gang on one slice / one pipeline stage per
+                    # slice.  head._place_pg_by_slice.
+                    "SLICE_PACK", "SLICE_SPREAD")
 
 _lock = threading.Lock()
 _groups: Dict[PlacementGroupID, "PlacementGroup"] = {}
