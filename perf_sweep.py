@@ -42,6 +42,13 @@ CASES = {
                            bf16_mu=True),
     "bf16mu-dots-b16": dict(kw={"remat_policy": "dots"}, batch=16,
                             bf16_mu=True),
+    "flash-attn-b8": dict(kw={"remat_policy": "attn"}, batch=8),
+    "flash-attn-b16": dict(kw={"remat_policy": "attn"}, batch=16),
+    "attn-unroll2-b8": dict(kw={"remat_policy": "attn",
+                                "scan_unroll": 2}, batch=8),
+    "attn-unroll4-b8": dict(kw={"remat_policy": "attn",
+                                "scan_unroll": 4}, batch=8),
+    "full-unroll2-b8": dict(kw={"scan_unroll": 2}, batch=8),
 }
 # Measured r4 (v5e): an "attn_out" save_only_these_names policy (save
 # attention outputs, remat the rest) came out SLOWER than full remat

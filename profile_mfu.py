@@ -16,21 +16,26 @@ PEAK = 197e12
 PHASES = ["fwd", "grad", "step", "attn_flash", "attn_dot", "head"]
 
 
-def timeit(fn, *args, warmup=2, steps=5):
+def _sync(out):
+    """Real sync on the axon platform = host readback of ONE element.
+    (device_get of a full array measures the ~50-100 MB/s tunnel, not
+    the kernel — that burned an afternoon.)"""
     import jax
 
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.tree.map(lambda x: x.block_until_ready(), out)
     lv = jax.tree.leaves(out)
     if lv:
-        _ = jax.device_get(lv[0])  # real sync on the axon platform
+        x = lv[0]
+        _ = jax.device_get(x[(0,) * x.ndim] if x.ndim else x)
+
+
+def timeit(fn, *args, warmup=2, steps=5):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = fn(*args)
-    lv = jax.tree.leaves(out)
-    if lv:
-        _ = jax.device_get(lv[0])
+    _sync(out)
     return (time.perf_counter() - t0) / steps
 
 

@@ -243,6 +243,7 @@ class ClusterClient:
             "num_returns": spec.num_returns,
             "name": spec.name,
             "resources": dict(spec.resources or {}),
+            "isolate": spec.isolate,
             # Big returns stay pinned on the executor under the OWNER's
             # ids (primary copies); streaming items report back here.
             "return_ids": list(spec.return_ids),
@@ -931,6 +932,7 @@ class NodeServer:
         opts = TaskOptions(num_returns=bundle["num_returns"],
                            max_retries=0, name=bundle.get("name"),
                            num_cpus=0,
+                           isolate=bundle.get("isolate", False),
                            resources=dict(bundle.get("resources") or {}))
         refs = self.runtime.submit_task(
             bundle["function"], bundle["args"], bundle["kwargs"], opts,
@@ -954,6 +956,7 @@ class NodeServer:
                 max_pending_calls=o.get("max_pending_calls", -1),
                 lifetime=o.get("lifetime"),
                 resources=o.get("resources"),
+                isolate=o.get("isolate", False),
                 _actor_id=b["actor_id"], _skip_cluster_routing=True)
             return {"ok": True}
         except Exception as e:

@@ -19,7 +19,7 @@ _ACTOR_OPTION_KEYS = {
     "name", "namespace", "lifetime", "max_restarts", "max_task_retries",
     "max_concurrency", "max_pending_calls", "num_cpus", "num_tpus",
     "num_gpus", "resources", "memory", "scheduling_strategy",
-    "get_if_exists", "runtime_env", "_metadata",
+    "get_if_exists", "runtime_env", "_metadata", "isolate",
 }
 
 
@@ -63,6 +63,7 @@ class ActorClass:
             resources=merged.get("resources"),
             scheduling_strategy=merged.get("scheduling_strategy"),
             get_if_exists=merged.get("get_if_exists", False),
+            isolate=bool(merged.get("isolate", False)),
         )
 
     def __call__(self, *args, **kwargs):

@@ -79,7 +79,23 @@ class _ActorCore:
     def create_instance(self):
         info = self.info
         try:
-            self.instance = info.klass(*info.init_args, **info.init_kwargs)
+            if info.isolate:
+                # N8: the instance lives in a dedicated subprocess; a
+                # crash there surfaces as WorkerCrashedError per call,
+                # not as this node going down.
+                if info.is_async:
+                    raise ValueError(
+                        "isolate=True does not support async actors "
+                        "(coroutines cannot cross the worker process "
+                        "boundary); use a sync actor or isolate=False")
+                from .isolated_pool import IsolatedInstance
+
+                self.instance = IsolatedInstance(
+                    self._runtime.isolated_pool, info.klass,
+                    info.init_args, info.init_kwargs)
+            else:
+                self.instance = info.klass(*info.init_args,
+                                           **info.init_kwargs)
             info.state = ActorState.ALIVE
         except BaseException as e:  # noqa: BLE001
             self._creation_error = e
@@ -198,12 +214,21 @@ class _ActorCore:
             spec, bound_instance=self.instance, actor_core=self)
 
     def _dead_error(self) -> ActorDiedError:
+        suffix = ""
+        if self._creation_error is not None:
+            suffix = f" (creation failed: {self._creation_error!r})"
         return ActorDiedError(
             self.info.actor_id,
-            f"actor {self.info.display_name()} is dead")
+            f"actor {self.info.display_name()} is dead{suffix}")
 
     # -- teardown ------------------------------------------------------------
     def stop(self):
+        inst = self.instance
+        if inst is not None and hasattr(inst, "_ray_tpu_isolated_close"):
+            try:
+                inst._ray_tpu_isolated_close()
+            except Exception:
+                pass
         with self._submit_lock:
             self._stopped.set()
             # Fail everything still queued.
@@ -225,7 +250,8 @@ class ActorInfo:
                  max_task_retries: int = 0,
                  max_concurrency: Optional[int] = None,
                  max_pending_calls: int = -1, lifetime: Optional[str] = None,
-                 resources: Optional[Dict[str, float]] = None):
+                 resources: Optional[Dict[str, float]] = None,
+                 isolate: bool = False):
         self.actor_id = actor_id
         self.klass = klass
         self.init_args = init_args
@@ -237,6 +263,7 @@ class ActorInfo:
         self.max_pending_calls = max_pending_calls
         self.lifetime = lifetime
         self.resources = resources or {}
+        self.isolate = isolate
         # Resource-accounting flags: acquire happens on a background
         # thread at creation; release must happen exactly once across
         # the kill / failed-creation / double-kill paths.

@@ -138,6 +138,22 @@ _d("object_pull_window", int, 2,
 _d("max_lineage_bytes", int, 100 * 1024 * 1024,
    "Lineage pinned for reconstruction, per owner (task_manager.h:219).")
 
+# --- isolated worker pool (N8) + memory monitor (N22) -----------------------
+_d("isolated_pool_prestart", int, 0,
+   "Worker subprocesses spawned ahead of demand "
+   "(worker_pool.h:216 prestart).")
+_d("isolated_pool_max_workers", int, 8,
+   "Max concurrent isolated worker subprocesses per node.")
+_d("isolated_pool_idle_timeout_s", float, 60.0,
+   "Idle pooled workers beyond the prestart count are reaped after "
+   "this long (worker_pool.h idle killing).")
+_d("memory_usage_threshold", float, 0.95,
+   "Node memory fraction that triggers the OOM killer on isolated "
+   "workers (ray_config_def.h memory_usage_threshold).")
+_d("memory_monitor_refresh_ms", int, 250,
+   "Memory watermark poll period; 0 disables the monitor "
+   "(memory_monitor.h:52).")
+
 # --- fault tolerance --------------------------------------------------------
 _d("health_check_period_ms", int, 1000, "GCS → node health probe period.")
 _d("health_check_failure_threshold", int, 5,

@@ -1,0 +1,331 @@
+"""Pooled worker subprocesses: opt-in process isolation (N8) + memory
+watermark OOM defense (N22).
+
+Reference: src/ray/raylet/worker_pool.h:216 (prestarted process
+workers, startup handshake, idle reaping) and
+src/ray/raylet/worker_killing_policy.h:34 (when node memory crosses the
+watermark, kill retriable tasks first, newest/largest first).
+
+Design here: the node process executes tasks inline by default (the
+TPU-native common case — everything shares one jax runtime), and
+``@ray_tpu.remote(isolate=True)`` routes a task/actor into a pooled
+subprocess so a crash (os._exit, segfault, unbounded allocation) takes
+down only that worker.  A crashed worker surfaces as
+``WorkerCrashedError`` / ``OutOfMemoryError`` — system failures, so the
+task manager's normal retry budget re-runs the task on a fresh worker.
+
+The memory monitor samples the node's available memory; past the
+watermark it SIGKILLs the isolated worker with the largest RSS whose
+task is retriable (policy above) — the node process and its actors
+keep serving.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..exceptions import OutOfMemoryError, WorkerCrashedError
+from .config import GLOBAL_CONFIG
+
+
+class _Child:
+    """One pooled subprocess (worker_pool.h PopWorker unit)."""
+
+    def __init__(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # the parent owns the TPU
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.isolated_worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=None, env=env)
+        self.lock = threading.Lock()
+        self.busy = False
+        self.retriable = True     # current task's retry eligibility
+        self.oom_killed = False
+        self.last_used = time.monotonic()
+        from .isolated_worker import read_frame
+
+        try:
+            hello = read_frame(self.proc.stdout)
+        except (EOFError, OSError) as e:
+            self.kill()
+            raise WorkerCrashedError(
+                f"isolated worker died during startup handshake") from e
+        if hello.get("ready") != self.proc.pid:
+            self.kill()
+            raise WorkerCrashedError(
+                f"isolated worker handshake failed: {hello!r}")
+
+    def request(self, payload: Dict[str, Any]) -> Any:
+        """Round-trip one op; raises WorkerCrashedError/OutOfMemoryError
+        if the child dies mid-call.  Serialized per child: concurrent
+        callers (isolated actor with max_concurrency > 1) would
+        interleave frames on the one pipe pair."""
+        from .isolated_worker import read_frame, write_frame
+
+        try:
+            with self.lock:
+                write_frame(self.proc.stdin, payload)
+                reply = read_frame(self.proc.stdout)
+        except (EOFError, OSError, BrokenPipeError) as e:
+            rc = self.proc.poll()
+            if self.oom_killed:
+                raise OutOfMemoryError(
+                    f"isolated worker pid={self.proc.pid} killed by the "
+                    f"memory monitor (node over watermark)") from e
+            raise WorkerCrashedError(
+                f"isolated worker pid={self.proc.pid} died "
+                f"(exit code {rc}) during {payload.get('op')}") from e
+        if "err" in reply:
+            raise reply["err"]
+        return reply["ok"]
+
+    def rss_bytes(self) -> int:
+        try:
+            with open(f"/proc/{self.proc.pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, IndexError, ValueError):
+            return 0
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self, oom: bool = False):
+        self.oom_killed = oom or self.oom_killed
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+
+    def shutdown(self):
+        from .isolated_worker import write_frame
+
+        try:
+            write_frame(self.proc.stdin, {"op": "exit"})
+            self.proc.wait(timeout=2)
+        except Exception:
+            self.kill()
+
+
+class IsolatedPool:
+    """Process pool for isolate=True tasks + dedicated actor workers."""
+
+    def __init__(self, node_memory_bytes: Optional[float] = None):
+        self.max_workers = GLOBAL_CONFIG.isolated_pool_max_workers()
+        self.idle_timeout_s = GLOBAL_CONFIG.isolated_pool_idle_timeout_s()
+        self._idle: List[_Child] = []
+        self._busy: List[_Child] = []
+        self._dedicated: List[_Child] = []
+        self._spawning = 0  # slots reserved by in-flight _Child() spawns
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._stopped = False
+        for _ in range(GLOBAL_CONFIG.isolated_pool_prestart()):
+            self._idle.append(_Child())
+        self._reaper = threading.Thread(target=self._reap_loop,
+                                        daemon=True,
+                                        name="isolated-pool-reaper")
+        self._reaper.start()
+        self._monitor = _MemoryMonitor(self, node_memory_bytes)
+
+    # ------------------------------------------------------------ tasks
+    def run(self, fn, args, kwargs, retriable: bool = True) -> Any:
+        """Execute ``fn`` in a pooled worker; blocks for a free slot."""
+        child = self._acquire()
+        child.retriable = retriable
+        try:
+            return child.request({"op": "task", "fn": fn,
+                                  "args": args, "kwargs": kwargs})
+        finally:
+            self._release(child)
+
+    def _acquire(self) -> _Child:
+        with self._cv:
+            while True:
+                if self._stopped:
+                    raise WorkerCrashedError("isolated pool shut down")
+                while self._idle:
+                    c = self._idle.pop()
+                    if c.alive():
+                        self._busy.append(c)
+                        c.busy = True
+                        return c
+                    c.kill()
+                if len(self._busy) + self._spawning < self.max_workers:
+                    # Reserve the slot before dropping the lock, or a
+                    # burst of acquirers all spawn past the cap.
+                    self._spawning += 1
+                    break
+                self._cv.wait(timeout=1.0)
+        try:
+            c = _Child()
+        finally:
+            with self._cv:
+                self._spawning -= 1
+                self._cv.notify_all()
+        with self._cv:
+            self._busy.append(c)
+            c.busy = True
+        return c
+
+    def _release(self, child: _Child):
+        with self._cv:
+            if child in self._busy:
+                self._busy.remove(child)
+            child.busy = False
+            child.last_used = time.monotonic()
+            if child.alive() and not self._stopped:
+                self._idle.append(child)
+            else:
+                child.kill()
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------ actors
+    def spawn_dedicated(self) -> _Child:
+        """A worker owned by one isolated actor for its lifetime (not
+        reused; dies with the actor)."""
+        c = _Child()
+        with self._lock:
+            self._dedicated.append(c)
+        return c
+
+    def drop_dedicated(self, child: _Child):
+        with self._lock:
+            if child in self._dedicated:
+                self._dedicated.remove(child)
+        child.shutdown()
+
+    # ------------------------------------------------------------ monitor
+    def _oom_candidates(self) -> List[_Child]:
+        """Busy isolated workers, retriable-first then largest-RSS —
+        worker_killing_policy.h ordering."""
+        with self._lock:
+            busy = list(self._busy) + [c for c in self._dedicated
+                                       if c.alive()]
+        return sorted(busy, key=lambda c: (not c.retriable,
+                                           -c.rss_bytes()))
+
+    def _reap_loop(self):
+        prestart = GLOBAL_CONFIG.isolated_pool_prestart()
+        while not self._stopped:
+            time.sleep(1.0)
+            now = time.monotonic()
+            with self._lock:
+                keep, reap = [], []
+                for c in self._idle:
+                    if (len(self._idle) - len(reap) > prestart
+                            and now - c.last_used > self.idle_timeout_s):
+                        reap.append(c)
+                    else:
+                        keep.append(c)
+                self._idle = keep
+            for c in reap:
+                c.shutdown()
+
+    def shutdown(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._monitor.stop()
+        with self._lock:
+            everyone = self._idle + self._busy + self._dedicated
+            self._idle, self._busy, self._dedicated = [], [], []
+        for c in everyone:
+            c.kill()
+
+
+class IsolatedInstance:
+    """Actor instance living in a dedicated worker subprocess; method
+    lookups forward over the pipe.  Duck-types the real instance for
+    ActorCore (``getattr(instance, method)(*args)``)."""
+
+    def __init__(self, pool: IsolatedPool, klass: type, args, kwargs):
+        self._pool = pool
+        self._child = pool.spawn_dedicated()
+        # Actors rank AFTER retriable tasks in the OOM-kill order —
+        # losing actor state is worse than re-running a task
+        # (worker_killing_policy.h: retriable first).
+        self._child.retriable = False
+        self._child.busy = True
+        self._klass_name = klass.__name__
+        try:
+            self._child.request({"op": "init", "cls": klass,
+                                 "args": args, "kwargs": kwargs})
+        except BaseException:
+            # Failed creation must not leak the live subprocess (a
+            # restarting actor would leak one per attempt).
+            pool.drop_dedicated(self._child)
+            raise
+
+    def __getattr__(self, name: str):
+        # Dunder lookups (pickling, repr machinery) must fail fast;
+        # single-underscore user methods forward like any other.
+        if name.startswith("__"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            return self._child.request({"op": "call", "method": name,
+                                        "args": args, "kwargs": kwargs})
+
+        call.__name__ = name
+        return call
+
+    def _ray_tpu_isolated_close(self):
+        self._pool.drop_dedicated(self._child)
+
+
+class _MemoryMonitor:
+    """Node watermark killer (memory_monitor.h:52 +
+    worker_killing_policy.h:34): above the watermark, kill the best
+    OOM candidate; isolated workers only — the node process itself is
+    never touched."""
+
+    def __init__(self, pool: IsolatedPool,
+                 node_memory_bytes: Optional[float] = None):
+        self.pool = pool
+        self.watermark = GLOBAL_CONFIG.memory_usage_threshold()
+        self.total = float(node_memory_bytes or _meminfo("MemTotal"))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="isolated-oom-monitor")
+        self._thread.start()
+
+    def _loop(self):
+        interval = GLOBAL_CONFIG.memory_monitor_refresh_ms() / 1000.0
+        if interval <= 0:
+            return
+        while not self._stop.wait(interval):
+            try:
+                used_frac = self._used_fraction()
+                if used_frac < self.watermark:
+                    continue
+                for child in self.pool._oom_candidates():
+                    child.kill(oom=True)
+                    break
+            except Exception:
+                pass
+
+    def _used_fraction(self) -> float:
+        avail = _meminfo("MemAvailable")
+        if not avail or not self.total:
+            return 0.0
+        return 1.0 - avail / self.total
+
+    def stop(self):
+        self._stop.set()
+
+
+def _meminfo(key: str) -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith(key + ":"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0

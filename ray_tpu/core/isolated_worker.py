@@ -1,0 +1,100 @@
+"""Child entry for pooled isolated workers (core/isolated_pool.py).
+
+One OS process per worker: a segfaulting C extension, an OOMing task,
+or a GIL-hogging loop dies HERE, not in the node process (reference:
+every Ray worker is a process — src/ray/raylet/worker_pool.h:216; this
+build makes isolation opt-in since the common case shares the node's
+jax runtime).
+
+Protocol over stdin/stdout pipes, 4-byte big-endian length framing,
+payloads via cluster.serialization (array/bf16-aware two-pickle):
+
+  child -> parent  {"ready": pid}                       (startup handshake)
+  parent -> child  {"op": "task", "fn", "args", "kwargs"}
+                   {"op": "init", "cls", "args", "kwargs"}
+                   {"op": "call", "method", "args", "kwargs"}
+                   {"op": "exit"}
+  child -> parent  {"ok": value} | {"err": exception}
+
+The child NEVER touches the TPU: JAX_PLATFORMS is forced to cpu before
+any user code runs (the parent process owns the chip; a second process
+attaching would wedge the runtime).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+
+
+def _read_exact(stream, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            raise EOFError("parent closed the pipe")
+        buf += chunk
+    return buf
+
+
+def read_frame(stream):
+    from ray_tpu.cluster.serialization import loads
+
+    (n,) = struct.unpack(">I", _read_exact(stream, 4))
+    return loads(_read_exact(stream, n))
+
+
+def write_frame(stream, payload) -> None:
+    from ray_tpu.cluster.serialization import dumps
+
+    data = dumps(payload)
+    stream.write(struct.pack(">I", len(data)) + data)
+    stream.flush()
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    inp = sys.stdin.buffer
+    # Reserve fd 1 for the protocol; user prints go to stderr so they
+    # cannot corrupt framing.
+    out = os.fdopen(os.dup(1), "wb")
+    sys.stdout = sys.stderr
+
+    write_frame(out, {"ready": os.getpid()})
+    instance = None
+    while True:
+        try:
+            msg = read_frame(inp)
+        except EOFError:
+            return
+        op = msg.get("op")
+        if op == "exit":
+            return
+        try:
+            if op == "task":
+                result = msg["fn"](*msg["args"], **msg["kwargs"])
+            elif op == "init":
+                instance = msg["cls"](*msg["args"], **msg["kwargs"])
+                result = None
+            elif op == "call":
+                result = getattr(instance, msg["method"])(
+                    *msg["args"], **msg["kwargs"])
+            else:
+                raise ValueError(f"unknown op {op!r}")
+            reply = {"ok": result}
+        except BaseException as e:  # noqa: BLE001
+            reply = {"err": e}
+        try:
+            write_frame(out, reply)
+        except Exception:
+            # Unpicklable result/exception: degrade to a repr error.
+            bad = reply["ok"] if "ok" in reply else reply["err"]
+            write_frame(out, {"err": RuntimeError(
+                f"isolated worker result not serializable: "
+                f"{type(bad).__name__}")})
+
+
+if __name__ == "__main__":
+    main()

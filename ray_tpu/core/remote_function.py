@@ -19,6 +19,7 @@ _OPTION_KEYS = {
     "num_returns", "num_cpus", "num_tpus", "num_gpus", "resources",
     "max_retries", "retry_exceptions", "scheduling_strategy", "name",
     "runtime_env", "memory", "_metadata", "concurrency_group",
+    "isolate",
 }
 
 
@@ -47,6 +48,7 @@ def _build_options(defaults: Dict[str, Any],
         scheduling_strategy=merged.get("scheduling_strategy"),
         name=merged.get("name", ""),
         runtime_env=merged.get("runtime_env"),
+        isolate=bool(merged.get("isolate", False)),
         _metadata=merged.get("_metadata") or {},
     )
 

@@ -94,6 +94,20 @@ class Runtime:
         # Cluster attachment (ray_tpu.cluster.client.ClusterClient);
         # None = single-process mode.
         self.cluster = None
+        # Isolated worker pool (N8): created on first isolate=True use.
+        self._isolated_pool = None
+        self._isolated_pool_lock = threading.Lock()
+
+    @property
+    def isolated_pool(self):
+        if self._isolated_pool is None:
+            from .isolated_pool import IsolatedPool
+
+            with self._isolated_pool_lock:
+                if self._isolated_pool is None:
+                    self._isolated_pool = IsolatedPool(
+                        self.node_resources.total.get("memory"))
+        return self._isolated_pool
 
     @property
     def address(self) -> str:
@@ -363,6 +377,7 @@ class Runtime:
             scheduling_strategy=normalize_strategy(
                 options.scheduling_strategy),
             name=options.name,
+            isolate=options.isolate,
             parent_task_id=parent,
             return_ids=return_ids,
         )
@@ -572,7 +587,17 @@ class Runtime:
         outcome = "ok"
         try:
             fn = self._lookup_callable(spec, bound_instance)
-            result = fn(*args, **kwargs)
+            if spec.isolate and not spec.is_actor_task:
+                if spec.num_returns == STREAMING:
+                    raise ValueError(
+                        "isolate=True does not support streaming "
+                        "generators (results cross a process boundary "
+                        "as one reply)")
+                result = self.isolated_pool.run(
+                    fn, args, kwargs,
+                    retriable=spec.attempt_number < spec.max_retries)
+            else:
+                result = fn(*args, **kwargs)
             if spec.num_returns == STREAMING:
                 self._consume_stream(spec, result)
             else:
@@ -717,6 +742,7 @@ class Runtime:
                      resources: Optional[Dict[str, float]] = None,
                      scheduling_strategy=None,
                      get_if_exists: bool = False,
+                     isolate: bool = False,
                      _actor_id: Optional[ActorID] = None,
                      _skip_cluster_routing: bool = False):
         from .actor import ActorHandle
@@ -761,6 +787,7 @@ class Runtime:
                         "max_pending_calls": max_pending_calls,
                         "lifetime": lifetime,
                         "resources": demand,
+                        "isolate": isolate,
                     }, demand)
                 return ActorHandle(actor_id, klass, self)
             raise ValueError(
@@ -772,7 +799,7 @@ class Runtime:
             max_restarts=max_restarts, max_task_retries=max_task_retries,
             max_concurrency=max_concurrency,
             max_pending_calls=max_pending_calls, lifetime=lifetime,
-            resources=demand)
+            resources=demand, isolate=isolate)
         core = self.actor_manager.create(info)
         if self.cluster is not None and not _skip_cluster_routing:
             # Publish EVERY actor cluster-wide (reference: GCS actor
@@ -802,6 +829,7 @@ class Runtime:
                         "max_pending_calls": max_pending_calls,
                         "lifetime": lifetime,
                         "resources": demand,
+                        "isolate": isolate,
                     },
                 }),
             })
@@ -1045,6 +1073,9 @@ class Runtime:
             self.cluster = None
         self.actor_manager.shutdown()
         self.scheduler.shutdown()
+        if self._isolated_pool is not None:
+            self._isolated_pool.shutdown()
+            self._isolated_pool = None
         self.plasma.destroy()
 
 

@@ -106,6 +106,9 @@ class TaskOptions:
     scheduling_strategy: SchedulingStrategy = None
     name: str = ""
     runtime_env: Optional[dict] = None
+    # Run in a pooled worker subprocess (N8 process isolation) instead
+    # of inline in the node process.
+    isolate: bool = False
     _metadata: Dict[str, Any] = field(default_factory=dict)
 
     def resource_demand(self, default_cpus: float = 1.0) -> Dict[str, float]:
@@ -137,6 +140,8 @@ class TaskSpec:
     is_actor_creation: bool = False
     is_actor_task: bool = False
     concurrency_group: str = ""
+    # Process isolation (N8): execute in a pooled subprocess.
+    isolate: bool = False
     # Ownership / lineage
     parent_task_id: Optional[TaskID] = None
     attempt_number: int = 0
@@ -162,15 +167,22 @@ class TaskSpec:
         # (reference: max_retries counts system failures by default;
         # retry_exceptions=True/[...] opts user exceptions in).
         from ..exceptions import (ActorDiedError, NodeDiedError,
-                                  OutOfMemoryError, TaskError)
+                                  OutOfMemoryError, TaskError,
+                                  WorkerCrashedError)
 
+        # Unwrap TaskError: execute_task_inline wraps in-task raises,
+        # so a WorkerCrashedError from the isolated pool arrives as
+        # TaskError(cause=WorkerCrashedError).
+        unwrapped = error.cause if isinstance(error, TaskError) else error
         system_failure = isinstance(
-            error, (NodeDiedError, OutOfMemoryError)) or (
+            unwrapped, (NodeDiedError, OutOfMemoryError,
+                        WorkerCrashedError)) or (
             # An actor dying with its node is a system failure for the
             # CALL; the budget (max_retries = the actor's
             # max_task_retries) gates how many such deaths a call may
             # survive (reference: actor_task_submitter.h:75).
-            self.is_actor_task and isinstance(error, ActorDiedError))
+            self.is_actor_task and isinstance(
+                unwrapped, ActorDiedError))
         if system_failure:
             return True
         if self.retry_exceptions is True:

@@ -128,3 +128,9 @@ class NodeDiedError(RayTpuError):
 
 class OutOfMemoryError(RayTpuError):
     """Worker killed by the memory monitor (reference: OOM killer, N22)."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """An isolated worker subprocess died mid-task (segfault, os._exit,
+    external kill).  A system failure: retried within max_retries
+    (reference: worker process death → task retry)."""

@@ -57,12 +57,19 @@ class LlamaConfig:
     # Rematerialization policy for the per-layer checkpoint wrapper:
     # "full" recomputes everything in backward (min memory, ~2N extra
     # flops/token); "dots" saves matmul/einsum outputs with no batch
-    # dims (XLA's dots_with_no_batch_dims_saveable — keeps the MXU work
-    # un-recomputed, recomputes only cheap elementwise); ignored when
-    # remat=False.
+    # dims (XLA's dots_with_no_batch_dims_saveable — but it saves the
+    # F32 dot results, ~830 MB/layer at bench shapes: OOM on one v5e);
+    # "attn" saves only the flash kernel's residuals (q/k/v/o bf16 +
+    # width-1 lse, ~129 MB/layer) so backward skips re-running the
+    # attention forward while still rematerializing the FFN — the best
+    # measured time/memory point on v5e; ignored when remat=False.
     remat_policy: str = "full"
     # Tie input embedding and LM head (small models).
     tie_embeddings: bool = False
+    # lax.scan unroll factor for the layer stack: >1 lets XLA fuse
+    # across adjacent layers (fewer loop-carried DUS/sequencing
+    # overheads) at the cost of compile time.
+    scan_unroll: int = 1
     # >0 enables REAL pipeline parallelism when the active mesh has a
     # pipe axis of size >1: the layer stack runs as a GPipe microbatch
     # schedule over pipe stages (parallel/pipeline.py) instead of one
@@ -261,6 +268,21 @@ def param_count(params: PyTree) -> int:
 # ---------------------------------------------------------------------------
 # Building blocks
 # ---------------------------------------------------------------------------
+
+def _remat_policy(config: LlamaConfig):
+    """Checkpoint policy for the per-layer remat wrapper (see
+    LlamaConfig.remat_policy)."""
+    if config.remat_policy == "attn":
+        from ray_tpu.ops.flash_attention import FLASH_RESIDUAL_NAMES
+
+        return jax.checkpoint_policies.save_only_these_names(
+            *FLASH_RESIDUAL_NAMES)
+    return {
+        "full": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    }[config.remat_policy]
+
 
 def matmul(x: jax.Array, w: jax.Array, out_dtype: Any = None) -> jax.Array:
     """bf16×bf16 matmul with float32 MXU accumulation.
@@ -468,14 +490,7 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
             sin=sin, cos=cos, positions=positions, config=c,
             attention_fn=attention_fn)
         if c.remat:
-            policies = {
-                "full": jax.checkpoint_policies.nothing_saveable,
-                "dots":
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                "dots_saveable": jax.checkpoint_policies.dots_saveable,
-            }
-            block = jax.checkpoint(block,
-                                   policy=policies[c.remat_policy])
+            block = jax.checkpoint(block, policy=_remat_policy(c))
         return block
 
     from ray_tpu.parallel.sharding import current_mesh
@@ -520,12 +535,14 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
                 return (h, aux + aux_l), None
 
             (x, aux_total), _ = jax.lax.scan(
-                scan_body, (x, aux_total), params["layers"])
+                scan_body, (x, aux_total), params["layers"],
+                unroll=c.scan_unroll)
         else:
             def scan_body(carry, layer_params):
                 return block(carry, layer_params), None
 
-            x, _ = jax.lax.scan(scan_body, x, params["layers"])
+            x, _ = jax.lax.scan(scan_body, x, params["layers"],
+                                unroll=c.scan_unroll)
 
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     if c.tie_embeddings:

@@ -86,12 +86,9 @@ def _run_layers(x: jax.Array, layers: PyTree, config: LlamaConfig):
         decoder_layer, sin=sin, cos=cos, positions=positions, config=c,
         attention_fn=_get_attention_fn(c.attention_impl))
     if c.remat:
-        policies = {
-            "full": jax.checkpoint_policies.nothing_saveable,
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "dots_saveable": jax.checkpoint_policies.dots_saveable,
-        }
-        block = jax.checkpoint(block, policy=policies[c.remat_policy])
+        from .llama import _remat_policy
+
+        block = jax.checkpoint(block, policy=_remat_policy(c))
 
     def body(h, layer):
         return block(h, layer), None

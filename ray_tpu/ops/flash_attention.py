@@ -143,15 +143,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # merging ignores them.
         l_safe = jnp.where(l > 0.0, l, 1.0)
         o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse = jnp.where(l_scr[:] > 0.0,
-                        m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-37)),
+        # lse output is width-1 (not lane-replicated): a (B,H,S,LANES)
+        # f32 lse is 134 MB/layer of pure HBM traffic at bench shapes.
+        lse = jnp.where(l > 0.0,
+                        m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-37)),
                         NEG_INF)
         lse_ref[0, 0, :, :] = lse
 
 
 def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
     """q: (B, Hq, Sq, D) pre-scaled; k/v: (B, Hkv, Sk, D).
-    Returns o (B, Hq, Sq, D), lse (B, Hq, Sq, LANES) f32."""
+    Returns o (B, Hq, Sq, D), lse (B, Hq, Sq, 1) f32."""
     B, Hq, Sq, D = q.shape
     _, Hkv, Sk, _ = k.shape
     group = Hq // Hkv
@@ -184,11 +186,11 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), o_map),
-            pl.BlockSpec((1, 1, bq, LANES), o_map),
+            pl.BlockSpec((1, 1, bq, 1), o_map),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, Hq, Sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, Sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, LANES), jnp.float32),
@@ -318,8 +320,7 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
     nq, nk = Sq // bq, Sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)  # (B, Hq, Sq)
-    delta = jnp.broadcast_to(delta[..., None], (B, Hq, Sq, LANES))
+                    axis=-1, keepdims=True)  # (B, Hq, Sq, 1)
 
     def q_map(b, h, qi, ki):
         return (b, h, qi, 0)
@@ -338,8 +339,8 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bk, D), k_map_q),
             pl.BlockSpec((1, 1, bk, D), k_map_q),
             pl.BlockSpec((1, 1, bq, D), q_map),
-            pl.BlockSpec((1, 1, bq, LANES), q_map),
-            pl.BlockSpec((1, 1, bq, LANES), q_map),
+            pl.BlockSpec((1, 1, bq, 1), q_map),
+            pl.BlockSpec((1, 1, bq, 1), q_map),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), q_map),
         out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), jnp.float32),
@@ -369,8 +370,8 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bk, D), kv_map),
             pl.BlockSpec((1, 1, bk, D), kv_map),
             pl.BlockSpec((1, 1, bq, D), q_map_kv),
-            pl.BlockSpec((1, 1, bq, LANES), q_map_kv),
-            pl.BlockSpec((1, 1, bq, LANES), q_map_kv),
+            pl.BlockSpec((1, 1, bq, 1), q_map_kv),
+            pl.BlockSpec((1, 1, bq, 1), q_map_kv),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), kv_map),
@@ -396,51 +397,88 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
 # Public API (custom VJP)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
-    o, _ = _flash_fwd_res(q, k, v, causal, block_q, block_k)
+# The primal runs the pallas forward OUTSIDE the custom_vjp (under
+# stop_gradient so AD never tries to transpose the kernel) and feeds
+# (qt, kt, vt, o, lse) into ``_flash_core``, an identity-on-o
+# custom_vjp whose backward runs the dq/dkdv kernels.  This makes
+# every backward residual a NAMED value in the primal graph
+# (checkpoint_name), so a remat policy can SAVE attention residuals —
+# ``save_only_these_names(*FLASH_RESIDUAL_NAMES)`` skips re-running the
+# attention forward in the backward pass entirely (llama remat_policy
+# "attn"), for ~129 MB/layer at bench shapes.
+
+FLASH_RESIDUAL_NAMES = ("flash_q", "flash_k", "flash_v", "flash_o",
+                        "flash_lse")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_core(qt, kt, vt, o, lse, causal, block_q, block_k):
     return o
 
 
-def _flash_fwd_res(q, k, v, causal, block_q, block_k):
-    B, S, Hq, D = q.shape
-    scale = D ** -0.5
-    qt = jnp.transpose(q, (0, 2, 1, 3)) * jnp.asarray(scale, q.dtype)
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    o, lse = _fwd(qt, kt, vt, causal=causal, block_q=block_q,
-                  block_k=block_k, interpret=_use_interpret())
-    out = jnp.transpose(o, (0, 2, 1, 3))
-    return out, (qt, kt, vt, o, lse)
+def _flash_core_fwd(qt, kt, vt, o, lse, causal, block_q, block_k):
+    return o, (qt, kt, vt, o, lse)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
-    out, res = _flash_fwd_res(q, k, v, causal, block_q, block_k)
-    return out, res
-
-
-def _flash_bwd(causal, block_q, block_k, res, g):
+def _flash_core_bwd(causal, block_q, block_k, res, g):
     qt, kt, vt, o, lse = res
     B, Hq, Sq, D = qt.shape
     Hkv = kt.shape[1]
     group = Hq // Hkv
-    scale = D ** -0.5
-    do = jnp.transpose(g, (0, 2, 1, 3))
+    do = g  # already (B, Hq, Sq, D)
     k_full = jnp.repeat(kt, group, axis=1)
     v_full = jnp.repeat(vt, group, axis=1)
     dq, dk, dv = _bwd_impl(qt, k_full, v_full, o, lse, do,
                            causal=causal, block_q=block_q,
                            block_k=block_k, interpret=_use_interpret())
-    dq = dq * scale  # qt was pre-scaled; undo for d(original q)
+    # dq is returned w.r.t. the PRE-SCALED qt: the outer qt = q * scale
+    # chain applies the scale factor during transposition (the old
+    # whole-function custom_vjp had to undo it by hand).
     dk = dk.reshape(B, Hkv, group, -1, D).sum(axis=2)
     dv = dv.reshape(B, Hkv, group, -1, D).sum(axis=2)
-    dq = jnp.transpose(dq, (0, 2, 1, 3)).astype(qt.dtype)
-    dk = jnp.transpose(dk, (0, 2, 1, 3)).astype(kt.dtype)
-    dv = jnp.transpose(dv, (0, 2, 1, 3)).astype(vt.dtype)
-    return dq, dk, dv
+    # o and lse are functions of q/k/v computed under stop_gradient in
+    # the primal; their cotangents are structurally zero.
+    return (dq.astype(qt.dtype), dk.astype(kt.dtype),
+            dv.astype(vt.dtype), jnp.zeros_like(o),
+            jnp.zeros_like(lse))
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _named_packed(x, name):
+    """checkpoint_name with a lane-friendly storage layout: head_dim is
+    usually 64/outputs (B,H,S,D) — the TPU (8,128) tile pads D<128 to
+    128 lanes, DOUBLING the saved residual's HBM cost.  Regroup rows so
+    the stored value's last dim is 128 (a contiguous row-major reshape);
+    consumers recompute the cheap un-reshape from the saved value."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    D = x.shape[-1]
+    if D < LANES and LANES % D == 0 and x.shape[-2] % (LANES // D) == 0:
+        g = LANES // D
+        shp = (*x.shape[:-2], x.shape[-2] // g, LANES)
+        return checkpoint_name(x.reshape(shp), name).reshape(x.shape)
+    return checkpoint_name(x, name)
+
+
+def _flash(q, k, v, causal, block_q, block_k):
+    B, S, Hq, D = q.shape
+    scale = D ** -0.5
+    qt = jnp.transpose(q, (0, 2, 1, 3)) * jnp.asarray(scale, q.dtype)
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    o, lse = _fwd(jax.lax.stop_gradient(qt), jax.lax.stop_gradient(kt),
+                  jax.lax.stop_gradient(vt), causal=causal,
+                  block_q=block_q, block_k=block_k,
+                  interpret=_use_interpret())
+    qt = _named_packed(qt, "flash_q")
+    kt = _named_packed(kt, "flash_k")
+    vt = _named_packed(vt, "flash_v")
+    o = _named_packed(o, "flash_o")
+    lse = _named_packed(lse, "flash_lse")
+    out = _flash_core(qt, kt, vt, o, lse, causal, block_q, block_k)
+    return jnp.transpose(out, (0, 2, 1, 3))
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

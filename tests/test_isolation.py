@@ -1,0 +1,181 @@
+"""Process isolation (N8) + OOM defense (N22).
+
+Reference: src/ray/raylet/worker_pool.h:216 (pooled process workers)
+and worker_killing_policy.h:34 (watermark kill, retriable first).
+An ``isolate=True`` task/actor runs in a pooled subprocess: crashes
+(os._exit, unbounded allocation) kill the worker, NOT the node — the
+node keeps serving its other actors, and the crashed ref resolves to a
+retried result or a clean system error.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (OutOfMemoryError, TaskError,
+                                WorkerCrashedError)
+
+
+def _cause(err):
+    return err.cause if isinstance(err, TaskError) else err
+
+
+class TestIsolatedTasks:
+    def test_runs_and_returns(self, ray_start_regular):
+        @ray_tpu.remote(isolate=True)
+        def child_pid():
+            return os.getpid()
+
+        pid = ray_tpu.get(child_pid.remote(), timeout=60)
+        assert pid != os.getpid()  # really a subprocess
+
+    def test_crash_retries_to_success(self, ray_start_regular, tmp_path):
+        flag = str(tmp_path / "crashed_once")
+
+        @ray_tpu.remote(isolate=True, max_retries=2)
+        def crash_once(flag):
+            if not os.path.exists(flag):
+                open(flag, "w").close()
+                os._exit(1)  # hard death: no exception, no cleanup
+            return 42
+
+        assert ray_tpu.get(crash_once.remote(flag), timeout=120) == 42
+
+    def test_crash_exhausts_retries_to_clean_error(self,
+                                                   ray_start_regular):
+        @ray_tpu.remote(isolate=True, max_retries=1)
+        def always_crash():
+            os._exit(1)
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(always_crash.remote(), timeout=120)
+        assert isinstance(_cause(ei.value), WorkerCrashedError)
+
+    def test_node_keeps_serving_through_crashes(self, ray_start_regular):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        @ray_tpu.remote(isolate=True, max_retries=0)
+        def crash():
+            os._exit(1)
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=30) == 1
+        refs = [crash.remote() for _ in range(3)]
+        for r in refs:
+            with pytest.raises(Exception):
+                ray_tpu.get(r, timeout=60)
+        # The in-process actor survived every subprocess death.
+        assert ray_tpu.get(c.incr.remote(), timeout=30) == 2
+
+    def test_user_exception_propagates_not_retried(self,
+                                                   ray_start_regular):
+        @ray_tpu.remote(isolate=True)
+        def boom():
+            raise ValueError("user error")
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(boom.remote(), timeout=60)
+        assert isinstance(_cause(ei.value), ValueError)
+
+
+class TestIsolatedActors:
+    def test_state_lives_in_subprocess(self, ray_start_regular):
+        @ray_tpu.remote(isolate=True)
+        class Acc:
+            def __init__(self, start):
+                self.total = start
+
+            def add(self, x):
+                self.total += x
+                return self.total
+
+            def pid(self):
+                return os.getpid()
+
+        a = Acc.remote(10)
+        assert ray_tpu.get(a.add.remote(5), timeout=60) == 15
+        assert ray_tpu.get(a.add.remote(1), timeout=30) == 16
+        assert ray_tpu.get(a.pid.remote(), timeout=30) != os.getpid()
+        ray_tpu.kill(a)
+
+    def test_actor_crash_is_clean_error_and_node_survives(
+            self, ray_start_regular):
+        @ray_tpu.remote(isolate=True)
+        class Bomb:
+            def ping(self):
+                return "pong"
+
+            def explode(self):
+                os._exit(1)
+
+        @ray_tpu.remote
+        class Healthy:
+            def ok(self):
+                return True
+
+        b = Bomb.remote()
+        h = Healthy.remote()
+        assert ray_tpu.get(b.ping.remote(), timeout=60) == "pong"
+        with pytest.raises(Exception):
+            ray_tpu.get(b.explode.remote(), timeout=60)
+        # Subsequent calls fail fast (worker gone) ...
+        with pytest.raises(Exception):
+            ray_tpu.get(b.ping.remote(), timeout=60)
+        # ... and the rest of the node is untouched.
+        assert ray_tpu.get(h.ok.remote(), timeout=30) is True
+
+
+class TestOomPolicy:
+    def test_watermark_kills_and_surfaces_oom(self, ray_start_regular,
+                                              monkeypatch):
+        from ray_tpu.core import isolated_pool as ip
+
+        # Force "over watermark" without actually exhausting the box.
+        monkeypatch.setattr(ip._MemoryMonitor, "_used_fraction",
+                            lambda self: 1.0)
+
+        @ray_tpu.remote(isolate=True, max_retries=0)
+        def hog():
+            time.sleep(300)  # killed long before this returns
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(hog.remote(), timeout=120)
+        assert isinstance(_cause(ei.value), OutOfMemoryError)
+
+    def test_kill_order_retriable_tasks_before_actors(self):
+        from ray_tpu.core.isolated_pool import IsolatedPool
+
+        pool = IsolatedPool.__new__(IsolatedPool)
+
+        class FakeChild:
+            def __init__(self, retriable, rss, alive=True):
+                self.retriable = retriable
+                self._rss = rss
+                self._alive = alive
+
+            def rss_bytes(self):
+                return self._rss
+
+            def alive(self):
+                return self._alive
+
+        import threading
+
+        pool._lock = threading.Lock()
+        task_small = FakeChild(True, 100)
+        task_big = FakeChild(True, 1000)
+        actor = FakeChild(False, 10_000)
+        pool._busy = [task_small, task_big]
+        pool._dedicated = [actor]
+        order = pool._oom_candidates()
+        # Retriable tasks first (largest RSS first), actors last.
+        assert order == [task_big, task_small, actor]
