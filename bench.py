@@ -122,6 +122,40 @@ def _object_plane_bench(size_bytes: int) -> dict:
         c.shutdown()
 
 
+def _broadcast_bench(size_bytes: int, n_nodes: int = 3) -> dict:
+    """Push-based broadcast tree (push_manager.h:30 analogue): driver
+    fans one object out to ``n_nodes`` workers; aggregate GB/s =
+    size * n / wall.  Loopback TCP bounds the absolute number."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.util import broadcast
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    for i in range(n_nodes):
+        c.add_node(num_cpus=1, name=f"b{i}")
+    c.connect(num_cpus=1)
+    try:
+        rng = np.random.default_rng(0)
+        ref = ray_tpu.put(rng.integers(0, 255, size_bytes,
+                                       dtype=np.uint8))
+        t0 = time.perf_counter()
+        n = broadcast(ref)
+        dt = time.perf_counter() - t0
+        assert n == n_nodes, f"broadcast reached {n}/{n_nodes}"
+        return {
+            "broadcast_gbytes_per_s": round(
+                size_bytes * n_nodes / dt / 1e9, 2),
+            "broadcast_nodes": n_nodes,
+            "broadcast_mb": size_bytes // (1024 * 1024),
+        }
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -132,12 +166,12 @@ def main():
     on_tpu = platform not in ("cpu",)
 
     if on_tpu:
-        # 440M-param Llama with the Pallas flash-attention kernel —
-        # the largest config that trains with f32 adam state in 16 GB
-        # HBM (measured); bigger hidden → better MXU utilization than
-        # the 125M preset.  batch 8 beats 16 on v5e (31.4% vs 29.7%
-        # MFU measured): smaller per-layer activation working set under
-        # full remat, same MXU tiling at 16k rows.
+        # 440M-param Llama, Pallas flash attention, head_dim 128 (full
+        # MXU depth + exact (8,128) tiling — see llama_440m docstring),
+        # remat_policy="attn" (backward reuses saved attention
+        # residuals).  batch 8: 12/16 OOM with the saved residuals on
+        # 16 GB HBM (measured r5: 32.7k tok/s @ 43.4% MFU; r4 was
+        # 23.7k @ 31.5%).
         cfg = llama.LlamaConfig.llama_440m()
         batch, seq, steps, warmup = 8, 2048, 10, 3
     else:
@@ -217,6 +251,13 @@ def main():
             1024 * 1024 * 1024 if on_tpu else 64 * 1024 * 1024))
     except Exception as e:  # noqa: BLE001
         extra["object_pull_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: broadcast phase start", file=sys.stderr, flush=True)
+    try:
+        extra.update(_broadcast_bench(
+            256 * 1024 * 1024 if on_tpu else 32 * 1024 * 1024))
+    except Exception as e:  # noqa: BLE001
+        extra["broadcast_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

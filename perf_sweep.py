@@ -44,6 +44,24 @@ CASES = {
                             bf16_mu=True),
     "flash-attn-b8": dict(kw={"remat_policy": "attn"}, batch=8),
     "flash-attn-b16": dict(kw={"remat_policy": "attn"}, batch=16),
+    "flash-attn-b12": dict(kw={"remat_policy": "attn"}, batch=12),
+    # head_dim 128 = full MXU systolic depth in the attention kernels
+    # (same param count: 8 heads x 128 vs 16 x 64).
+    "attn-hd128-b8": dict(kw={"remat_policy": "attn", "n_heads": 8,
+                              "n_kv_heads": 8, "head_dim": 128},
+                          batch=8),
+    "full-hd128-b8": dict(kw={"n_heads": 8, "n_kv_heads": 8,
+                              "head_dim": 128}, batch=8),
+    "attn-hd128-b12": dict(kw={"remat_policy": "attn", "n_heads": 8,
+                               "n_kv_heads": 8, "head_dim": 128},
+                           batch=12),
+    "attn-hd128-b16": dict(kw={"remat_policy": "attn", "n_heads": 8,
+                               "n_kv_heads": 8, "head_dim": 128},
+                           batch=16),
+    "bf16mu-attn-hd128-b16": dict(kw={"remat_policy": "attn",
+                                      "n_heads": 8, "n_kv_heads": 8,
+                                      "head_dim": 128}, batch=16,
+                                  bf16_mu=True),
     "attn-unroll2-b8": dict(kw={"remat_policy": "attn",
                                 "scan_unroll": 2}, batch=8),
     "attn-unroll4-b8": dict(kw={"remat_policy": "attn",

@@ -191,10 +191,11 @@ def wire_size(meta: dict) -> int:
     return meta["payload"] + sum(e[3] for e in meta["externs"])
 
 
-def read_layout_chunk(bufs: List[memoryview], offset: int, length: int):
-    """Read ``length`` bytes at ``offset`` of the virtual concatenation.
-    A chunk that falls inside one buffer is returned as a zero-copy
-    memoryview (the RPC layer sends bytes-like payloads raw)."""
+def read_layout_pieces(bufs: List[memoryview], offset: int,
+                       length: int) -> List[memoryview]:
+    """Zero-copy memoryview pieces covering [offset, offset+length) of
+    the virtual concatenation (the raw object stream sendmsg's them
+    directly from the live buffers)."""
     pieces = []
     taken = 0
     for b in bufs:
@@ -208,6 +209,14 @@ def read_layout_chunk(bufs: List[memoryview], offset: int, length: int):
         offset = 0
         if taken >= length:
             break
+    return pieces
+
+
+def read_layout_chunk(bufs: List[memoryview], offset: int, length: int):
+    """Read ``length`` bytes at ``offset`` of the virtual concatenation.
+    A chunk that falls inside one buffer is returned as a zero-copy
+    memoryview (the RPC layer sends bytes-like payloads raw)."""
+    pieces = read_layout_pieces(bufs, offset, length)
     if len(pieces) == 1:
         return pieces[0]
     return b"".join(pieces)

@@ -130,11 +130,20 @@ _d("object_spilling_directory", str, "",
 _d("object_store_full_delay_ms", int, 100, "Retry delay when store is full.")
 _d("object_chunk_bytes", int, 4 * 1024 * 1024,
    "Chunk size for inter-node object pulls (object_buffer_pool.h).")
-_d("object_pull_window", int, 2,
-   "Max in-flight chunk requests per pull (pull_manager.h:52 "
-   "admission control).  The path is memcpy-bound, not latency-bound: "
-   "2 in flight hides the RTT; more just thrashes the GIL (measured "
-   "0.85 GB/s at 2 vs 0.76 at 8 on loopback).")
+_d("object_shm_directory", str, "/dev/shm",
+   "tmpfs directory for shared-memory primary copies (plasma proper, "
+   "store.h:55); empty disables shm re-homing.")
+_d("object_shm_min_bytes", int, 1024 * 1024,
+   "Primary copies at or above this size are re-homed to shared "
+   "memory at seal time; 0 disables.")
+_d("object_pull_streams", int, 4,
+   "Parallel TCP connections per chunked pull.  One socket serializes "
+   "all chunks behind one reader thread (~0.8 GB/s loopback); striping "
+   "chunks over N sockets multiplies throughput until memory "
+   "bandwidth (recv copies release the GIL).")
+_d("object_broadcast_fanout", int, 2,
+   "Children per node in the push-based broadcast tree "
+   "(push_manager.h:30 analogue; depth = log_fanout(n)).")
 _d("max_lineage_bytes", int, 100 * 1024 * 1024,
    "Lineage pinned for reconstruction, per owner (task_manager.h:219).")
 

@@ -289,6 +289,11 @@ class _MemoryMonitor:
                  node_memory_bytes: Optional[float] = None):
         self.pool = pool
         self.watermark = GLOBAL_CONFIG.memory_usage_threshold()
+        # Physical memory only: the watermark protects the BOX.  A
+        # logical resources={"memory": ...} override is a scheduling
+        # quota, not a measurement baseline — mixing them makes the
+        # fraction nonsensical (node_memory_bytes is accepted for tests
+        # that fake a box size).
         self.total = float(node_memory_bytes or _meminfo("MemTotal"))
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,

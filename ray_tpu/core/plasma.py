@@ -37,7 +37,7 @@ from .ids import ObjectID
 
 class _Entry:
     __slots__ = ("sealed", "meta", "bufs", "size", "spill_path",
-                 "last_access", "primary")
+                 "last_access", "primary", "shm_path", "_mm")
 
     def __init__(self, sealed, size: int, primary: bool):
         self.sealed = sealed
@@ -47,6 +47,11 @@ class _Entry:
         self.spill_path: Optional[str] = None
         self.last_access = time.monotonic()
         self.primary = primary
+        # Shared-memory backing (plasma proper, store.h:55): primary
+        # copies live as flat layouts in a /dev/shm file; same-host
+        # pullers mmap it instead of copying bytes over loopback.
+        self.shm_path: Optional[str] = None
+        self._mm = None
 
 
 _FOREIGN_IDLE_S = 120.0  # serving-cache entries swept after this idle time
@@ -84,14 +89,93 @@ class LocalObjectStore:
     # ------------------------------------------------------------ write
     def put_primary(self, oid: ObjectID, sealed) -> None:
         """Pin a primary copy on this node.  The entry stays (in memory
-        or spilled) until ``free`` — the owner's out-of-scope hook."""
+        or spilled) until ``free`` — the owner's out-of-scope hook.
+
+        Big values are re-homed into SHARED MEMORY (store.h:55 — the
+        plasma design proper): the flat wire layout is written to a
+        /dev/shm file once at seal time, the entry's arrays become
+        zero-copy views into the mapping, and a same-host puller mmaps
+        the file instead of copying a gigabyte over loopback TCP.
+        Same-node consumers see numpy views (a device array extern pays
+        its device→host transfer here, where the copy already happens
+        for serving)."""
         with self._lock:
             if oid in self._entries:
                 return  # immutable: double-seal keeps the first copy
-            self._entries[oid] = _Entry(sealed, sealed.size_bytes,
-                                        primary=True)
-            self._mem_bytes += sealed.size_bytes
+        entry = _Entry(sealed, sealed.size_bytes, primary=True)
+        shm = None
+        if (sealed.size_bytes
+                >= int(GLOBAL_CONFIG.object_shm_min_bytes()) > 0):
+            # Copy into tmpfs OUTSIDE the lock (gigabyte memcpy).
+            shm = self._build_shm(oid, sealed)
+        with self._lock:
+            if oid in self._entries:
+                # Lost a double-seal race after the copy: drop our file.
+                if shm is not None:
+                    self._discard_shm(shm)
+                return
+            if shm is not None:
+                self._commit_shm_locked(entry, shm)
+            self._entries[oid] = entry
+            self._mem_bytes += entry.size
             self._maybe_spill(exclude=oid)
+
+    def _build_shm(self, oid: ObjectID, sealed):
+        """Write ``sealed``'s flat layout into a /dev/shm file; returns
+        (path, mm, meta) or None on failure (tiny container tmpfs)."""
+        import mmap
+
+        from ..cluster.serialization import wire_layout, wire_size
+
+        shm_dir = GLOBAL_CONFIG.object_shm_directory()
+        if not shm_dir or not os.path.isdir(shm_dir):
+            return None
+        path = os.path.join(
+            shm_dir, f"ray_tpu-{os.getpid()}-{oid.hex()[:24]}")
+        try:
+            meta, bufs = wire_layout(sealed)
+            total = wire_size(meta)
+            with open(path, "wb+") as f:
+                f.truncate(total)
+                mm = mmap.mmap(f.fileno(), total)
+            off = 0
+            mv = memoryview(mm)
+            for b in bufs:
+                mv[off:off + len(b)] = b
+                off += len(b)
+            return (path, mm, meta)
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+
+    @staticmethod
+    def _discard_shm(shm) -> None:
+        path, mm, _meta = shm
+        try:
+            mm.close()
+        except (OSError, BufferError):
+            pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _commit_shm_locked(entry: _Entry, shm) -> None:
+        """Swap the entry onto its shm backing — a handful of reference
+        assignments, safe under the lock."""
+        from ..cluster.serialization import sealed_from_flat
+
+        path, mm, meta = shm
+        mv = memoryview(mm)
+        entry.sealed = sealed_from_flat(meta, mv.toreadonly())
+        entry.meta = meta
+        entry.bufs = [mv]
+        entry.shm_path = path
+        entry._mm = mm
 
     def serve_foreign(self, oid: ObjectID, sealed) -> dict:
         """Cache a *non-primary* sealed value (e.g. the owner's own
@@ -178,6 +262,59 @@ class LocalObjectStore:
                     self._wire_meta_locked(oid, entry)
                 return read_layout_chunk(entry.bufs, offset, length)
 
+    def ensure_shm(self, oid: ObjectID) -> Optional[str]:
+        """Re-home an existing entry (primary or foreign) to shared
+        memory if it qualifies; returns the shm path if backed.  The
+        tmpfs copy happens outside the lock (a gigabyte memcpy under it
+        would stall every concurrent chunk read)."""
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None or entry.sealed is None:
+                return None
+            qualifies = (entry.size
+                         >= int(GLOBAL_CONFIG.object_shm_min_bytes()) > 0)
+            if entry.shm_path is not None or not qualifies:
+                return entry.shm_path
+            sealed = entry.sealed
+        shm = self._build_shm(oid, sealed)
+        with self._lock:
+            cur = self._entries.get(oid)
+            if cur is None or cur.sealed is None:
+                if shm is not None:
+                    self._discard_shm(shm)
+                return None
+            if cur.shm_path is None and shm is not None:
+                self._commit_shm_locked(cur, shm)
+            elif shm is not None and cur.shm_path != shm[0]:
+                self._discard_shm(shm)
+            return cur.shm_path
+
+    def shm_path_of(self, oid: ObjectID) -> Optional[str]:
+        """The /dev/shm backing file, if this entry was re-homed —
+        same-host pullers mmap it instead of pulling bytes."""
+        with self._lock:
+            entry = self._entries.get(oid)
+            return entry.shm_path if entry is not None else None
+
+    def read_chunk_pieces(self, oid: ObjectID, offset: int, length: int):
+        """Zero-copy memoryview pieces of the flat layout for the raw
+        object stream (cluster/client.py ObjectStreamServer) — sendmsg
+        ships them without assembling a bytes copy.  Spilled entries
+        fall back to one file-read piece."""
+        from ..cluster.serialization import read_layout_pieces
+
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None:
+                return None
+            entry.last_access = time.monotonic()
+            if not (entry.spill_path is not None and entry.sealed is None):
+                if entry.bufs is None:
+                    self._wire_meta_locked(oid, entry)
+                return read_layout_pieces(entry.bufs, offset, length)
+        data = self.read_chunk(oid, offset, length)
+        return None if data is None else [memoryview(data)]
+
     # ------------------------------------------------------------- free
     def free(self, oid: ObjectID) -> None:
         with self._lock:
@@ -192,6 +329,13 @@ class LocalObjectStore:
                     os.unlink(entry.spill_path)
                 except OSError:
                     pass
+            if entry.shm_path is not None:
+                # Unlink only: pullers holding the mapping keep the
+                # pages alive (POSIX); fresh pulls fall back to TCP.
+                try:
+                    os.unlink(entry.shm_path)
+                except OSError:
+                    pass
 
     # ---------------------------------------------------------- spilling
     def _maybe_spill(self, exclude: Optional[ObjectID] = None) -> None:
@@ -202,7 +346,11 @@ class LocalObjectStore:
             return
         candidates = sorted(
             ((oid, e) for oid, e in self._entries.items()
-             if e.sealed is not None and oid != exclude),
+             if e.sealed is not None and oid != exclude
+             # shm-backed entries are exempt: mappings may be shared
+             # with same-host pullers, and tmpfs pages are already the
+             # OS's to reclaim via swap.
+             and e.shm_path is None),
             key=lambda kv: kv[1].last_access)
         for oid, entry in candidates:
             if self._mem_bytes <= watermark:

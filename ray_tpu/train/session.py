@@ -120,6 +120,15 @@ def get_dataset_shard(dataset_name: str = "train"):
                        f"(have {list(s.context._datasets)})")
     rank = s.context.get_world_rank()
     world = s.context.get_world_size()
+    from .split_coordinator import RemoteSplitShard, SplitCoordinatorRef
+
+    if isinstance(ds, SplitCoordinatorRef):
+        # Cross-process gang: ONE execution lives in the coordinator
+        # actor on the driver; every rank pulls its blocks over the
+        # object plane (reference: output_splitter +
+        # train/_internal/data_config.py — read tasks run exactly once
+        # regardless of worker processes).
+        return RemoteSplitShard(ds.actor, rank, world)
     # ray_tpu.data.Dataset → streaming split; plain iterables → strided.
     if hasattr(ds, "streaming_split"):
         # streaming_split's router barrier lives in ONE process.  If
@@ -128,7 +137,10 @@ def get_dataset_shard(dataset_name: str = "train"):
         # consumers that never arrive (deadlock, ADVICE r3).  The
         # trainer decides colocation for the WHOLE gang (identity
         # handshake), so either every worker shares one router or every
-        # worker strides independently — never a mix.
+        # worker strides independently — never a mix.  (The TRAINER
+        # normally swaps Datasets for SplitCoordinatorRefs on
+        # non-colocated gangs; this strided path remains for shards
+        # obtained outside JaxTrainer.)
         if not s.context._colocated:
             return _StridedBlockShard(ds, rank, world)
         # One shared split per dataset NAME (not per object: two names

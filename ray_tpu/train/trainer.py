@@ -74,6 +74,7 @@ class JaxTrainer:
 
             reset_dataset_shards()
             collector = _ReportCollector.remote()
+            coordinators: list = []
             group = WorkerGroup(
                 self.scaling_config.num_workers,
                 self.scaling_config.worker_resources(),
@@ -99,10 +100,28 @@ class JaxTrainer:
                     coordinator = ray_tpu.get(
                         group.workers[0].reserve_coordinator.remote())
                     group.run_all("setup_distributed", coordinator)
+                datasets = self.datasets
+                if not colocated and datasets:
+                    # Cross-process gang: host ONE shared execution per
+                    # dataset in this (driver) process and hand workers
+                    # a coordinator handle — each read task runs exactly
+                    # once instead of once per worker
+                    # (split_coordinator.py; reference output_splitter).
+                    from .split_coordinator import make_split_coordinator
+
+                    datasets = {}
+                    for key, d in self.datasets.items():
+                        if hasattr(d, "streaming_split"):
+                            ref = make_split_coordinator(
+                                d, self.scaling_config.num_workers)
+                            coordinators.append(ref.actor)
+                            datasets[key] = ref
+                        else:
+                            datasets[key] = d
                 refs = group.run_all_async(
                     "run", self.train_loop_per_worker,
                     self.train_loop_config, self.scaling_config.mesh,
-                    collector, name, storage, self.datasets,
+                    collector, name, storage, datasets,
                     latest_ckpt.path if latest_ckpt else None,
                     colocated)
                 ray_tpu.get(refs)
@@ -121,6 +140,11 @@ class JaxTrainer:
                     latest_ckpt = manager.latest_checkpoint()
             finally:
                 group.shutdown()
+                for coord in coordinators:
+                    try:
+                        ray_tpu.kill(coord)
+                    except Exception:
+                        pass
                 try:
                     ray_tpu.kill(collector)
                 except Exception:

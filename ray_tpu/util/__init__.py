@@ -1,10 +1,11 @@
 from .actor_pool import ActorPool
+from .broadcast import broadcast
 from .placement_group import (PlacementGroup, placement_group,
                               remove_placement_group,
                               get_current_placement_group)
 from .queue import Queue
 
 __all__ = [
-    "ActorPool", "PlacementGroup", "placement_group",
+    "ActorPool", "PlacementGroup", "broadcast", "placement_group",
     "remove_placement_group", "get_current_placement_group", "Queue",
 ]

@@ -266,3 +266,10 @@ def test_infeasible_task_rejected(ray_start_regular):
 
     with pytest.raises(ValueError):
         huge.remote()
+
+
+def test_broadcast_local_mode_is_noop(ray_start_regular):
+    """util.broadcast with no cluster attached replicates nowhere."""
+    from ray_tpu.util import broadcast
+
+    assert broadcast(ray_tpu.put([1, 2, 3])) == 0

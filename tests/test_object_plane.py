@@ -216,6 +216,42 @@ class TestPrimaryCopyReturns:
         assert ray_tpu.get(s, timeout=60) == pytest.approx(data.sum())
 
 
+class TestBroadcast:
+    def test_broadcast_replicates_to_all_nodes(self, plane_cluster):
+        """util.broadcast pushes a copy to every other node over the
+        fanout tree (push_manager.h:30); consumers then resolve the
+        arg from their LOCAL store instead of pulling."""
+        from ray_tpu.util import broadcast
+
+        data = np.arange(200_000, dtype=np.float64)
+        ref = ray_tpu.put(data)
+        n = broadcast(ref)
+        assert n == 2  # both worker nodes
+
+        @ray_tpu.remote
+        def has_local_copy(oid):
+            rt = ray_tpu.get_runtime()
+            return rt.object_store.contains(oid)
+
+        for res in ("w0", "w1"):
+            assert ray_tpu.get(
+                has_local_copy.options(resources={res: 1}).remote(
+                    ref.object_id()), timeout=30)
+        # And the value is actually usable on each node.
+        s = array_sum.options(resources={"w1": 1}).remote(ref)
+        assert ray_tpu.get(s, timeout=30) == pytest.approx(data.sum())
+
+    def test_broadcast_of_primary_copy_return(self, plane_cluster):
+        """Broadcasting a task's primary-copy return: the driver pulls
+        it once, then fans out."""
+        from ray_tpu.util import broadcast
+
+        ref = big_array.options(resources={"w0": 1}).remote(300_000, 2.0)
+        ray_tpu.wait([ref], timeout=30)
+        assert broadcast(ref) == 2
+        s = array_sum.options(resources={"w1": 1}).remote(ref)
+        assert ray_tpu.get(s, timeout=30) == pytest.approx(600_000.0)
+
 class TestLineageReconstruction:
     def test_lost_primary_recomputed_on_get(self, plane_cluster):
         """Kill the node pinning a task's output: get() transparently
