@@ -590,10 +590,13 @@ class ClusterClient:
         envelope includes 1 GiB broadcast to 50+ nodes).
 
         Ships the object's flat wire layout to ``addresses`` (default:
-        every other alive node); each recipient seals a local borrowed
-        copy and relays to its subtree, so the source uploads only
-        ``fanout`` copies regardless of cluster size.  Returns the
-        number of nodes pushed to."""
+        every other alive node); each recipient caches a local copy
+        (plasma foreign cache — consumers resolve the ref locally, no
+        pull) and relays to its subtree, so the source uploads only
+        ``fanout`` copies regardless of cluster size.  Copies are
+        CACHES: keep the ref alive through the task wave that uses it;
+        idle copies are swept.  Returns the number of nodes pushed
+        to."""
         from ..core.config import GLOBAL_CONFIG
         from .serialization import serialize
 
@@ -683,16 +686,23 @@ class ClusterClient:
     def accept_pushed_object(self, oid, owner: str, meta, size: int,
                              shm_path: Optional[str], data,
                              relay: List[str], timeout: float) -> bool:
-        """Recipient side: seal a borrowed local copy (mmap the shm
-        file when same-host, else from ``data``), register the borrow
-        with the owner, relay to the subtree.  Returns False if data
-        is needed but absent (caller resends with bytes)."""
-        from ..core.object_store import RayObject
+        """Recipient side: cache a local copy (mmap the shm file when
+        same-host, else from ``data``) and relay to the subtree.
+
+        The copy goes into plasma's FOREIGN cache, not the object
+        store: a pushed copy has no local reference whose scope could
+        ever release a borrow hold, so registering one would pin the
+        object at the owner forever.  Cache semantics instead — local
+        consumers hit it through fetch_object's plasma short-circuit,
+        remote pullers through chunk serving, and idle copies are
+        swept (plasma _FOREIGN_IDLE_S) / dropped under pressure.
+        Returns False if data is needed but absent (caller resends
+        with bytes)."""
         from .serialization import sealed_from_flat
 
-        store = self.runtime.object_store
+        plasma = self.runtime.plasma
         have_data = data is not None
-        if not store.contains(oid) and owner != self.address:
+        if not plasma.contains(oid) and owner != self.address:
             sealed = _try_mmap_shm(shm_path, size, meta)
             if sealed is None:
                 if not have_data:
@@ -701,36 +711,15 @@ class ClusterClient:
                     else bytes(data)
                 sealed = sealed_from_flat(
                     meta, memoryview(raw).toreadonly())
-            register = False
-            with self._loc_lock:
-                if oid not in self._borrowed:
-                    self._borrowed[oid] = owner
-                    register = True
-            if register:
-                # SYNCHRONOUS: the borrow hold must be on the owner's
-                # books before the push RPC completes, or broadcast()
-                # returning + the caller dropping its ref could free
-                # the object while copies are still being registered.
-                try:
-                    self.pool.get(owner).call(
-                        "register_borrower",
-                        {"oid": oid, "borrower": self.address},
-                        timeout=30.0)
-                except Exception:
-                    # Owner unreachable: keep the copy usable locally;
-                    # liveness degrades to the owner's own lifetime.
-                    pass
-            store.put(oid, RayObject(sealed=sealed))
+            plasma.serve_foreign(oid, sealed)
         if relay:
             from ..core.config import GLOBAL_CONFIG
 
             def get_data():
                 if data is not None:
                     return data
-                # Serve from the local copy we just stored.
-                obj = store.get_if_exists(oid)
-                m2 = self.runtime.plasma.serve_foreign(oid, obj.sealed)
-                return self.runtime.plasma.read_chunk(oid, 0, m2["size"])
+                m2 = plasma.wire_meta(oid)
+                return plasma.read_chunk(oid, 0, m2["size"])
 
             self._relay_push(
                 oid, owner, meta, size, shm_path, get_data, relay,
@@ -1209,7 +1198,9 @@ class ObjectStreamServer:
                     [p if isinstance(p, memoryview) else memoryview(p)
                      for p in pieces]
                 while bufs:
-                    sent = conn.sendmsg(bufs)
+                    # Cap the iovec at IOV_MAX-ish: a chunk spanning
+                    # thousands of tiny externs would EMSGSIZE.
+                    sent = conn.sendmsg(bufs[:1024])
                     while bufs and sent >= len(bufs[0]):
                         sent -= len(bufs[0])
                         bufs.pop(0)
@@ -1248,7 +1239,6 @@ class NodeServer:
             "object_meta": self._object_meta,
             "object_chunk": self._object_chunk,
             "push_object": self._push_object,
-            "register_borrower": self._register_borrower,
             "free_primary": self._free_primary,
             "report_object_lost": self._report_object_lost,
             "stream_item": self._stream_item,
@@ -1446,13 +1436,6 @@ class NodeServer:
         self.runtime.reference_counter.remove_borrower(
             p["oid"], p["borrower"])
         return {"ok": True}
-
-    def _register_borrower(self, p):
-        """Owner-side hold registration for a PUSHED copy (broadcast
-        recipients; the pull path registers through get_object)."""
-        ok = self.runtime.reference_counter.add_borrower(
-            p["oid"], p["borrower"])
-        return {"ok": ok}
 
     def _push_object(self, p):
         try:

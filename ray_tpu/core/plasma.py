@@ -67,6 +67,7 @@ class LocalObjectStore:
         self._mem_bytes = 0
         self._spill_dir = spill_dir
         self._spilled_bytes = 0
+        self._shm_bytes = 0
         self._num_spilled = 0
         self._num_restored = 0
 
@@ -116,8 +117,13 @@ class LocalObjectStore:
                 return
             if shm is not None:
                 self._commit_shm_locked(entry, shm)
+                # shm entries live on the tmpfs budget, not the store's
+                # heap watermark — counting them would permanently
+                # saturate it and spill every non-shm object on sight.
+                self._shm_bytes += entry.size
+            else:
+                self._mem_bytes += entry.size
             self._entries[oid] = entry
-            self._mem_bytes += entry.size
             self._maybe_spill(exclude=oid)
 
     def _build_shm(self, oid: ObjectID, sealed):
@@ -136,7 +142,10 @@ class LocalObjectStore:
             meta, bufs = wire_layout(sealed)
             total = wire_size(meta)
             with open(path, "wb+") as f:
-                f.truncate(total)
+                # posix_fallocate, NOT truncate: truncate on tmpfs
+                # reserves nothing, so running out of /dev/shm mid-copy
+                # is a SIGBUS (process death), not a catchable error.
+                os.posix_fallocate(f.fileno(), 0, total)
                 mm = mmap.mmap(f.fileno(), total)
             off = 0
             mv = memoryview(mm)
@@ -285,6 +294,9 @@ class LocalObjectStore:
                 return None
             if cur.shm_path is None and shm is not None:
                 self._commit_shm_locked(cur, shm)
+                # Move the bytes from the heap budget to the shm one.
+                self._mem_bytes -= cur.size
+                self._shm_bytes += cur.size
             elif shm is not None and cur.shm_path != shm[0]:
                 self._discard_shm(shm)
             return cur.shm_path
@@ -322,7 +334,10 @@ class LocalObjectStore:
             if entry is None:
                 return
             if entry.sealed is not None:
-                self._mem_bytes -= entry.size
+                if entry.shm_path is not None:
+                    self._shm_bytes -= entry.size
+                else:
+                    self._mem_bytes -= entry.size
             if entry.spill_path is not None:
                 self._spilled_bytes -= entry.size
                 try:
@@ -411,6 +426,7 @@ class LocalObjectStore:
                 "num_objects": len(self._entries),
                 "mem_bytes": self._mem_bytes,
                 "spilled_bytes": self._spilled_bytes,
+                "shm_bytes": self._shm_bytes,
                 "num_spilled": self._num_spilled,
                 "num_restored": self._num_restored,
             }
@@ -419,9 +435,12 @@ class LocalObjectStore:
         with self._lock:
             paths = [e.spill_path for e in self._entries.values()
                      if e.spill_path]
+            paths += [e.shm_path for e in self._entries.values()
+                      if e.shm_path]
             self._entries.clear()
             self._mem_bytes = 0
             self._spilled_bytes = 0
+            self._shm_bytes = 0
         for p in paths:
             try:
                 os.unlink(p)

@@ -13,15 +13,18 @@ from .context import DataContext
 from .executor import ActorPoolStrategy
 from .dataset import (DataIterator, Dataset, from_arrow, from_blocks,
                       from_items, from_numpy, from_pandas, range,
-                      read_csv, read_datasource, read_json, read_numpy,
-                      read_parquet)
+                      read_csv, read_datasource, read_images, read_json,
+                      read_numpy, read_parquet, read_tfrecords)
 from .datasource import Datasource, FileDatasource, ReadTask
+from .random_access import RandomAccessDataset
+from . import preprocessors
 
 __all__ = [
     "ActorPoolStrategy",
     "Block", "BlockAccessor", "BlockMetadata", "DataContext",
     "DataIterator", "Dataset", "Datasource", "FileDatasource",
-    "ReadTask", "from_arrow", "from_blocks", "from_items", "from_numpy",
-    "from_pandas", "range", "read_csv", "read_datasource", "read_json",
-    "read_numpy", "read_parquet",
+    "RandomAccessDataset", "ReadTask", "from_arrow", "from_blocks",
+    "from_items", "from_numpy", "from_pandas", "preprocessors", "range",
+    "read_csv", "read_datasource", "read_images", "read_json",
+    "read_numpy", "read_parquet", "read_tfrecords",
 ]

@@ -14,6 +14,7 @@ prefetching batcher + GPU pinning, block_batching/).
 from __future__ import annotations
 
 import builtins
+import os
 
 import itertools
 import threading
@@ -258,6 +259,60 @@ class Dataset:
         if self._last_stats is None:
             return "(dataset not executed yet)"
         return self._last_stats.summary()
+
+    # -- writers (reference: Dataset.write_* → one file per block) ----------
+    def _write_files(self, path: str, ext: str, write_block) -> List[str]:
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in enumerate(self.iter_blocks()):
+            fp = os.path.join(path, f"{i:06d}.{ext}")
+            write_block(fp, block)
+            out.append(fp)
+        return out
+
+    def write_parquet(self, path: str) -> List[str]:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        def w(fp, block):
+            pq.write_table(pa.table(
+                {k: np.asarray(v) for k, v in block.items()}), fp)
+
+        return self._write_files(path, "parquet", w)
+
+    def write_csv(self, path: str) -> List[str]:
+        def w(fp, block):
+            BlockAccessor.to_pandas(block).to_csv(fp, index=False)
+
+        return self._write_files(path, "csv", w)
+
+    def write_json(self, path: str) -> List[str]:
+        import json
+
+        def w(fp, block):
+            with open(fp, "w") as f:
+                for row in BlockAccessor.to_rows(block):
+                    f.write(json.dumps(
+                        {k: (v.tolist() if hasattr(v, "tolist") else v)
+                         for k, v in row.items()}) + "\n")
+
+        return self._write_files(path, "jsonl", w)
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        from .tfrecords import write_tfrecords_file
+
+        def w(fp, block):
+            write_tfrecords_file(fp, [block])
+
+        return self._write_files(path, "tfrecords", w)
+
+    def to_random_access_dataset(self, key: str, *,
+                                 num_workers: int = 2):
+        """Keyed O(log n) lookup structure over the sorted dataset
+        (reference: Dataset.to_random_access_dataset)."""
+        from .random_access import RandomAccessDataset
+
+        return RandomAccessDataset(self, key, num_workers=num_workers)
 
     # -- splitting (Train integration) --------------------------------------
     def streaming_split(self, n: int, *, equal: bool = True
@@ -569,4 +624,24 @@ def read_json(paths, *, parallelism: int = -1) -> Dataset:
 
 def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(numpy_datasource(paths),
+                           parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    """TFRecord files of tf.train.Example protos (reference:
+    read_api.read_tfrecords; codec is native — data/tfrecords.py)."""
+    from .datasource import tfrecords_datasource
+
+    return read_datasource(tfrecords_datasource(paths),
+                           parallelism=parallelism)
+
+
+def read_images(paths, *, size=None, mode=None,
+                parallelism: int = -1) -> Dataset:
+    """Image files → rows {"image": HWC array, "path"} (reference:
+    read_api.read_images).  ``size=(w, h)`` resizes; ``mode`` converts
+    (e.g. "RGB")."""
+    from .datasource import image_datasource
+
+    return read_datasource(image_datasource(paths, size=size, mode=mode),
                            parallelism=parallelism)

@@ -163,6 +163,31 @@ def _read_numpy_file(path: str) -> List[Block]:
     return [{"data": arr}]
 
 
+def _read_image_file(path: str, *, size=None, mode=None) -> List[Block]:
+    """Decode one image into {"image": HWC uint8 array, "path": str}
+    (reference: datasource/image_datasource.py)."""
+    from PIL import Image
+
+    img = Image.open(path)
+    if mode:
+        img = img.convert(mode)
+    if size:
+        img = img.resize(tuple(size))
+    return [{"image": np.asarray(img)[None, ...],
+             "path": np.asarray([path])}]
+
+
+def image_datasource(paths, *, size=None, mode=None) -> FileDatasource:
+    return FileDatasource(
+        paths, lambda p: _read_image_file(p, size=size, mode=mode))
+
+
+def tfrecords_datasource(paths) -> FileDatasource:
+    from .tfrecords import read_tfrecords_file
+
+    return FileDatasource(paths, read_tfrecords_file)
+
+
 def parquet_datasource(paths, columns=None) -> FileDatasource:
     return FileDatasource(
         paths, lambda p: _read_parquet_file(p, columns=columns))

@@ -1,0 +1,171 @@
+"""Fit/transform preprocessors chained into the dataset plan.
+
+Reference: python/ray/data/preprocessor.py (base Preprocessor with
+fit/transform/fit_transform over Datasets) + the concrete scalers and
+encoders under python/ray/data/preprocessors/.  ``fit`` aggregates
+statistics with one pass over the dataset; ``transform`` appends an
+ordinary ``map_batches`` stage, so downstream training consumes the
+preprocessed stream with no materialization (a preprocessor feeding
+JaxTrainer is just another plan stage).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Preprocessor:
+    """fit(ds) → self (computes stats); transform(ds) → Dataset."""
+
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted and type(self)._fit is not Preprocessor._fit:
+            raise RuntimeError(
+                f"{type(self).__name__} must be fit before transform")
+        return ds.map_batches(self._transform_batch)
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    # Stateless preprocessors override only _transform_batch.
+    def _fit(self, ds) -> None:
+        pass
+
+    def _transform_batch(self, batch: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference:
+    preprocessors/scaler.py StandardScaler)."""
+
+    def __init__(self, columns: Sequence[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        n = 0
+        s = {c: 0.0 for c in self.columns}
+        sq = {c: 0.0 for c in self.columns}
+        for block in ds.iter_blocks():
+            for c in self.columns:
+                v = np.asarray(block[c], dtype=np.float64)
+                s[c] += float(v.sum())
+                sq[c] += float((v * v).sum())
+            n += len(np.asarray(block[self.columns[0]]))
+        for c in self.columns:
+            mean = s[c] / max(n, 1)
+            var = max(sq[c] / max(n, 1) - mean * mean, 0.0)
+            self.stats_[c] = (mean, float(np.sqrt(var)) or 1.0)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            out[c] = (np.asarray(batch[c], np.float64) - mean) / std
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (reference MinMaxScaler)."""
+
+    def __init__(self, columns: Sequence[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        lo = {c: np.inf for c in self.columns}
+        hi = {c: -np.inf for c in self.columns}
+        for block in ds.iter_blocks():
+            for c in self.columns:
+                v = np.asarray(block[c], dtype=np.float64)
+                lo[c] = min(lo[c], float(v.min()))
+                hi[c] = max(hi[c], float(v.max()))
+        for c in self.columns:
+            self.stats_[c] = (lo[c], hi[c])
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            span = (hi - lo) or 1.0
+            out[c] = (np.asarray(batch[c], np.float64) - lo) / span
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical column → dense int codes (reference LabelEncoder)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Optional[List] = None
+
+    def _fit(self, ds) -> None:
+        seen = set()
+        for block in ds.iter_blocks():
+            seen.update(np.asarray(block[self.label_column]).tolist())
+        self.classes_ = sorted(seen)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        index = {v: i for i, v in enumerate(self.classes_)}
+        out[self.label_column] = np.asarray(
+            [index[v] for v in
+             np.asarray(batch[self.label_column]).tolist()],
+            dtype=np.int64)
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Merge feature columns into one float matrix column (reference
+    preprocessors/concatenator.py) — the shape a train step consumes."""
+
+    def __init__(self, columns: Sequence[str],
+                 output_column_name: str = "concat_out",
+                 dtype=np.float32):
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _transform_batch(self, batch):
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        cols = [np.asarray(batch[c], self.dtype).reshape(
+            len(np.asarray(batch[c])), -1) for c in self.columns]
+        out[self.output_column_name] = np.concatenate(cols, axis=1)
+        return out
+
+
+class Chain(Preprocessor):
+    """Apply preprocessors in order; fit runs sequentially with each
+    stage fitting on the PREVIOUS stages' transformed output
+    (reference preprocessors/chain.py)."""
+
+    def __init__(self, *stages: Preprocessor):
+        self.stages = list(stages)
+
+    def fit(self, ds) -> "Chain":
+        cur = ds
+        for st in self.stages:
+            st.fit(cur)
+            cur = st.transform(cur)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        cur = ds
+        for st in self.stages:
+            cur = st.transform(cur)
+        return cur
+
+    def _transform_batch(self, batch):
+        for st in self.stages:
+            batch = st._transform_batch(batch)
+        return batch

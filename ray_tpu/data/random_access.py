@@ -1,0 +1,87 @@
+"""RandomAccessDataset: O(log n) keyed lookups over a sorted dataset.
+
+Reference: python/ray/data/random_access_dataset.py — the dataset is
+sorted by key and partitioned over holder actors; a lookup binary-
+searches the partition index and asks the owning actor, which binary-
+searches its local blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class _BlockHolder:
+    def __init__(self, blocks: List[Dict[str, np.ndarray]], key: str):
+        self._key = key
+        self._blocks = blocks
+        self._lows = [float(np.asarray(b[key])[0]) for b in blocks]
+
+    def get(self, key_value) -> Optional[Dict[str, Any]]:
+        i = int(np.searchsorted(self._lows, key_value, side="right")) - 1
+        for b in self._blocks[max(i, 0):i + 2]:
+            col = np.asarray(b[self._key])
+            j = int(np.searchsorted(col, key_value))
+            if j < len(col) and col[j] == key_value:
+                return {k: np.asarray(v)[j] for k, v in b.items()}
+        return None
+
+    def multiget(self, key_values: List) -> List[Optional[Dict]]:
+        return [self.get(k) for k in key_values]
+
+
+class RandomAccessDataset:
+    """Built via ``Dataset.to_random_access_dataset(key)``."""
+
+    def __init__(self, ds, key: str, *, num_workers: int = 2):
+        self._key = key
+        blocks = [dict(b) for b in ds.sort(key).iter_blocks()
+                  if len(np.asarray(b[key]))]
+        if not blocks:
+            raise ValueError("cannot index an empty dataset")
+        num_workers = max(1, min(num_workers, len(blocks)))
+        shards: List[List] = [[] for _ in range(num_workers)]
+        for i, b in enumerate(blocks):
+            # Contiguous ranges per worker (blocks are globally sorted).
+            shards[i * num_workers // len(blocks)].append(b)
+        self._actors = [_BlockHolder.remote(s, key) for s in shards
+                        if s]
+        self._lows = [float(np.asarray(s[0][key])[0])
+                      for s in shards if s]
+
+    def _actor_for(self, key_value):
+        i = int(np.searchsorted(self._lows, key_value,
+                                side="right")) - 1
+        return self._actors[max(i, 0)]
+
+    def get_async(self, key_value):
+        """ObjectRef resolving to the row dict (or None)."""
+        return self._actor_for(key_value).get.remote(key_value)
+
+    def multiget(self, key_values: List) -> List[Optional[Dict]]:
+        by_actor: Dict[int, List] = {}
+        order: Dict[int, List[int]] = {}
+        for pos, kv in enumerate(key_values):
+            a = self._actors.index(self._actor_for(kv))
+            by_actor.setdefault(a, []).append(kv)
+            order.setdefault(a, []).append(pos)
+        out: List[Optional[Dict]] = [None] * len(key_values)
+        refs = {a: self._actors[a].multiget.remote(kvs)
+                for a, kvs in by_actor.items()}
+        for a, ref in refs.items():
+            for pos, row in zip(order[a], ray_tpu.get(ref)):
+                out[pos] = row
+        return out
+
+    def destroy(self):
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []

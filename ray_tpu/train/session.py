@@ -127,8 +127,16 @@ def get_dataset_shard(dataset_name: str = "train"):
         # actor on the driver; every rank pulls its blocks over the
         # object plane (reference: output_splitter +
         # train/_internal/data_config.py — read tasks run exactly once
-        # regardless of worker processes).
-        return RemoteSplitShard(ds.actor, rank, world)
+        # regardless of worker processes).  Cached like the colocated
+        # path: a fresh shard per call would restart at epoch 0 while
+        # the router has moved on (instant-empty epochs).
+        with _split_lock:
+            key = (dataset_name, id(ds), rank)
+            shard = _split_cache.get(key)
+            if shard is None:
+                shard = RemoteSplitShard(ds.actor, rank, world)
+                _split_cache[key] = shard
+        return shard
     # ray_tpu.data.Dataset → streaming split; plain iterables → strided.
     if hasattr(ds, "streaming_split"):
         # streaming_split's router barrier lives in ONE process.  If

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from .schedulers import (CONTINUE, STOP, ASHAScheduler, FIFOScheduler,
+                         HyperBandScheduler, MedianStoppingRule,
                          PopulationBasedTraining)
 from .search import (choice, generate_variants, grid_search, loguniform,
                      randint, uniform)
@@ -197,7 +198,8 @@ class Tuner:
             ray_tpu.init()
         cfg = self._cfg
         scheduler = cfg.scheduler or FIFOScheduler()
-        if isinstance(scheduler, (ASHAScheduler,
+        if isinstance(scheduler, (ASHAScheduler, HyperBandScheduler,
+                                  MedianStoppingRule,
                                   PopulationBasedTraining)) \
                 and not scheduler.metric:
             scheduler.metric = cfg.metric or ""
@@ -316,7 +318,8 @@ def scheduler_metric(scheduler, cfg: TuneConfig) -> Optional[str]:
 
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
     "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
     "get_checkpoint", "grid_search", "loguniform", "randint", "report",
     "uniform",

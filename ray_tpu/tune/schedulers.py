@@ -80,6 +80,126 @@ class ASHAScheduler:
         return CONTINUE
 
 
+class HyperBandScheduler:
+    """Multi-bracket asynchronous HyperBand (reference:
+    tune/schedulers/async_hyperband.py AsyncHyperBandScheduler with
+    ``brackets`` > 1, the configuration the HyperBand paper
+    recommends).  Trials are dealt round-robin over ``brackets``
+    ASHA ladders whose grace periods are ``grace_period * eta^k`` —
+    aggressive early stopping for most trials, a long-fuse bracket so
+    late bloomers survive."""
+
+    def __init__(self, *, metric: str = "", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4, brackets: int = 3):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self._brackets: List[ASHAScheduler] = []
+        for k in range(max(1, brackets)):
+            g = grace_period * (reduction_factor ** k)
+            if g >= max_t:
+                break
+            self._brackets.append(ASHAScheduler(
+                metric=metric, mode=mode, max_t=max_t,
+                grace_period=g, reduction_factor=reduction_factor))
+        if not self._brackets:
+            self._brackets.append(ASHAScheduler(
+                metric=metric, mode=mode, max_t=max_t,
+                grace_period=grace_period,
+                reduction_factor=reduction_factor))
+        self._assignment: Dict[str, ASHAScheduler] = {}
+        self._next = 0
+
+    def _bracket(self, trial_id: str) -> ASHAScheduler:
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._brackets[self._next % len(self._brackets)]
+            self._next += 1
+            self._assignment[trial_id] = b
+        return b
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        b = self._bracket(trial_id)
+        b.metric, b.mode = self.metric, self.mode
+        return b.on_result(trial_id, iteration, metric_value)
+
+    def reevaluate(self, trial_id: str) -> str:
+        b = self._assignment.get(trial_id)
+        return b.reevaluate(trial_id) if b is not None else CONTINUE
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric falls below the
+    median of the other trials' running averages at comparable
+    iterations (reference: tune/schedulers/median_stopping_rule.py,
+    Vizier's rule).  Decisions start after ``grace_period`` iterations
+    and ``min_samples_required`` trials have reported."""
+
+    def __init__(self, *, metric: str = "", mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 hard_stop: bool = True):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples_required = min_samples_required
+        self.hard_stop = hard_stop
+        # trial_id -> list of sign-normalized values by report order.
+        self._results: Dict[str, List[float]] = {}
+
+    def _norm(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def _running_avg(self, trial_id: str, upto: int) -> float:
+        vals = self._results[trial_id][:upto]
+        return sum(vals) / len(vals)
+
+    def _decide(self, trial_id: str, iteration: int) -> str:
+        vals = self._results.get(trial_id) or []
+        n = len(vals)
+        if n == 0 or iteration < self.grace_period:
+            return CONTINUE
+        # Peers one report behind still count (the controller polls
+        # round-robin, so at this trial's turn its peers are typically
+        # at n-1); averages compare over the shared prefix.
+        others = [t for t, r in self._results.items()
+                  if t != trial_id and len(r) >= max(1, n - 1)]
+        if len(others) + 1 < self.min_samples_required:
+            return CONTINUE
+        medians = sorted(
+            self._running_avg(t, min(n, len(self._results[t])))
+            for t in others)
+        if not medians:
+            return CONTINUE
+        median = medians[len(medians) // 2]
+        k = min([n] + [len(self._results[t]) for t in others])
+        if self._running_avg(trial_id, k) < median:
+            return STOP if self.hard_stop else CONTINUE
+        return CONTINUE
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        vals = self._results.setdefault(trial_id, [])
+        vals.append(self._norm(float(metric_value)))
+        self._last_iter = getattr(self, "_last_iter", {})
+        self._last_iter[trial_id] = iteration
+        return self._decide(trial_id, iteration)
+
+    def reevaluate(self, trial_id: str) -> str:
+        """A trial polled before its peers never sees enough comparable
+        histories at on_result time (same asymmetry ASHA.reevaluate
+        handles); re-check against peers' CURRENT histories."""
+        it = getattr(self, "_last_iter", {}).get(trial_id)
+        if it is None:
+            return CONTINUE
+        return self._decide(trial_id, it)
+
+
 class PopulationBasedTraining:
     """PBT (reference: tune/schedulers/pbt.py): every
     ``perturbation_interval`` iterations, trials in the bottom quantile

@@ -1,0 +1,189 @@
+"""Datasources/writers (tfrecords, images, jsonl), preprocessors,
+RandomAccessDataset.
+
+Reference surfaces: read_api.read_tfrecords / read_images,
+data/preprocessor.py + preprocessors/, random_access_dataset.py.
+The native TFRecord/Example codec (data/tfrecords.py) is cross-checked
+against tensorflow's own reader/writer.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+class TestTFRecords:
+    def test_round_trip(self, ray_start_regular, tmp_path):
+        ds = rd.from_blocks([
+            {"x": np.arange(5, dtype=np.int64),
+             "y": np.linspace(0, 1, 5).astype(np.float32),
+             "name": np.asarray([f"r{i}" for i in range(5)])},
+            {"x": np.arange(5, 10, dtype=np.int64),
+             "y": np.linspace(1, 2, 5).astype(np.float32),
+             "name": np.asarray([f"r{i}" for i in range(5, 10)])},
+        ])
+        files = ds.write_tfrecords(str(tmp_path / "tfr"))
+        assert len(files) == 2
+        back = rd.read_tfrecords(files).sort("x")
+        rows = back.take_all()
+        assert [int(r["x"]) for r in rows] == list(range(10))
+        np.testing.assert_allclose(
+            [float(r["y"]) for r in rows[:5]],
+            np.linspace(0, 1, 5), rtol=1e-6)
+        assert rows[3]["name"] == b"r3"
+
+    def test_tensorflow_cross_compat(self, tmp_path):
+        """Our writer's records parse with tf; tf's writer's records
+        parse with our reader."""
+        tf = pytest.importorskip("tensorflow")
+        from ray_tpu.data.tfrecords import (decode_example,
+                                            encode_example,
+                                            read_records, write_records)
+
+        row = {"a": np.asarray([1, 2, 3], np.int64),
+               "b": np.asarray([0.5, 1.5], np.float32),
+               "s": b"hello"}
+        ours = str(tmp_path / "ours.tfrecord")
+        write_records(ours, [encode_example(row)])
+
+        # tf reads ours (CRCs included).
+        recs = list(tf.data.TFRecordDataset(ours))
+        ex = tf.train.Example.FromString(recs[0].numpy())
+        f = ex.features.feature
+        assert list(f["a"].int64_list.value) == [1, 2, 3]
+        assert f["s"].bytes_list.value[0] == b"hello"
+        np.testing.assert_allclose(list(f["b"].float_list.value),
+                                   [0.5, 1.5], rtol=1e-6)
+
+        # we read tf's.
+        theirs = str(tmp_path / "theirs.tfrecord")
+        with tf.io.TFRecordWriter(theirs) as w:
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "a": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[7, -9])),
+                "s": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b"x"])),
+            }))
+            w.write(ex.SerializeToString())
+        got = [decode_example(r)
+               for r in read_records(theirs, verify=True)]
+        assert list(got[0]["a"]) == [7, -9]
+        assert got[0]["s"] == b"x"
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    from PIL import Image
+
+    for i in range(3):
+        Image.fromarray(
+            np.full((8, 6, 3), i * 40, np.uint8)).save(
+                tmp_path / f"img{i}.png")
+    ds = rd.read_images(str(tmp_path / "*.png"), size=(4, 4), mode="RGB")
+    rows = ds.take_all()
+    assert len(rows) == 3
+    assert rows[0]["image"].shape == (4, 4, 3)
+    vals = sorted(int(r["image"][0, 0, 0]) for r in rows)
+    assert vals == [0, 40, 80]
+
+
+def test_jsonl_write_read(ray_start_regular, tmp_path):
+    ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(7)])
+    files = ds.write_json(str(tmp_path / "j"))
+    back = rd.read_json(files).sort("a").take_all()
+    assert [r["a"] for r in back] == list(range(7))
+    assert back[2]["b"] == "s2"
+
+
+def test_csv_parquet_writers(ray_start_regular, tmp_path):
+    ds = rd.from_blocks([{"a": np.arange(4), "b": np.arange(4) * 2.0}])
+    csvs = ds.write_csv(str(tmp_path / "c"))
+    assert rd.read_csv(csvs).count() == 4
+    pqs = ds.write_parquet(str(tmp_path / "p"))
+    rows = rd.read_parquet(pqs).sort("a").take_all()
+    assert [r["a"] for r in rows] == [0, 1, 2, 3]
+
+
+class TestPreprocessors:
+    def test_standard_scaler_feeds_training(self, ray_start_regular):
+        from ray_tpu.data.preprocessors import StandardScaler
+
+        rng = np.random.default_rng(0)
+        ds = rd.from_blocks([
+            {"x": rng.normal(5.0, 2.0, 50)} for _ in range(4)])
+        sc = StandardScaler(["x"]).fit(ds)
+        out = sc.transform(ds)
+        xs = np.concatenate([np.asarray(b["x"])
+                             for b in out.iter_blocks()])
+        assert abs(xs.mean()) < 1e-9
+        assert abs(xs.std() - 1.0) < 1e-9
+
+    def test_minmax_label_concat_chain(self, ray_start_regular):
+        from ray_tpu.data.preprocessors import (Chain, Concatenator,
+                                                LabelEncoder,
+                                                MinMaxScaler)
+
+        ds = rd.from_items([
+            {"f1": float(i), "f2": float(10 - i), "label": "ab"[i % 2]}
+            for i in range(10)])
+        pre = Chain(MinMaxScaler(["f1", "f2"]), LabelEncoder("label"),
+                    Concatenator(["f1", "f2"], "features"))
+        out = pre.fit_transform(ds)
+        batch = next(out.iter_batches(batch_size=10))
+        assert batch["features"].shape == (10, 2)
+        assert batch["features"].min() == 0.0
+        assert batch["features"].max() == 1.0
+        assert set(batch["label"].tolist()) == {0, 1}
+
+    def test_unfitted_raises(self, ray_start_regular):
+        from ray_tpu.data.preprocessors import StandardScaler
+
+        with pytest.raises(RuntimeError, match="must be fit"):
+            StandardScaler(["x"]).transform(rd.range(4))
+
+    def test_preprocessor_feeds_jax_trainer(self, ray_start_regular,
+                                            tmp_path):
+        """fit → transform → JaxTrainer end-to-end (VERDICT r4 #10)."""
+        from ray_tpu.data.preprocessors import Concatenator, StandardScaler
+        from ray_tpu.train import (JaxTrainer, RunConfig, ScalingConfig)
+
+        rng = np.random.default_rng(0)
+        ds = rd.from_blocks([
+            {"f": rng.normal(3, 2, 16), "y": rng.normal(0, 1, 16)}
+            for _ in range(2)])
+        pre = StandardScaler(["f"]).fit(ds)
+        train_ds = Concatenator(["f"], "x").transform(pre.transform(ds))
+
+        def loop(config):
+            from ray_tpu import train
+
+            shard = train.get_dataset_shard("train")
+            n = 0
+            for batch in shard.iter_batches(batch_size=8):
+                assert batch["x"].shape[1] == 1
+                n += batch["x"].shape[0]
+            train.report({"rows": n})
+
+        res = JaxTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=str(tmp_path)),
+            datasets={"train": train_ds}).fit()
+        assert res.error is None
+        assert res.metrics["rows"] == 32
+
+
+def test_random_access_dataset(ray_start_regular):
+    ds = rd.from_blocks([
+        {"k": np.asarray([3, 1, 9]), "v": np.asarray([30, 10, 90])},
+        {"k": np.asarray([7, 5]), "v": np.asarray([70, 50])},
+    ])
+    rad = ds.to_random_access_dataset("k", num_workers=2)
+    try:
+        assert ray_tpu.get(rad.get_async(5))["v"] == 50
+        assert ray_tpu.get(rad.get_async(4)) is None
+        rows = rad.multiget([9, 1, 7, 2])
+        assert [r["v"] if r else None for r in rows] == [90, 10, 70,
+                                                         None]
+    finally:
+        rad.destroy()

@@ -230,8 +230,10 @@ class TestBroadcast:
 
         @ray_tpu.remote
         def has_local_copy(oid):
+            # Pushed copies live in plasma's foreign cache (broadcast
+            # copies are caches, not borrows).
             rt = ray_tpu.get_runtime()
-            return rt.object_store.contains(oid)
+            return rt.plasma.contains(oid)
 
         for res in ("w0", "w1"):
             assert ray_tpu.get(

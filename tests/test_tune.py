@@ -189,3 +189,64 @@ def test_pbt_exploit_and_explore(ray_start_regular, tmp_path):
     # Explore perturbed at least one trial off the initial grid
     # (x1.2 or x0.8 of a population member).
     assert lrs - {0.5, 1.0, 2.0, 4.0}, lrs
+
+
+def test_hyperband_brackets_stop_bad_trials(ray_start_regular):
+    """Multi-bracket async HyperBand (reference async_hyperband.py with
+    brackets>1): bad trials are cut early, the best finishes."""
+    from ray_tpu.tune import HyperBandScheduler
+
+    max_t = 32
+
+    def trainable(config):
+        for i in range(1, max_t + 1):
+            tune.report({"acc": config["q"] * i})
+            time.sleep(0.005)
+
+    grid = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search(
+            [0.05, 0.1, 0.15, 0.2, 0.9, 1.0])},
+        tune_config=TuneConfig(
+            metric="acc", mode="max", max_concurrent_trials=6,
+            scheduler=HyperBandScheduler(max_t=max_t, grace_period=2,
+                                         reduction_factor=2,
+                                         brackets=2)),
+    ).fit()
+    by_q = {r.config["q"]: r for r in grid}
+    assert len(by_q[1.0].metrics_history) == max_t
+    assert by_q[1.0].status == "TERMINATED"
+    # At least one bottom-tier trial was stopped early.
+    stopped = [q for q in (0.05, 0.1, 0.15, 0.2)
+               if by_q[q].status == "STOPPED"
+               and len(by_q[q].metrics_history) < max_t]
+    assert stopped, {q: by_q[q].status for q in by_q}
+    assert grid.get_best_result().config["q"] == 1.0
+
+
+def test_median_stopping_rule(ray_start_regular):
+    """Trials whose running average falls below the median of the
+    others stop early (reference median_stopping_rule.py)."""
+    from ray_tpu.tune import MedianStoppingRule
+
+    max_t = 24
+
+    def trainable(config):
+        for i in range(1, max_t + 1):
+            tune.report({"acc": config["q"] * i})
+            time.sleep(0.02)
+
+    grid = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.05, 0.8, 0.9, 1.0])},
+        tune_config=TuneConfig(
+            metric="acc", mode="max", max_concurrent_trials=4,
+            scheduler=MedianStoppingRule(grace_period=3,
+                                         min_samples_required=3)),
+    ).fit()
+    by_q = {r.config["q"]: r for r in grid}
+    assert by_q[1.0].status == "TERMINATED"
+    assert len(by_q[1.0].metrics_history) == max_t
+    assert by_q[0.05].status == "STOPPED"
+    assert len(by_q[0.05].metrics_history) < max_t
+    assert grid.get_best_result().config["q"] == 1.0
