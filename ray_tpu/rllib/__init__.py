@@ -12,6 +12,9 @@ torch DDP wrapper.
 from .algorithm import Algorithm
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .algorithms.ppo import PPO, PPOConfig
+from .algorithms.dqn import DQN, DQNConfig, ReplayBuffer
+from .algorithms.impala import IMPALA, IMPALAConfig
 
-__all__ = ["Algorithm", "EnvRunner", "EnvRunnerGroup", "PPO",
-           "PPOConfig"]
+__all__ = ["Algorithm", "DQN", "DQNConfig", "EnvRunner",
+           "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "PPO",
+           "PPOConfig", "ReplayBuffer"]

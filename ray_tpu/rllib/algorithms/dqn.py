@@ -1,0 +1,310 @@
+"""DQN: double Q-learning with a replay buffer and target network.
+
+Reference: rllib/algorithms/dqn/dqn.py (DQN + DQNConfig builder;
+training_step samples transitions into the EpisodeReplayBuffer, then
+updates with double-Q targets and a periodically-synced target
+network).  TPU-first: the TD update is one jitted function; the replay
+buffer is plain numpy ring storage on the driver (replay sampling is
+bandwidth-trivial at control-problem scale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+
+from ..algorithm import Algorithm
+from ..env_runner import _make_env
+
+
+def _init_q(rng, obs_dim: int, n_actions: int, hidden):
+    import jax
+    import jax.numpy as jnp
+
+    sizes = [obs_dim, *hidden, n_actions]
+    keys = jax.random.split(rng, len(sizes))
+    layers = []
+    for i in range(len(sizes) - 1):
+        w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
+                              jnp.float32) * (2.0 / sizes[i]) ** 0.5
+        layers.append({"w": w, "b": jnp.zeros(sizes[i + 1], jnp.float32)})
+    return layers
+
+
+def _apply_q(layers, obs):
+    import jax.numpy as jnp
+
+    x = obs
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ layers[-1]["w"] + layers[-1]["b"]
+
+
+class _TransitionRunner:
+    """Epsilon-greedy transition collector (one actor; reference:
+    SingleAgentEnvRunner in DQN mode)."""
+
+    def __init__(self, env_spec, num_envs: int, steps_per_round: int,
+                 seed: int, hidden):
+        self.envs = [_make_env(env_spec) for _ in range(num_envs)]
+        self.steps = steps_per_round
+        self.hidden = tuple(hidden)
+        self._rng = np.random.default_rng(seed)
+        self._obs = np.stack([
+            env.reset(seed=seed + i)[0]
+            for i, env in enumerate(self.envs)]).astype(np.float32)
+        self._episode_return = np.zeros(num_envs, np.float64)
+        self._completed: List[float] = []
+        self._apply = None
+
+    def collect(self, params, epsilon: float) -> Dict[str, np.ndarray]:
+        import jax
+
+        if self._apply is None:
+            self._apply = jax.jit(_apply_q)
+        E = len(self.envs)
+        obs, act, rew, nobs, done = [], [], [], [], []
+        for _ in range(self.steps):
+            q = np.asarray(self._apply(params, self._obs))
+            greedy = q.argmax(-1)
+            explore = self._rng.random(E) < epsilon
+            actions = np.where(
+                explore, self._rng.integers(0, q.shape[-1], E), greedy)
+            for e, env in enumerate(self.envs):
+                o2, r, term, trunc, _ = env.step(int(actions[e]))
+                obs.append(self._obs[e].copy())
+                act.append(int(actions[e]))
+                rew.append(float(r))
+                self._episode_return[e] += r
+                # The stored next_obs must be the TRUE successor state
+                # (pre-reset): a truncated transition bootstraps from
+                # it (done=0), and bootstrapping from the next
+                # episode's reset state would corrupt the TD target.
+                nobs.append(np.asarray(o2, np.float32))
+                done.append(1.0 if term else 0.0)
+                if term or trunc:
+                    self._completed.append(float(self._episode_return[e]))
+                    self._episode_return[e] = 0.0
+                    o2, _ = env.reset()
+                self._obs[e] = o2
+        completed, self._completed = self._completed, []
+        return {
+            "obs": np.asarray(obs, np.float32),
+            "actions": np.asarray(act, np.int32),
+            "rewards": np.asarray(rew, np.float32),
+            "next_obs": np.asarray(nobs, np.float32),
+            "dones": np.asarray(done, np.float32),
+            "episode_returns": np.asarray(completed, np.float64),
+        }
+
+
+class ReplayBuffer:
+    """Uniform ring buffer (reference:
+    utils/replay_buffers/replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self._rng = np.random.default_rng(seed)
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self._n = 0
+        self._i = 0
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        for j in range(len(batch["obs"])):
+            i = self._i
+            self.obs[i] = batch["obs"][j]
+            self.actions[i] = batch["actions"][j]
+            self.rewards[i] = batch["rewards"][j]
+            self.next_obs[i] = batch["next_obs"][j]
+            self.dones[i] = batch["dones"][j]
+            self._i = (i + 1) % self.capacity
+            self._n = min(self._n + 1, self.capacity)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._n, batch_size)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "next_obs": self.next_obs[idx],
+                "dones": self.dones[idx]}
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env: Any = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 2
+    steps_per_round: int = 64
+    gamma: float = 0.99
+    lr: float = 1e-3
+    buffer_capacity: int = 50_000
+    learn_starts: int = 500
+    train_batch_size: int = 64
+    updates_per_iteration: int = 32
+    target_update_freq: int = 4  # iterations between target syncs
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_iters: int = 20
+    double_q: bool = True
+    hidden: Sequence[int] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "DQNConfig":
+        return dataclasses.replace(self, env=env)
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None
+                    ) -> "DQNConfig":
+        out = self
+        if num_env_runners is not None:
+            out = dataclasses.replace(out,
+                                      num_env_runners=num_env_runners)
+        if num_envs_per_env_runner is not None:
+            out = dataclasses.replace(
+                out, num_envs_per_runner=num_envs_per_env_runner)
+        return out
+
+    def training(self, **kwargs) -> "DQNConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN(Algorithm):
+    def __init__(self, config: DQNConfig):
+        super().__init__(config)
+        import jax
+        import optax
+
+        probe = _make_env(config.env)
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.n_actions = int(probe.action_space.n)
+        if hasattr(probe, "close"):
+            probe.close()
+
+        self.params = _init_q(jax.random.key(config.seed), self.obs_dim,
+                              self.n_actions, config.hidden)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._optimizer = optax.adam(config.lr)
+        self.opt_state = self._optimizer.init(self.params)
+        self._update = self._make_update()
+        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim,
+                                   config.seed)
+        Runner = ray_tpu.remote(_TransitionRunner)
+        self._factory = lambda i: Runner.remote(
+            config.env, config.num_envs_per_runner,
+            config.steps_per_round, config.seed + 1000 * i,
+            config.hidden)
+        self.runners = [self._factory(i)
+                        for i in range(config.num_env_runners)]
+        self._ep_returns: List[float] = []
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        optimizer = self._optimizer
+
+        def loss_fn(params, target_params, batch):
+            q = _apply_q(params, batch["obs"])
+            q_sa = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=-1)[:, 0]
+            q_next_t = _apply_q(target_params, batch["next_obs"])
+            if cfg.double_q:
+                # Online net picks, target net evaluates.
+                a_star = _apply_q(params, batch["next_obs"]).argmax(-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], axis=-1)[:, 0]
+            else:
+                q_next = q_next_t.max(-1)
+            target = batch["rewards"] + cfg.gamma * q_next * (
+                1.0 - batch["dones"])
+            td = q_sa - jax.lax.stop_gradient(target)
+            return jnp.mean(td * td)
+
+        def update(params, target_params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return jax.jit(update)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def _step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        eps = self._epsilon()
+        refs = [r.collect.remote(self.params, eps) for r in self.runners]
+        for i, ref in enumerate(refs):
+            try:
+                batch = ray_tpu.get(ref, timeout=600)
+            except Exception:
+                # FaultAwareApply: replace the dead runner, skip round.
+                self.runners[i] = self._factory(i)
+                continue
+            self.buffer.add_batch(batch)
+            self._ep_returns.extend(batch["episode_returns"].tolist())
+        self._ep_returns = self._ep_returns[-100:]
+
+        loss = float("nan")
+        if len(self.buffer) >= cfg.learn_starts:
+            for _ in range(cfg.updates_per_iteration):
+                mb = {k: jnp.asarray(v) for k, v in
+                      self.buffer.sample(cfg.train_batch_size).items()}
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.target_params, self.opt_state, mb)
+            loss = float(loss)
+        if self.iteration % cfg.target_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {
+            "episode_return_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns
+                                    else float("nan")),
+            "num_env_steps_sampled": len(self.buffer),
+            "epsilon": eps,
+            "td_loss": loss,
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        import jax
+
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,244 @@
+"""IMPALA: asynchronous sampling with V-trace off-policy correction.
+
+Reference: rllib/algorithms/impala/impala.py — env runners sample
+CONTINUOUSLY with whatever policy version they last received; the
+learner consumes fragments as they arrive (no lockstep barrier) and
+corrects the policy lag with V-trace (Espeholt et al. 2018).
+
+TPU-first: V-trace runs INSIDE the jitted update as a reverse
+``lax.scan`` over the fragment — behavior log-probs come from the
+runner, target log-probs/values from the current params, all on
+device.  The async loop is the runtime's dataflow: every runner has
+one in-flight ``sample.remote``; ``ray_tpu.wait`` harvests whichever
+finishes first and the runner is immediately re-armed with the newest
+weights, so a slow or dead runner never stalls the learner
+(FaultAwareApply, env/env_runner.py:28)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+
+from ..algorithm import Algorithm
+from ..env_runner import EnvRunner, _make_env
+from ..models import apply_actor_critic, init_actor_critic
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env: Any = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 64
+    gamma: float = 0.99
+    lr: float = 5e-4
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    entropy_coeff: float = 0.01
+    vf_loss_coeff: float = 0.5
+    # Fragments consumed per train() call.
+    fragments_per_iteration: int = 4
+    hidden: Sequence[int] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "IMPALAConfig":
+        return dataclasses.replace(self, env=env)
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "IMPALAConfig":
+        out = self
+        if num_env_runners is not None:
+            out = dataclasses.replace(out,
+                                      num_env_runners=num_env_runners)
+        if num_envs_per_env_runner is not None:
+            out = dataclasses.replace(
+                out, num_envs_per_runner=num_envs_per_env_runner)
+        if rollout_fragment_length is not None:
+            out = dataclasses.replace(
+                out, rollout_fragment_length=rollout_fragment_length)
+        return out
+
+    def training(self, **kwargs) -> "IMPALAConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA(Algorithm):
+    def __init__(self, config: IMPALAConfig):
+        super().__init__(config)
+        import jax
+        import optax
+
+        probe = _make_env(config.env)
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.n_actions = int(probe.action_space.n)
+        if hasattr(probe, "close"):
+            probe.close()
+
+        self.params = init_actor_critic(
+            jax.random.key(config.seed), self.obs_dim, self.n_actions,
+            config.hidden)
+        self._optimizer = optax.adam(config.lr)
+        self.opt_state = self._optimizer.init(self.params)
+        self._update = self._make_update()
+
+        Runner = ray_tpu.remote(EnvRunner)
+        self._factory = lambda i: Runner.remote(
+            config.env, config.num_envs_per_runner,
+            config.rollout_fragment_length, config.gamma, 0.95,
+            config.seed + 1000 * i, config.hidden)
+        self.runners = [self._factory(i)
+                        for i in range(config.num_env_runners)]
+        # The async pipeline: one in-flight sample per runner.
+        self._inflight: Dict[Any, int] = {
+            r.sample.remote(self.params, True): i
+            for i, r in enumerate(self.runners)}
+        self._ep_returns: List[float] = []
+        self.num_stale_fragments = 0
+
+    # ------------------------------------------------------------ learner
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        optimizer = self._optimizer
+
+        def loss_fn(params, batch):
+            # batch: time-major (T, E, ...) + bootstrap_obs (E, ...).
+            T = batch["obs"].shape[0]
+            logits, values = apply_actor_critic(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            _logits_b, v_boot = apply_actor_critic(
+                params, batch["bootstrap_obs"])
+            rho = jnp.exp(logp - batch["behavior_logp"])
+            rho_c = jnp.minimum(rho, cfg.vtrace_rho_clip)
+            c = jnp.minimum(rho, cfg.vtrace_c_clip)
+            nonterm = 1.0 - batch["dones"]
+            v_next = jnp.concatenate(
+                [values[1:], v_boot[None]], axis=0)
+            deltas = rho_c * (batch["rewards"]
+                              + cfg.gamma * v_next * nonterm - values)
+
+            def back(carry, xs):
+                delta_t, c_t, nt_t = xs
+                acc = delta_t + cfg.gamma * c_t * nt_t * carry
+                return acc, acc
+
+            _last, vs_minus_v = jax.lax.scan(
+                back, jnp.zeros_like(v_boot),
+                (deltas, c, nonterm), reverse=True)
+            vs = values + vs_minus_v
+            vs_next = jnp.concatenate([vs[1:], v_boot[None]], axis=0)
+            pg_adv = jax.lax.stop_gradient(
+                rho_c * (batch["rewards"]
+                         + cfg.gamma * vs_next * nonterm - values))
+            pg_loss = -jnp.mean(logp * pg_adv)
+            vf_loss = jnp.mean(
+                (values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_rho": jnp.mean(rho)}
+
+        def update(params, opt_state, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"total_loss": total, **aux}
+
+        return jax.jit(update)
+
+    # ------------------------------------------------------------- driver
+    def _harvest_one(self, timeout: float = 120.0):
+        """Block for the next finished fragment; re-arm its runner with
+        the CURRENT weights.  Dead runners are replaced in place."""
+        import jax.numpy as jnp
+
+        while True:
+            if not self._inflight:
+                raise RuntimeError("no live env runners")
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError("no fragment arrived in time")
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            try:
+                frag = ray_tpu.get(ref)
+            except Exception:
+                # Runner died mid-fragment: respawn, re-arm, move on —
+                # the learner keeps consuming the other runners.
+                self.runners[idx] = self._factory(idx)
+                self._inflight[self.runners[idx].sample.remote(
+                    self.params, True)] = idx
+                continue
+            self._inflight[self.runners[idx].sample.remote(
+                self.params, True)] = idx
+            self._ep_returns.extend(frag.pop("episode_returns").tolist())
+            self._ep_returns = self._ep_returns[-100:]
+            return {
+                "obs": jnp.asarray(frag["obs"]),
+                "actions": jnp.asarray(frag["actions"]),
+                "behavior_logp": jnp.asarray(frag["logp"]),
+                "rewards": jnp.asarray(frag["rewards"]),
+                "dones": jnp.asarray(frag["dones"]),
+                "bootstrap_obs": jnp.asarray(frag["bootstrap_obs"]),
+            }
+
+    def _step(self) -> Dict[str, Any]:
+        cfg = self.config
+        stats: Dict[str, Any] = {}
+        steps = 0
+        for _ in range(cfg.fragments_per_iteration):
+            batch = self._harvest_one()
+            steps += int(batch["obs"].shape[0] * batch["obs"].shape[1])
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.opt_state, batch)
+            # Off-policy (stale-weights) fragment: the importance
+            # ratios moved materially away from 1 (float-noise between
+            # the runner's numpy logp and the device logp is ~ulp).
+            if abs(float(stats.get("mean_rho", 1.0)) - 1.0) > 1e-3:
+                self.num_stale_fragments += 1
+        return {
+            "episode_return_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns
+                                    else float("nan")),
+            "num_env_steps_sampled": steps,
+            **{k: float(v) for k, v in stats.items()},
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        import jax
+
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -61,9 +61,15 @@ class EnvRunner:
         logits, value = self._apply(params, obs)
         return np.asarray(logits), np.asarray(value)
 
-    def sample(self, params) -> Dict[str, np.ndarray]:
-        """Collect one fragment; returns flattened (T*E, ...) arrays
-        with GAE advantages and value targets."""
+    def sample(self, params, raw: bool = False) -> Dict[str, np.ndarray]:
+        """Collect one fragment.
+
+        ``raw=False`` (PPO shape): flattened (T*E, ...) arrays with
+        GAE advantages and value targets.
+        ``raw=True`` (IMPALA shape): time-major (T, E, ...) obs /
+        actions / behavior logp / rewards / dones + bootstrap obs —
+        the learner applies V-trace with its own (possibly newer)
+        policy, so no advantages are computed runner-side."""
         T, E = self.rollout_len, self.num_envs
         obs_buf = np.zeros((T, E) + self._obs.shape[1:], np.float32)
         act_buf = np.zeros((T, E), np.int32)
@@ -95,6 +101,15 @@ class EnvRunner:
                     self._episode_return[e] = 0.0
                     nobs, _ = env.reset()
                 self._obs[e] = nobs
+        if raw:
+            completed = self._completed_returns
+            self._completed_returns = []
+            return {
+                "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+                "rewards": rew_buf, "dones": done_buf,
+                "bootstrap_obs": self._obs.copy(),
+                "episode_returns": np.asarray(completed, np.float64),
+            }
         _logits, bootstrap = self._policy(params, self._obs)
         val_buf[T] = bootstrap
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from .batching import batch
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .handle import DeploymentHandle, DeploymentResponse
 
 _CONTROLLER_NAME = "serve_controller"
@@ -186,5 +187,6 @@ def shutdown():
 __all__ = [
     "Application", "Deployment", "DeploymentHandle",
     "DeploymentResponse", "batch", "delete", "deployment",
-    "get_deployment_handle", "run", "shutdown", "status",
+    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
+    "run", "shutdown", "status",
 ]

@@ -122,6 +122,10 @@ class _Router:
         # Keyed by replica actor id so counts survive membership swaps.
         self._outstanding: Dict[Any, int] = {
             self._key(r): 0 for r in self._replicas}
+        # model_id -> replica key: multiplexed requests prefer the
+        # replica already holding their model (pow_2_scheduler.py:52
+        # model-affinity tier; client-local view).
+        self._model_affinity: Dict[str, Any] = {}
         self._last_refresh = time.monotonic()
 
     @staticmethod
@@ -157,9 +161,13 @@ class _Router:
                 fresh[k] = self._outstanding.get(k, 0)
             self._outstanding = fresh
 
-    def pick(self):
-        """Power-of-two-choices on outstanding counts; returns
-        (replica, key)."""
+    # A model-affine replica is used unless it's this much busier than
+    # the least-loaded one (load still wins over cache warmth past it).
+    _AFFINITY_SLACK = 8
+
+    def pick(self, model_id: str = ""):
+        """Power-of-two-choices on outstanding counts, with a model-
+        affinity tier for multiplexed requests; returns (replica, key)."""
         self._maybe_refresh()
         with self._lock:
             n = len(self._replicas)
@@ -167,6 +175,17 @@ class _Router:
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no live "
                     f"replicas")
+            if model_id:
+                by_key = {self._key(r): r for r in self._replicas}
+                k = self._model_affinity.get(model_id)
+                if k in by_key:
+                    least = min(self._outstanding.get(self._key(r), 0)
+                                for r in self._replicas)
+                    if (self._outstanding.get(k, 0)
+                            <= least + self._AFFINITY_SLACK):
+                        self._outstanding[k] = \
+                            self._outstanding.get(k, 0) + 1
+                        return by_key[k], k
             if n == 1:
                 idx = 0
             else:
@@ -177,6 +196,8 @@ class _Router:
                     self._outstanding.get(kb, 0) else b
             replica = self._replicas[idx]
             k = self._key(replica)
+            if model_id:
+                self._model_affinity[model_id] = k
             self._outstanding[k] = self._outstanding.get(k, 0) + 1
             return replica, k
 
@@ -190,12 +211,13 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str, replicas: List[Any],
                  method_name: str = "", controller=None,
                  version: int = -1, _router: Optional[_Router] = None,
-                 stream: bool = False):
+                 stream: bool = False, multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._router = _router or _Router(deployment_name, replicas,
                                           controller, version)
         self._method = method_name
         self._stream = stream
+        self._model_id = multiplexed_model_id
 
     # -- calls -------------------------------------------------------------
     def remote(self, *args, **kwargs):
@@ -226,11 +248,11 @@ class DeploymentHandle:
         returns a DeploymentResponseGenerator yielding values as the
         replica yields them (cross-node: streaming-generator item
         reporting)."""
-        replica, key = self._router.pick()
+        replica, key = self._router.pick(self._model_id)
         try:
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
-                self._method, args, kwargs)
+                self._method, args, kwargs, self._model_id)
         except BaseException:
             self._router.release(key)
             raise
@@ -238,10 +260,10 @@ class DeploymentHandle:
             gen, on_done=lambda: self._router.release(key))
 
     def _issue(self, args, kwargs):
-        replica, key = self._router.pick()
+        replica, key = self._router.pick(self._model_id)
         try:
             ref = replica.handle_request.remote(self._method, args,
-                                                kwargs)
+                                                kwargs, self._model_id)
         except BaseException:
             # e.g. PendingCallsLimitExceededError: give the slot back or
             # the router is permanently biased away from this replica.
@@ -259,14 +281,19 @@ class DeploymentHandle:
         return ref, release_once
 
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
         # Views share the router, so balance and membership are global
         # across method-scoped views of the same handle.
         return DeploymentHandle(
             self.deployment_name, [],
             method_name if method_name is not None else self._method,
             _router=self._router,
-            stream=self._stream if stream is None else stream)
+            stream=self._stream if stream is None else stream,
+            multiplexed_model_id=(self._model_id
+                                  if multiplexed_model_id is None
+                                  else multiplexed_model_id))
 
     @property
     def method(self):

@@ -416,11 +416,16 @@ class LLMServer:
         pending = None  # (toks_device, [(slot, req)], k) in flight
         try:
             while not self._stop.is_set():
+                # Prefill-priority admission: queued prompts' prefill
+                # calls enqueue on the device BEFORE the next decode
+                # chunk, so a freed slot's first token isn't serialized
+                # behind another 16-token decode of everyone else
+                # (saturated-TTFT tail, r4 verdict weak #7).
+                self._admit_wave()
                 launched = self._launch_chunk()
                 if pending is not None:
                     self._process(pending)  # overlaps the launched chunk
                 self._harvest_prefills()
-                self._admit_wave()
                 pending = launched
                 if pending is None and not any(
                         r is not None for r in self.slot_req):

@@ -31,8 +31,12 @@ class Replica:
         self._num_ongoing = 0
 
     async def handle_request(self, method: str, args: Tuple,
-                             kwargs: Dict[str, Any]):
+                             kwargs: Dict[str, Any],
+                             multiplexed_model_id: str = ""):
+        from .multiplex import _reset_model_id, _set_model_id
+
         self._num_ongoing += 1
+        token = _set_model_id(multiplexed_model_id)
         try:
             if method:
                 fn = getattr(self._instance, method)
@@ -43,14 +47,19 @@ class Replica:
                 out = await out
             return out
         finally:
+            _reset_model_id(token)
             self._num_ongoing -= 1
 
     async def handle_request_streaming(self, method: str, args: Tuple,
-                                       kwargs: Dict[str, Any]):
+                                       kwargs: Dict[str, Any],
+                                       multiplexed_model_id: str = ""):
         """Generator endpoint: the user method yields items, forwarded
         through the actor streaming-generator machinery (reference:
         replica streaming + proxy_response_generator.py)."""
+        from .multiplex import _reset_model_id, _set_model_id
+
         self._num_ongoing += 1
+        token = _set_model_id(multiplexed_model_id)
         try:
             fn = getattr(self._instance, method) if method \
                 else self._instance
@@ -62,6 +71,7 @@ class Replica:
                 for item in out:
                     yield item
         finally:
+            _reset_model_id(token)
             self._num_ongoing -= 1
 
     async def num_ongoing_requests(self) -> int:
