@@ -1,0 +1,235 @@
+"""Web dashboard: one HTTP head serving cluster state.
+
+Reference: python/ray/dashboard/head.py:61 + its module system (29.4k
+LoC of aiohttp handlers, per-node agents, a React frontend).  The
+TPU-native cut: ONE threaded stdlib HTTP server in the driver/head
+process, JSON APIs straight off the state API + head tables, a
+Prometheus passthrough, a Chrome-timeline download, and a single
+self-contained HTML page that polls the JSON — no build step, no
+per-node agents (per-node state arrives through heartbeats and the
+log-tail RPC the CLI already uses).
+
+Endpoints:
+  /                 HTML overview (auto-refreshing)
+  /api/cluster      summary (nodes, resources, tasks)
+  /api/nodes        node table
+  /api/actors       actor table
+  /api/tasks        pending tasks + summary
+  /api/objects      object-store entries
+  /api/jobs         job table
+  /api/serve        serve app status
+  /api/memory       object store stats per node
+  /api/timeline     Chrome trace JSON (open in perfetto)
+  /metrics          Prometheus text exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 1.5rem;
+        background: #fafafa; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.4rem; }
+ table { border-collapse: collapse; font-size: .85rem; min-width: 40rem; }
+ th, td { border: 1px solid #ddd; padding: .3rem .6rem; text-align: left; }
+ th { background: #f0f0f0; }
+ .pill { display: inline-block; padding: 0 .5rem; border-radius: 1rem;
+         background: #e8f4e8; }
+ .dead { background: #f8e0e0; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="summary"></div>
+<h2>Nodes</h2><div id="nodes"></div>
+<h2>Actors</h2><div id="actors"></div>
+<h2>Jobs</h2><div id="jobs"></div>
+<h2>Serve</h2><div id="serve"></div>
+<h2>Object store</h2><div id="memory"></div>
+<p><a href="/api/timeline">timeline</a> · <a href="/metrics">metrics</a></p>
+<script>
+function esc(v) {
+  return String(v).replace(/[&<>"']/g, ch => ({"&": "&amp;",
+    "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[ch]));
+}
+function table(rows, cols) {
+  if (!rows || !rows.length) return "<i>none</i>";
+  cols = cols || Object.keys(rows[0]);
+  let h = "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("")
+    + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c =>
+      `<td>${esc(typeof r[c] === "object" ? JSON.stringify(r[c])
+                 : r[c])}</td>`
+    ).join("") + "</tr>";
+  return h + "</table>";
+}
+async function refresh() {
+  try {
+    const [cl, nodes, actors, jobs, serve, mem] = await Promise.all(
+      ["cluster", "nodes", "actors", "jobs", "serve", "memory"].map(
+        p => fetch("/api/" + p).then(r => r.json())));
+    document.getElementById("summary").innerHTML =
+      `<span class="pill">${cl.num_nodes} nodes</span> ` +
+      `<span class="pill">${cl.num_actors} actors</span> ` +
+      `<span class="pill">tasks: ${JSON.stringify(cl.tasks)}</span>`;
+    document.getElementById("nodes").innerHTML = table(nodes);
+    document.getElementById("actors").innerHTML = table(actors);
+    document.getElementById("jobs").innerHTML = table(jobs);
+    document.getElementById("serve").innerHTML = table(serve);
+    document.getElementById("memory").innerHTML =
+      table(Array.isArray(mem) ? mem : [mem]);
+  } catch (e) { console.error(e); }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def _collect(path: str):
+    """One JSON payload per API path, computed against the live
+    runtime (state API + head tables)."""
+    from ..core.runtime import get_runtime
+    from ..util import state
+
+    rt = get_runtime()
+    if path == "cluster":
+        nodes = state.list_nodes()
+        # Cluster-wide aggregation (the CLI attaches with num_cpus=0,
+        # so the DRIVER's local resources would render as {}).
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in nodes:
+            for k, v in (n.get("Resources") or n.get("total")
+                         or {}).items():
+                total[k] = total.get(k, 0) + v
+            for k, v in (n.get("available") or {}).items():
+                avail[k] = avail.get(k, 0) + v
+        if not nodes:
+            total = rt.node_resources.total
+            avail = rt.node_resources.available()
+        return {
+            "num_nodes": len(nodes),
+            "num_actors": len(state.list_actors()),
+            "tasks": state.summarize_tasks(),
+            "resources": {"total": total, "available": avail},
+        }
+    if path == "nodes":
+        return state.list_nodes()
+    if path == "actors":
+        return state.list_actors()
+    if path == "tasks":
+        return {"pending": state.list_tasks(),
+                "summary": state.summarize_tasks()}
+    if path == "objects":
+        return state.list_objects()
+    if path == "jobs":
+        try:
+            from ..job import list_jobs
+
+            return list_jobs()
+        except Exception:
+            return []  # no cluster attached / no jobs table
+    if path == "serve":
+        try:
+            from .. import serve
+
+            st = serve.status()
+            return [{"deployment": name, **info}
+                    for name, info in st.items()]
+        except Exception:
+            return []
+    if path == "memory":
+        out = [{"node": "driver", **rt.plasma.stats(),
+                "store_objects":
+                    rt.object_store.stats()["num_objects"]}]
+        return out
+    raise KeyError(path)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        try:
+            self.path = self.path.split("?", 1)[0]
+            if self.path in ("/", "/index.html"):
+                return self._send(200, _PAGE.encode(),
+                                  "text/html; charset=utf-8")
+            if self.path == "/metrics":
+                from ..observability.metrics import prometheus_text
+
+                return self._send(200, prometheus_text().encode(),
+                                  "text/plain; version=0.0.4")
+            if self.path == "/api/timeline":
+                from ..observability.timeline import export_timeline
+
+                body = json.dumps(export_timeline(None)).encode()
+                return self._send(200, body, "application/json")
+            if self.path.startswith("/api/"):
+                data = _collect(self.path[len("/api/"):])
+                return self._send(200, json.dumps(data).encode(),
+                                  "application/json")
+            return self._send(404, b"not found", "text/plain")
+        except KeyError:
+            return self._send(404, b"unknown api", "text/plain")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            return self._send(500, f"{type(e).__name__}: {e}".encode(),
+                              "text/plain")
+
+
+class Dashboard:
+    """The dashboard HTTP server; runs in the driver/head process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.url = "http://%s:%d" % self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"dashboard-{self.url}")
+        self._thread.start()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> Dashboard:
+    global _dashboard
+    if _dashboard is not None:
+        bound_host, bound_port = \
+            _dashboard._server.server_address[:2]
+        if (host, port) not in ((bound_host, bound_port),
+                                (bound_host, 0)):
+            raise RuntimeError(
+                f"dashboard already running at {_dashboard.url}; "
+                f"stop_dashboard() before rebinding to "
+                f"{host}:{port}")
+        return _dashboard
+    _dashboard = Dashboard(host, port)
+    return _dashboard
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

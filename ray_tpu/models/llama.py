@@ -485,7 +485,14 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
     attention_fn = _get_attention_fn(c.attention_impl)
 
-    x = params["embed_tokens"].astype(c.dtype)[tokens]
+    # ZeRO-3 semantics for the lookup: all-gather the fsdp-sharded
+    # embed dim of the table BEFORE the gather.  Without this the
+    # gather's output inherits the table's D-sharding and the SPMD
+    # partitioner falls into "involuntary full rematerialization"
+    # resharding it to (batch, seq) (observed in the 8-way dryrun).
+    emb = with_logical_constraint(
+        params["embed_tokens"].astype(c.dtype), "vocab", None)
+    x = emb[tokens]
     x = with_logical_constraint(x, "batch", "seq", None)
     sin, cos = rope_table(positions, c.head_dim, c.rope_theta)
 

@@ -8,6 +8,7 @@ Commands:
   start --head [--port P] [--storage PATH]      run a head (blocking)
   start --address H:P [--num-cpus N] [...]      run a worker node
   status --address H:P                          cluster summary
+  dashboard --address H:P [--port 8265]         web dashboard
   list (nodes|actors|jobs) --address H:P        state listings
   timeline --address H:P -o trace.json          Chrome-trace export
   memory --address H:P                          object-store stats
@@ -143,6 +144,22 @@ def cmd_logs(args) -> int:
     return 1
 
 
+def cmd_dashboard(args) -> int:
+    """Attach to the cluster and serve the web dashboard
+    (dashboard/head.py:61 analogue) until interrupted."""
+    _connect(args.address)
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard(args.host, args.port)
+    print(f"dashboard at {dash.url} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.shutdown()
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu import job as job_mod
 
@@ -192,6 +209,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="cluster summary")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--address", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("what", choices=["nodes", "actors", "jobs"])

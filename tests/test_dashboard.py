@@ -1,0 +1,81 @@
+"""Web dashboard (reference dashboard/head.py:61): JSON state APIs,
+HTML overview, Prometheus passthrough, timeline download."""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read()
+    return ctype, body
+
+
+def test_dashboard_serves_cluster_state(tmp_path):
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2, name="dashnode")
+    c.connect(num_cpus=2)
+    dash = start_dashboard(port=0)
+    try:
+        @ray_tpu.remote
+        class Pinger:
+            def ping(self):
+                return "pong"
+
+        a = Pinger.options(name="dash-actor").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+        ctype, body = _get(dash.url + "/")
+        assert "text/html" in ctype and b"ray_tpu dashboard" in body
+
+        _ctype, body = _get(dash.url + "/api/cluster")
+        cluster = json.loads(body)
+        assert cluster["num_nodes"] >= 2
+        assert cluster["num_actors"] >= 1
+
+        _ctype, body = _get(dash.url + "/api/nodes")
+        nodes = json.loads(body)
+        assert len(nodes) >= 2
+
+        _ctype, body = _get(dash.url + "/api/actors")
+        actors = json.loads(body)
+        assert any("Pinger" in str(a_.get("class", "")) or
+                   a_.get("name") == "dash-actor" for a_ in actors)
+
+        ctype, body = _get(dash.url + "/metrics")
+        assert b"ray_tpu" in body or body == b""
+
+        _ctype, body = _get(dash.url + "/api/timeline")
+        assert isinstance(json.loads(body), list)
+
+        _ctype, body = _get(dash.url + "/api/memory")
+        mem = json.loads(body)
+        assert "num_objects" in mem[0]
+
+        # Unknown API → 404, not a crash.
+        try:
+            _get(dash.url + "/api/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        stop_dashboard()
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_dashboard_local_mode(ray_start_regular):
+    dash = start_dashboard(port=0)
+    try:
+        _ctype, body = _get(dash.url + "/api/cluster")
+        assert json.loads(body)["tasks"] is not None
+        _ctype, body = _get(dash.url + "/api/jobs")
+        assert json.loads(body) == []
+    finally:
+        stop_dashboard()
