@@ -1,0 +1,12 @@
+"""Mutable-object channels (reference:
+python/ray/experimental/channel/shared_memory_channel.py:159).
+
+The native C++ ring (ray_tpu.native.channel) is the substrate: a
+compiled DAG's same-host actor pairs can move payloads through a
+pre-allocated mutable ring at memcpy speed instead of minting an
+object per pass.  Cross-host edges keep riding the object plane.
+"""
+
+from ray_tpu.native.channel import Channel, ChannelClosed
+
+__all__ = ["Channel", "ChannelClosed"]
