@@ -46,11 +46,11 @@ def _serve_bench(n_requests: int = 256) -> dict:
     from ray_tpu import serve
     from ray_tpu.serve.llm import LLMServer
 
-    # max_slots 96 measured best on v5e (r5): 114.9 req/s / 336 ms
+    # max_slots 112 measured best on v5e (r5): ~112 req/s / ~335 ms
     # saturated p50 TTFT vs 88.4 / 573 at 64 slots (admission waves
     # dominate the saturated tail; 128 slots regresses throughput).
     handle = serve.run(serve.deployment(LLMServer).bind(
-        model_preset="llama_125m", max_slots=96, max_len=256,
+        model_preset="llama_125m", max_slots=112, max_len=256,
         prefill_buckets=(32,), decode_chunk=16))
     try:
         rng = np.random.default_rng(0)
