@@ -67,6 +67,13 @@ CASES = {
     "attn-unroll4-b8": dict(kw={"remat_policy": "attn",
                                 "scan_unroll": 4}, batch=8),
     "full-unroll2-b8": dict(kw={"scan_unroll": 2}, batch=8),
+    "bf16mu-attn-hd128-b12": dict(kw={"remat_policy": "attn",
+                                      "n_heads": 8, "n_kv_heads": 8,
+                                      "head_dim": 128}, batch=12,
+                                  bf16_mu=True),
+    "attn-hd128-b10": dict(kw={"remat_policy": "attn", "n_heads": 8,
+                               "n_kv_heads": 8, "head_dim": 128},
+                           batch=10),
 }
 # Measured r4 (v5e): an "attn_out" save_only_these_names policy (save
 # attention outputs, remat the rest) came out SLOWER than full remat

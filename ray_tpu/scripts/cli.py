@@ -9,6 +9,7 @@ Commands:
   start --address H:P [--num-cpus N] [...]      run a worker node
   status --address H:P                          cluster summary
   dashboard --address H:P [--port 8265]         web dashboard
+  client-proxy --address H:P [--port 10001]     thin-driver proxy
   list (nodes|actors|jobs) --address H:P        state listings
   timeline --address H:P -o trace.json          Chrome-trace export
   memory --address H:P                          object-store stats
@@ -160,6 +161,22 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_client_proxy(args) -> int:
+    """Attach to the cluster and host thin remote drivers
+    (util/client/server/proxier.py analogue) until interrupted."""
+    _connect(args.address)
+    from ray_tpu.util.client import ClientProxyServer
+
+    srv = ClientProxyServer(args.host, args.port)
+    print(f"client proxy at {srv.address} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu import job as job_mod
 
@@ -215,6 +232,13 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("client-proxy",
+                       help="host thin remote drivers")
+    p.add_argument("--address", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10001)
+    p.set_defaults(fn=cmd_client_proxy)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("what", choices=["nodes", "actors", "jobs"])

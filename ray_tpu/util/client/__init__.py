@@ -1,0 +1,214 @@
+"""Thin remote driver ("Ray client").
+
+Reference: python/ray/util/client/worker.py:81 — a laptop-side client
+that drives a cluster through one proxied connection instead of
+joining it (`ray.init("ray://head:10001")`).  Here:
+
+    from ray_tpu.util import client
+    ctx = client.connect("head-host:10001")   # ClientProxyServer addr
+    ref = ctx.put(big_array)
+    double = ctx.remote(lambda x: x * 2)      # functions ship by value
+    out = ctx.get(double.remote(ref))
+    Counter = ctx.remote(CounterClass)
+    c = Counter.remote()
+    ctx.get(c.incr.remote())
+    ctx.disconnect()                          # releases every held ref
+
+Everything crosses ONE socket (array-aware serialization); references
+are opaque tokens held by the proxy until released/disconnected.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, List, Optional
+
+from ray_tpu.cluster.rpc import RpcClient
+from ray_tpu.cluster.serialization import dumps, loads
+
+from .server import ClientProxyServer  # noqa: F401  (re-export)
+
+
+class ClientObjectRef:
+    __slots__ = ("_ctx", "token")
+
+    def __init__(self, ctx: "ClientContext", token: str):
+        self._ctx = ctx
+        self.token = token
+
+    def _wire(self):
+        return {"__client_ref__": self.token}
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.token[:12]})"
+
+
+def _wire_args(args, kwargs):
+    """Refs → wire tokens, recursively through list/tuple/dict
+    containers (a raw ClientObjectRef must never hit cloudpickle: it
+    holds a socket)."""
+    def conv(v):
+        if isinstance(v, ClientObjectRef):
+            return v._wire()
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, tuple):
+            return tuple(conv(x) for x in v)
+        if isinstance(v, list):
+            return [conv(x) for x in v]
+        return v
+
+    return [conv(a) for a in args], {k: conv(v)
+                                     for k, v in kwargs.items()}
+
+
+class ClientRemoteFunction:
+    def __init__(self, ctx: "ClientContext", fn, options=None):
+        self._ctx = ctx
+        self._fn = fn
+        self._options = options or {}
+
+    def options(self, **overrides) -> "ClientRemoteFunction":
+        return ClientRemoteFunction(self._ctx, self._fn,
+                                    {**self._options, **overrides})
+
+    def remote(self, *args, **kwargs):
+        wa, wk = _wire_args(args, kwargs)
+        out = self._ctx._call("client_task", {
+            "fn": self._fn, "args": wa, "kwargs": wk,
+            "options": self._options})
+        if "refs" in out:  # num_returns > 1
+            return [ClientObjectRef(self._ctx, t)
+                    for t in out["refs"]]
+        return ClientObjectRef(self._ctx, out["ref"])
+
+
+class ClientActorMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        h = self._handle
+        wa, wk = _wire_args(args, kwargs)
+        out = h._ctx._call("client_actor_call", {
+            "actor": h._token, "method": self._name,
+            "args": wa, "kwargs": wk})
+        return ClientObjectRef(h._ctx, out["ref"])
+
+
+class ClientActorHandle:
+    def __init__(self, ctx: "ClientContext", token: str):
+        self._ctx = ctx
+        self._token = token
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClientActorMethod(self, name)
+
+
+class ClientActorClass:
+    def __init__(self, ctx: "ClientContext", cls, options=None):
+        self._ctx = ctx
+        self._cls = cls
+        self._options = options or {}
+
+    def options(self, **overrides) -> "ClientActorClass":
+        return ClientActorClass(self._ctx, self._cls,
+                                {**self._options, **overrides})
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        wa, wk = _wire_args(args, kwargs)
+        out = self._ctx._call("client_create_actor", {
+            "cls": self._cls, "args": wa, "kwargs": wk,
+            "options": self._options})
+        return ClientActorHandle(self._ctx, out["actor"])
+
+
+class ClientContext:
+    """One proxied driver session."""
+
+    def __init__(self, address: str):
+        self._rpc = RpcClient(address)
+        self._session = loads(bytes(self._rpc.call(
+            "client_connect", dumps({}))))["session"]
+        self.address = address
+        # Keepalive: the proxy reaps sessions silent past its TTL
+        # (covers clients that die without disconnecting); a ping
+        # every 30s keeps a blocked-in-get session alive.
+        self._closed = threading.Event()
+        threading.Thread(target=self._keepalive, daemon=True,
+                         name=f"client-keepalive-{address}").start()
+
+    def _keepalive(self):
+        while not self._closed.wait(30.0):
+            try:
+                self._rpc.call("client_ping",
+                               dumps({"session": self._session}),
+                               timeout=30.0)
+            except Exception:
+                pass
+
+    def _call(self, method: str, payload: dict,
+              timeout: Optional[float] = 600.0):
+        payload["session"] = self._session
+        out = loads(bytes(self._rpc.call(method, dumps(payload),
+                                         timeout=timeout)))
+        if isinstance(out, dict) and isinstance(
+                out.get("error"), BaseException):
+            raise out["error"]
+        return out
+
+    # ------------------------------------------------------------- API
+    def put(self, value: Any) -> ClientObjectRef:
+        out = self._call("client_put", {"value": value})
+        return ClientObjectRef(self, out["ref"])
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        tokens = [refs.token] if single else [r.token for r in refs]
+        # timeout=None blocks indefinitely, matching get() semantics
+        # (the RPC wait blocks with it; the keepalive thread keeps the
+        # session leased meanwhile).
+        out = self._call("client_get", {"refs": tokens,
+                                        "timeout": timeout},
+                         timeout=None if timeout is None
+                         else timeout + 30.0)
+        values = out["values"]
+        return values[0] if single else values
+
+    def wait(self, refs: List[ClientObjectRef], *, num_returns: int = 1,
+             timeout: Optional[float] = None):
+        out = self._call("client_wait", {
+            "refs": [r.token for r in refs],
+            "num_returns": num_returns, "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30.0)
+        by_token = {r.token: r for r in refs}
+        return ([by_token[t] for t in out["ready"]],
+                [by_token[t] for t in out["not_ready"]])
+
+    def remote(self, fn_or_class, **options):
+        if inspect.isclass(fn_or_class):
+            return ClientActorClass(self, fn_or_class, options)
+        return ClientRemoteFunction(self, fn_or_class, options)
+
+    def kill(self, handle: ClientActorHandle) -> None:
+        self._call("client_kill", {"actor": handle._token})
+
+    def release(self, refs: List[ClientObjectRef]) -> None:
+        self._call("client_release",
+                   {"refs": [r.token for r in refs]})
+
+    def disconnect(self) -> None:
+        self._closed.set()
+        try:
+            self._call("client_disconnect", {})
+        finally:
+            self._rpc.close()
+
+
+def connect(address: str) -> ClientContext:
+    """Connect a thin driver to a ClientProxyServer."""
+    return ClientContext(address)

@@ -1,0 +1,226 @@
+"""Client proxy server: hosts remote thin drivers.
+
+Reference: python/ray/util/client/server/proxier.py + server.py — a
+gRPC service through which a laptop-side "Ray client" drives a cluster
+it cannot join directly (NAT, firewalls, no fat runtime locally).  The
+proxy executes put/get/task/actor operations against its own runtime
+on the clients' behalf and hands back opaque reference tokens.
+
+This build reuses the cluster RPC framing (array-aware two-pickle) —
+one listening port, sessions scoped by a client-chosen id; dropping a
+session releases every reference it holds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.cluster.rpc import RpcServer
+from ray_tpu.cluster.serialization import dumps, loads
+
+
+def _sealed(handler):
+    """Payloads cross as serialization bundles (cloudpickle + extern
+    arrays — lambdas, local classes, and jax/bf16 arrays all work),
+    riding the RPC layer's raw-bytes framing like task bundles do."""
+    def wrapped(wire):
+        return dumps(handler(loads(wire)))
+
+    return wrapped
+
+
+class ClientProxyServer:
+    """Serves thin clients against this process's runtime (the driver
+    or a head-host sidecar)."""
+
+    # A session with no calls (incl. the client's keepalive ping,
+    # every ~30s) for this long is presumed dead and its refs/actors
+    # are released — the proxier's channel-drop cleanup, lease-style.
+    SESSION_TTL_S = 120.0
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001):
+        self._lock = threading.Lock()
+        # session_id -> {token: ObjectRef}
+        self._refs: Dict[str, Dict[str, Any]] = {}
+        # session_id -> {token: ActorHandle}
+        self._actors: Dict[str, Dict[str, Any]] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._stopped = threading.Event()
+        self._server = RpcServer({
+            "client_connect": _sealed(self._connect),
+            "client_disconnect": _sealed(self._disconnect),
+            "client_ping": _sealed(self._ping),
+            "client_put": _sealed(self._put),
+            "client_get": _sealed(self._get),
+            "client_wait": _sealed(self._wait),
+            "client_task": _sealed(self._task),
+            "client_create_actor": _sealed(self._create_actor),
+            "client_actor_call": _sealed(self._actor_call),
+            "client_kill": _sealed(self._kill),
+            "client_release": _sealed(self._release),
+        }, host=host, port=port)
+        self.address = self._server.address
+        threading.Thread(target=self._reap_loop, daemon=True,
+                         name="client-proxy-reaper").start()
+
+    # ------------------------------------------------------------ session
+    def _connect(self, p):
+        sid = uuid.uuid4().hex
+        with self._lock:
+            self._refs[sid] = {}
+            self._actors[sid] = {}
+            self._last_seen[sid] = time.monotonic()
+        return {"session": sid}
+
+    def _ping(self, p):
+        with self._lock:
+            ok = p["session"] in self._refs
+            if ok:
+                self._last_seen[p["session"]] = time.monotonic()
+        return {"ok": ok}
+
+    def _reap_loop(self):
+        while not self._stopped.wait(10.0):
+            cutoff = time.monotonic() - self.SESSION_TTL_S
+            with self._lock:
+                dead = [s for s, t in self._last_seen.items()
+                        if t < cutoff]
+            for sid in dead:
+                self._disconnect({"session": sid})
+
+    def _disconnect(self, p):
+        with self._lock:
+            refs = self._refs.pop(p["session"], {})
+            actors = self._actors.pop(p["session"], {})
+            self._last_seen.pop(p["session"], None)
+        refs.clear()  # drops the proxy's holds; owner GC follows
+        for handle in actors.values():
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+        return {"ok": True}
+
+    def _table(self, p) -> Dict[str, Any]:
+        # Caller must hold self._lock (or tolerate a raced disconnect
+        # orphaning its insert — hence _hold/_lookup lock themselves).
+        refs = self._refs.get(p["session"])
+        if refs is None:
+            raise ValueError(f"unknown client session {p['session']!r}")
+        return refs
+
+    def _touch_locked(self, p):
+        self._last_seen[p["session"]] = time.monotonic()
+
+    def _hold(self, p, ref) -> str:
+        token = uuid.uuid4().hex
+        with self._lock:
+            self._table(p)[token] = ref
+            self._touch_locked(p)
+        return token
+
+    def _lookup(self, p, tokens: List[str]) -> List[Any]:
+        with self._lock:
+            refs = self._table(p)
+            self._touch_locked(p)
+            return [refs[t] for t in tokens]
+
+    def _resolve_args(self, p, args, kwargs):
+        with self._lock:
+            refs = dict(self._table(p))
+            self._touch_locked(p)
+
+        def conv(v):
+            if isinstance(v, dict) and "__client_ref__" in v:
+                return refs[v["__client_ref__"]]
+            if isinstance(v, dict):
+                return {k: conv(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                out = [conv(x) for x in v]
+                return type(v)(out) if isinstance(v, tuple) else out
+            return v
+
+        return tuple(conv(a) for a in args), \
+            {k: conv(v) for k, v in kwargs.items()}
+
+    # ------------------------------------------------------------- objects
+    def _put(self, p):
+        return {"ref": self._hold(p, ray_tpu.put(p["value"]))}
+
+    def _get(self, p):
+        targets = self._lookup(p, p["refs"])
+        try:
+            values = ray_tpu.get(targets, timeout=p.get("timeout"))
+        except BaseException as e:  # noqa: BLE001
+            return {"error": e}
+        return {"values": values}
+
+    def _wait(self, p):
+        by_token = dict(zip(p["refs"], self._lookup(p, p["refs"])))
+        ready, not_ready = ray_tpu.wait(
+            list(by_token.values()),
+            num_returns=p.get("num_returns", 1),
+            timeout=p.get("timeout"))
+        inv = {id(r): t for t, r in by_token.items()}
+        return {"ready": [inv[id(r)] for r in ready],
+                "not_ready": [inv[id(r)] for r in not_ready]}
+
+    def _release(self, p):
+        with self._lock:
+            refs = self._table(p)
+            for t in p["refs"]:
+                refs.pop(t, None)
+            self._touch_locked(p)
+        return {"ok": True}
+
+    # --------------------------------------------------------------- tasks
+    def _task(self, p):
+        args, kwargs = self._resolve_args(p, p["args"], p["kwargs"])
+        fn = ray_tpu.remote(p["fn"])
+        opts = p.get("options") or {}
+        handle = fn.options(**opts) if opts else fn
+        ref = handle.remote(*args, **kwargs)
+        if isinstance(ref, (tuple, list)):  # num_returns > 1
+            return {"refs": [self._hold(p, r) for r in ref]}
+        return {"ref": self._hold(p, ref)}
+
+    # -------------------------------------------------------------- actors
+    def _create_actor(self, p):
+        args, kwargs = self._resolve_args(p, p["args"], p["kwargs"])
+        cls = ray_tpu.remote(p["cls"])
+        opts = p.get("options") or {}
+        handle = (cls.options(**opts) if opts else cls).remote(
+            *args, **kwargs)
+        token = uuid.uuid4().hex
+        with self._lock:
+            actors = self._actors.get(p["session"])
+            if actors is None:
+                # Raced a disconnect: don't leak a running actor.
+                ray_tpu.kill(handle)
+                raise ValueError(
+                    f"client session {p['session']!r} is gone")
+            actors[token] = handle
+            self._touch_locked(p)
+        return {"actor": token}
+
+    def _actor_call(self, p):
+        with self._lock:
+            handle = self._actors[p["session"]][p["actor"]]
+        args, kwargs = self._resolve_args(p, p["args"], p["kwargs"])
+        ref = getattr(handle, p["method"]).remote(*args, **kwargs)
+        return {"ref": self._hold(p, ref)}
+
+    def _kill(self, p):
+        with self._lock:
+            handle = self._actors[p["session"]].pop(p["actor"], None)
+        if handle is not None:
+            ray_tpu.kill(handle)
+        return {"ok": handle is not None}
+
+    def shutdown(self):
+        self._stopped.set()
+        self._server.shutdown()
