@@ -14,7 +14,8 @@ from .env_runner import EnvRunner, EnvRunnerGroup
 from .algorithms.ppo import PPO, PPOConfig
 from .algorithms.dqn import DQN, DQNConfig, ReplayBuffer
 from .algorithms.impala import IMPALA, IMPALAConfig
+from .multi_agent import MultiAgentEnv
 
 __all__ = ["Algorithm", "DQN", "DQNConfig", "EnvRunner",
-           "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "PPO",
-           "PPOConfig", "ReplayBuffer"]
+           "EnvRunnerGroup", "IMPALA", "IMPALAConfig",
+           "MultiAgentEnv", "PPO", "PPOConfig", "ReplayBuffer"]

@@ -142,6 +142,11 @@ class ReplayBuffer:
 @dataclasses.dataclass
 class DQNConfig:
     env: Any = None
+    # Offline RL (reference: rllib/offline/offline_data.py:22):
+    # ``input_path`` trains from logged transitions (parquet/jsonl
+    # written by ``output_path``) instead of env sampling.
+    input_path: Any = None
+    output_path: Any = None
     num_env_runners: int = 2
     num_envs_per_runner: int = 2
     steps_per_round: int = 64
@@ -177,6 +182,11 @@ class DQNConfig:
     def training(self, **kwargs) -> "DQNConfig":
         return dataclasses.replace(self, **kwargs)
 
+    def offline_data(self, *, input_path=None,
+                     output_path=None) -> "DQNConfig":
+        return dataclasses.replace(self, input_path=input_path,
+                                   output_path=output_path)
+
     def build(self) -> "DQN":
         return DQN(self)
 
@@ -201,14 +211,40 @@ class DQN(Algorithm):
         self._update = self._make_update()
         self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim,
                                    config.seed)
-        Runner = ray_tpu.remote(_TransitionRunner)
-        self._factory = lambda i: Runner.remote(
-            config.env, config.num_envs_per_runner,
-            config.steps_per_round, config.seed + 1000 * i,
-            config.hidden)
-        self.runners = [self._factory(i)
-                        for i in range(config.num_env_runners)]
+        self.runners = []
+        if config.input_path is None:
+            Runner = ray_tpu.remote(_TransitionRunner)
+            self._factory = lambda i: Runner.remote(
+                config.env, config.num_envs_per_runner,
+                config.steps_per_round, config.seed + 1000 * i,
+                config.hidden)
+            self.runners = [self._factory(i)
+                            for i in range(config.num_env_runners)]
+        else:
+            self._load_offline(config.input_path)
         self._ep_returns: List[float] = []
+
+    def _load_offline(self, path) -> None:
+        """Fill the replay buffer from a logged-transition dataset
+        (reference: OfflineData feeding the replay buffer)."""
+        from ray_tpu import data as rd
+
+        ds = path if hasattr(path, "iter_blocks") else             rd.read_parquet(path)
+        def mat(col):
+            # Arrow list columns arrive as object arrays of row lists.
+            return np.stack([np.asarray(r, np.float32)
+                             for r in col]).reshape(-1, self.obs_dim)
+
+        for block in ds.iter_blocks():
+            self.buffer.add_batch({
+                "obs": mat(block["obs"]),
+                "actions": np.asarray(block["actions"], np.int32),
+                "rewards": np.asarray(block["rewards"], np.float32),
+                "next_obs": mat(block["next_obs"]),
+                "dones": np.asarray(block["dones"], np.float32),
+            })
+        if len(self.buffer) == 0:
+            raise ValueError(f"offline input {path!r} had no rows")
 
     def _make_update(self):
         import jax
@@ -267,6 +303,8 @@ class DQN(Algorithm):
                 continue
             self.buffer.add_batch(batch)
             self._ep_returns.extend(batch["episode_returns"].tolist())
+            if cfg.output_path is not None:
+                self._write_transitions(batch)
         self._ep_returns = self._ep_returns[-100:]
 
         loss = float("nan")
@@ -287,6 +325,30 @@ class DQN(Algorithm):
             "epsilon": eps,
             "td_loss": loss,
         }
+
+    def _write_transitions(self, batch) -> None:
+        """Append one parquet file of logged transitions (reference:
+        output API writing experiences for offline consumers)."""
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(self.config.output_path, exist_ok=True)
+        n = len(batch["actions"])
+        table = pa.table({
+            "obs": batch["obs"].reshape(n, -1).tolist(),
+            "actions": batch["actions"],
+            "rewards": batch["rewards"],
+            "next_obs": batch["next_obs"].reshape(n, -1).tolist(),
+            "dones": batch["dones"],
+        })
+        self._out_seq = getattr(self, "_out_seq", 0)
+        pq.write_table(table, os.path.join(
+            self.config.output_path,
+            f"transitions-{self.iteration:05d}-{self._out_seq:03d}"
+            f".parquet"))
+        self._out_seq += 1
 
     def get_state(self) -> Dict[str, Any]:
         import jax

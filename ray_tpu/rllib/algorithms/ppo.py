@@ -79,7 +79,10 @@ class PPO(Algorithm):
 
         # Probe spaces from one local env (reference: the algorithm
         # validates env/spaces at build).
+        from ..multi_agent import MultiAgentEnv
+
         probe = _make_env(config.env)
+        self._multi_agent = isinstance(probe, MultiAgentEnv)
         obs_dim = int(np.prod(probe.observation_space.shape))
         n_actions = int(probe.action_space.n)
         probe.close() if hasattr(probe, "close") else None
@@ -96,12 +99,29 @@ class PPO(Algorithm):
 
             self._mesh = build_mesh(config.learner_mesh)
         self._update = self._make_update()
-        self.runners = EnvRunnerGroup(
-            config.env, num_runners=config.num_env_runners,
-            num_envs=config.num_envs_per_runner,
-            rollout_len=config.rollout_fragment_length,
-            gamma=config.gamma, gae_lambda=config.gae_lambda,
-            seed=config.seed, hidden=config.hidden)
+        if self._multi_agent:
+            # Parameter-sharing multi-agent: every agent runs the one
+            # policy; per-agent rows feed the same learner
+            # (multi_agent.py).
+            if config.num_envs_per_runner != PPOConfig.num_envs_per_runner:
+                raise ValueError(
+                    "multi-agent runners hold one env each; "
+                    "num_envs_per_env_runner is not supported — scale "
+                    "with num_env_runners")
+            from ..multi_agent import MultiAgentEnvRunnerGroup
+
+            self.runners = MultiAgentEnvRunnerGroup(
+                config.env, num_runners=config.num_env_runners,
+                rollout_len=config.rollout_fragment_length,
+                gamma=config.gamma, gae_lambda=config.gae_lambda,
+                seed=config.seed, hidden=config.hidden)
+        else:
+            self.runners = EnvRunnerGroup(
+                config.env, num_runners=config.num_env_runners,
+                num_envs=config.num_envs_per_runner,
+                rollout_len=config.rollout_fragment_length,
+                gamma=config.gamma, gae_lambda=config.gae_lambda,
+                seed=config.seed, hidden=config.hidden)
         self._ep_returns: list = []
 
     # -- learner --------------------------------------------------------

@@ -136,18 +136,28 @@ class EnvRunner:
 
 
 class EnvRunnerGroup:
-    """Actor gang of EnvRunners (env_runner_group.py:70)."""
+    """Actor gang of EnvRunners (env_runner_group.py:70).  Subclasses
+    override ``_make_factory`` to swap the runner class; the fault-
+    replacement sampling loop is shared."""
 
     def __init__(self, env_spec, *, num_runners: int, num_envs: int,
                  rollout_len: int, gamma: float, gae_lambda: float,
                  seed: int = 0, hidden=(64, 64),
                  runner_resources: Optional[Dict[str, float]] = None):
-        self._factory = lambda i: ray_tpu.remote(EnvRunner).options(
+        self._factory = self._make_factory(
+            env_spec, num_envs=num_envs, rollout_len=rollout_len,
+            gamma=gamma, gae_lambda=gae_lambda, seed=seed,
+            hidden=hidden, runner_resources=runner_resources)
+        self.runners = [self._factory(i) for i in range(num_runners)]
+
+    @staticmethod
+    def _make_factory(env_spec, *, num_envs, rollout_len, gamma,
+                      gae_lambda, seed, hidden, runner_resources):
+        return lambda i: ray_tpu.remote(EnvRunner).options(
             **(dict(num_cpus=1, resources=runner_resources)
                if runner_resources else {})).remote(
             env_spec, num_envs, rollout_len, gamma, gae_lambda,
             seed + 1000 * i, hidden)
-        self.runners = [self._factory(i) for i in range(num_runners)]
 
     def sample_all(self, params) -> List[Dict[str, np.ndarray]]:
         """One fragment from every runner (parallel).  A failed runner

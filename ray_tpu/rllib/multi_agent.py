@@ -1,0 +1,162 @@
+"""Multi-agent environments with a shared policy.
+
+Reference: rllib/env/multi_agent_env.py — a dict-keyed env protocol
+(per-agent obs/action/reward dicts, ``"__all__"`` in the terminated
+dict ends the episode) driven by policies mapped onto agents.  This
+build covers the workhorse configuration: ALL agents share one policy
+(parameter sharing), the dominant setup for homogeneous-agent
+training, and agents act synchronously (every agent present each
+step).
+
+``PPO`` detects a ``MultiAgentEnv`` at build time and swaps its
+runner group for ``MultiAgentEnvRunnerGroup``; the learner is
+unchanged — per-agent trajectories flatten into the same
+(obs, action, logp, advantage, return) rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .env_runner import EnvRunnerGroup
+
+
+class MultiAgentEnv:
+    """Protocol base (reference: multi_agent_env.py MultiAgentEnv).
+
+    Subclasses define ``possible_agents``, shared
+    ``observation_space``/``action_space``, and:
+
+      reset(seed=None) -> (obs_dict, info_dict)
+      step(action_dict) -> (obs, rewards, terminateds, truncateds,
+                            infos)   # dicts; terminateds["__all__"]
+    """
+
+    possible_agents: List[str] = []
+    observation_space: Any = None
+    action_space: Any = None
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class MultiAgentEnvRunner:
+    """Samples fragments from one MultiAgentEnv under the shared
+    policy; GAE runs per agent stream (each agent is one 'row' of the
+    (T, A) buffers — the single-agent math applies unchanged)."""
+
+    def __init__(self, env_creator: Callable[[], MultiAgentEnv],
+                 rollout_len: int, gamma: float, gae_lambda: float,
+                 seed: int, hidden=(64, 64)):
+        self.env = env_creator()
+        if not isinstance(self.env, MultiAgentEnv):
+            raise TypeError("MultiAgentEnvRunner needs a MultiAgentEnv")
+        self.agents = list(self.env.possible_agents)
+        self.rollout_len = rollout_len
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self.hidden = tuple(hidden)
+        self._rng = np.random.default_rng(seed)
+        obs, _ = self.env.reset(seed=seed)
+        self._obs = self._stack(obs)
+        self._episode_return = 0.0
+        self._completed: List[float] = []
+        self._apply = None
+
+    def _stack(self, obs_dict) -> np.ndarray:
+        return np.stack([np.asarray(obs_dict[a], np.float32)
+                         for a in self.agents])
+
+    def _policy(self, params, obs):
+        import jax
+
+        from .models import apply_actor_critic
+
+        if self._apply is None:
+            self._apply = jax.jit(apply_actor_critic)
+        logits, value = self._apply(params, obs)
+        return np.asarray(logits), np.asarray(value)
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        T, A = self.rollout_len, len(self.agents)
+        obs_buf = np.zeros((T, A) + self._obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, A), np.int32)
+        logp_buf = np.zeros((T, A), np.float32)
+        rew_buf = np.zeros((T, A), np.float32)
+        done_buf = np.zeros((T, A), np.float32)
+        val_buf = np.zeros((T + 1, A), np.float32)
+
+        for t in range(T):
+            logits, value = self._policy(params, self._obs)
+            z = logits - logits.max(-1, keepdims=True)
+            logp_all = z - np.log(np.exp(z).sum(-1, keepdims=True))
+            g = self._rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + g, axis=-1)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = np.take_along_axis(
+                logp_all, actions[:, None], axis=-1)[:, 0]
+            val_buf[t] = value
+            action_dict = {a: int(actions[i])
+                           for i, a in enumerate(self.agents)}
+            nobs, rews, terms, truncs, _ = self.env.step(action_dict)
+            rew_buf[t] = [float(rews.get(a, 0.0)) for a in self.agents]
+            self._episode_return += float(sum(rews.values()))
+            over = terms.get("__all__", False) or \
+                truncs.get("__all__", False)
+            if over:
+                done_buf[t] = 1.0
+                self._completed.append(self._episode_return)
+                self._episode_return = 0.0
+                nobs, _ = self.env.reset()
+            self._obs = self._stack(nobs)
+        _l, bootstrap = self._policy(params, self._obs)
+        val_buf[T] = bootstrap
+
+        adv = np.zeros((T, A), np.float32)
+        last = np.zeros(A, np.float32)
+        for t in reversed(range(T)):
+            nonterm = 1.0 - done_buf[t]
+            delta = (rew_buf[t] + self.gamma * val_buf[t + 1] * nonterm
+                     - val_buf[t])
+            last = delta + self.gamma * self.gae_lambda * nonterm * last
+            adv[t] = last
+        returns = adv + val_buf[:T]
+
+        completed, self._completed = self._completed, []
+        flat = lambda a: a.reshape((T * A,) + a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs_buf), "actions": flat(act_buf),
+            "logp": flat(logp_buf), "advantages": flat(adv),
+            "returns": flat(returns),
+            "episode_returns": np.asarray(completed, np.float64),
+        }
+
+
+class MultiAgentEnvRunnerGroup(EnvRunnerGroup):
+    """EnvRunnerGroup over MultiAgentEnvRunners — the sampling loop,
+    fault replacement, and shutdown are inherited; only the runner
+    factory differs."""
+
+    def __init__(self, env_creator, *, num_runners: int,
+                 rollout_len: int, gamma: float, gae_lambda: float,
+                 seed: int = 0, hidden=(64, 64)):
+        super().__init__(env_creator, num_runners=num_runners,
+                         num_envs=1, rollout_len=rollout_len,
+                         gamma=gamma, gae_lambda=gae_lambda, seed=seed,
+                         hidden=hidden)
+
+    @staticmethod
+    def _make_factory(env_spec, *, num_envs, rollout_len, gamma,
+                      gae_lambda, seed, hidden, runner_resources):
+        Runner = ray_tpu.remote(MultiAgentEnvRunner)
+        return lambda i: Runner.remote(
+            env_spec, rollout_len, gamma, gae_lambda,
+            seed + 1000 * i, hidden)

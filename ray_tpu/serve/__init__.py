@@ -109,7 +109,8 @@ def _get_controller(create: bool = True):
 
 
 def run(app: Application, *, name: Optional[str] = None,
-        http_port: Optional[int] = None) -> DeploymentHandle:
+        http_port: Optional[int] = None,
+        grpc_port: Optional[int] = None) -> DeploymentHandle:
     """Deploy an application; returns its handle
     (reference: serve.run, api.py:492)."""
     import ray_tpu
@@ -127,6 +128,8 @@ def run(app: Application, *, name: Optional[str] = None,
     handle = get_deployment_handle(dep.name)
     from . import http_proxy
 
+    from . import grpc_proxy
+
     live = http_proxy.proxy_handles()
     if live is not None:
         # A redeploy replaced the replicas; refresh the running
@@ -135,11 +138,21 @@ def run(app: Application, *, name: Optional[str] = None,
         # get_deployment_handle — reference handles refresh via
         # long-poll, not implemented here.)
         live[dep.name] = handle
+    grpc_live = grpc_proxy.grpc_proxy_handles()
+    if grpc_live is not None:
+        grpc_live[dep.name] = handle  # same in-place redeploy refresh
     if http_port is not None:
         handles = dict(live or {})
         handles[dep.name] = handle
         port = http_proxy.start_proxy(handles, port=http_port)
         handle.http_port = port
+    if grpc_port is not None:
+        # Seed a restart from BOTH live maps so earlier apps keep
+        # serving whichever ingress they were on.
+        handles = {**(live or {}), **(grpc_live or {})}
+        handles[dep.name] = handle
+        handle.grpc_port = grpc_proxy.start_grpc_proxy(
+            handles, port=grpc_port)
     return handle
 
 
@@ -173,6 +186,12 @@ def shutdown():
     from . import http_proxy
 
     http_proxy.stop_proxy()
+    try:
+        from . import grpc_proxy
+
+        grpc_proxy.stop_grpc_proxy()
+    except Exception:
+        pass
     try:
         controller = _get_controller(create=False)
     except Exception:

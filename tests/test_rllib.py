@@ -175,3 +175,129 @@ def test_dqn_checkpoint_roundtrip(ray_start_regular, tmp_path):
         assert res["training_iteration"] == 2
     finally:
         algo2.stop()
+
+
+def test_dqn_offline_round_trip(ray_start_regular, tmp_path):
+    """Offline RL (reference rllib/offline/offline_data.py:22): online
+    training logs transitions; a fresh algorithm trains purely from
+    the logged dataset — no env runners at all."""
+    from ray_tpu.rllib import DQNConfig
+
+    out_dir = str(tmp_path / "transitions")
+    online = (DQNConfig().environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=4)
+              .training(steps_per_round=128, updates_per_iteration=64,
+                        learn_starts=400, epsilon_decay_iters=6,
+                        target_update_freq=2, lr=1e-3, seed=0)
+              .offline_data(output_path=out_dir)
+              .build())
+    try:
+        for _ in range(10):
+            online.train()
+    finally:
+        online.stop()
+
+    offline = (DQNConfig().environment("CartPole-v1")
+               .training(updates_per_iteration=512, learn_starts=1,
+                         target_update_freq=1, lr=1e-3, seed=1)
+               .offline_data(input_path=out_dir)
+               .build())
+    try:
+        assert offline.runners == []  # never samples an env
+        n0 = len(offline.buffer)
+        assert n0 >= 5000  # the logged corpus loaded
+        losses = [offline.train()["td_loss"] for _ in range(12)]
+        assert all(loss == loss for loss in losses)  # real updates ran
+
+        # The offline-trained policy is meaningfully better than a
+        # fresh random-init policy on the live env.
+        import gymnasium
+        import jax
+        from ray_tpu.rllib.algorithms.dqn import _apply_q
+        import numpy as np
+
+        def rollout(params, episodes=8):
+            env = gymnasium.make("CartPole-v1")
+            total = 0.0
+            for ep in range(episodes):
+                obs, _ = env.reset(seed=100 + ep)
+                done = False
+                while not done:
+                    q = np.asarray(_apply_q(params, obs[None]))[0]
+                    obs, r, term, trunc, _ = env.step(int(q.argmax()))
+                    total += r
+                    done = term or trunc
+            return total / episodes
+
+        from ray_tpu.rllib.algorithms.dqn import _init_q
+
+        fresh = _init_q(jax.random.key(123), offline.obs_dim,
+                        offline.n_actions, (64, 64))
+        # Random-init scores ~10 on CartPole; the offline-trained
+        # policy must be far past it (measured ~85+ by iter 5).
+        assert rollout(offline.params) >= 60 > rollout(fresh) + 20
+    finally:
+        offline.stop()
+
+
+def test_multi_agent_shared_policy_learns(ray_start_regular):
+    """Parameter-sharing PPO over a MultiAgentEnv (reference
+    rllib/env/multi_agent_env.py): two agents, one policy, per-agent
+    rewards; the shared policy learns to match each agent's target."""
+    import gymnasium
+    import numpy as np
+
+    from ray_tpu.rllib import MultiAgentEnv, PPOConfig
+
+    class TargetMatch(MultiAgentEnv):
+        """Each agent sees a one-hot target and is paid for choosing
+        the matching action; 8-step episodes."""
+
+        possible_agents = ["a0", "a1"]
+        observation_space = gymnasium.spaces.Box(0, 1, (4,), np.float32)
+        action_space = gymnasium.spaces.Discrete(4)
+
+        def __init__(self):
+            self._rng = np.random.default_rng(0)
+            self._t = 0
+
+        def _obs(self):
+            self._targets = {a: int(self._rng.integers(0, 4))
+                             for a in self.possible_agents}
+            return {a: np.eye(4, dtype=np.float32)[t]
+                    for a, t in self._targets.items()}
+
+        def reset(self, seed=None):
+            if seed is not None:
+                self._rng = np.random.default_rng(seed)
+            self._t = 0
+            return self._obs(), {}
+
+        def step(self, action_dict):
+            rewards = {a: float(action_dict[a] == self._targets[a])
+                       for a in self.possible_agents}
+            self._t += 1
+            over = self._t >= 8
+            obs = self._obs()
+            return (obs, rewards,
+                    {"__all__": over}, {"__all__": False}, {})
+
+    algo = (PPOConfig()
+            .environment(TargetMatch)
+            .env_runners(num_env_runners=2,
+                         rollout_fragment_length=64)
+            .training(lr=3e-3, entropy_coeff=0.001, num_epochs=4,
+                      minibatch_size=128, seed=0)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(40):
+            res = algo.train()
+            r = res["episode_return_mean"]
+            if r == r:
+                best = max(best, r)
+            if best >= 14.0:  # 16 max (2 agents x 8 steps); random = 4
+                break
+        assert best >= 14.0, f"shared policy failed to learn ({best})"
+    finally:
+        algo.stop()

@@ -374,3 +374,37 @@ class TestMultiplexing:
         from ray_tpu import serve
 
         assert serve.get_multiplexed_model_id() == ""
+
+
+class TestGrpcIngress:
+    """gRPC ingress (reference serve/_private/proxy.py:538): the
+    generic protoless service routes unary and streaming calls to
+    deployment handles."""
+
+    def test_unary_and_streaming(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu import serve
+        from ray_tpu.serve.grpc_proxy import GrpcServeClient
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __call__(self, x):
+                return {"doubled": np.asarray(x) * 2}
+
+            def stream(self, n):
+                for i in range(n):
+                    yield i * 10
+
+        handle = serve.run(Echo.bind(), grpc_port=0)
+        client = GrpcServeClient(f"127.0.0.1:{handle.grpc_port}")
+        try:
+            out = client.call("Echo", np.arange(4))
+            assert out["doubled"].tolist() == [0, 2, 4, 6]
+            items = list(client.call_stream("Echo", 3, method="stream"))
+            assert items == [0, 10, 20]
+            with pytest.raises(KeyError):
+                client.call("Nope", 1)
+        finally:
+            client.close()
+            serve.shutdown()
