@@ -1245,6 +1245,7 @@ class NodeServer:
             "add_pg_capacity": self._add_pg_capacity,
             "remove_pg_capacity": self._remove_pg_capacity,
             "tail_log": self._tail_log,
+            "node_state": self._node_state,
             "ping": lambda p: "pong",
         }, ordered={"actor_call"})
         self.address = self._server.address
@@ -1527,6 +1528,13 @@ class NodeServer:
         except Exception:
             pass
         return {"ok": True}
+
+    def _node_state(self, p):
+        """Per-node task/object listings for the state CLI (the
+        reference aggregates these through per-node agents)."""
+        from ..core.util_state_compat import node_state
+
+        return node_state(self.runtime, p.get("what", "tasks"))
 
     def _tail_log(self, p):
         """Tail this node's log file (reference: the dashboard log

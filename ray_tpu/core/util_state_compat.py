@@ -1,0 +1,20 @@
+"""Node-local state snapshots served over the node RPC
+(``node_state``): the task/object halves of the state API, gathered
+per node by the CLI (reference: util/state backed by per-node agents +
+GCS task events).  Thin shim over ray_tpu.util.state, which reads the
+LOCAL runtime — exactly what a per-node RPC handler wants.
+"""
+
+from __future__ import annotations
+
+
+def node_state(runtime, what: str):
+    from ray_tpu.util import state
+
+    if what == "tasks":
+        return {"pending": state.list_tasks(),
+                "summary": state.summarize_tasks()}
+    if what == "objects":
+        return {"objects": state.list_objects()[:200],
+                "plasma": runtime.plasma.stats()}
+    raise ValueError(f"unknown node_state {what!r}")

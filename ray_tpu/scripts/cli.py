@@ -10,7 +10,7 @@ Commands:
   status --address H:P                          cluster summary
   dashboard --address H:P [--port 8265]         web dashboard
   client-proxy --address H:P [--port 10001]     thin-driver proxy
-  list (nodes|actors|jobs) --address H:P        state listings
+  list (nodes|actors|jobs|tasks|objects) ...    state listings
   timeline --address H:P -o trace.json          Chrome-trace export
   memory --address H:P                          object-store stats
   job (submit|status|logs|stop|list) ...        job control
@@ -93,11 +93,36 @@ def cmd_list(args) -> int:
         from ray_tpu import job as job_mod
 
         rows = job_mod.list_jobs()
+    elif args.what == "tasks":
+        # Task/object tables are per-node runtime state; the head has
+        # no global view (reference: the state API aggregates via
+        # per-node agents).  Gather over the nodes' RPC servers.
+        rows = _gather_node_state(rt, "tasks")
+    elif args.what == "objects":
+        rows = _gather_node_state(rt, "objects")
     else:
         print(f"unknown listing {args.what!r}", file=sys.stderr)
         return 2
     print(json.dumps(rows, indent=2, default=str))
     return 0
+
+
+def _gather_node_state(rt, what: str):
+    """Per-node task/object state over the node RPC servers (the
+    driver's own runtime is empty — it just connected)."""
+    out = []
+    for n in rt.cluster.list_nodes():
+        if not n.get("alive"):
+            continue
+        try:
+            resp = rt.cluster.pool.get(n["address"]).call(
+                "node_state", {"what": what}, timeout=30.0)
+            out.append({"node": n.get("name") or n["node_id"][:12],
+                        what: resp})
+        except Exception as e:  # noqa: BLE001
+            out.append({"node": n.get("name") or n["node_id"][:12],
+                        "error": str(e)})
+    return out
 
 
 def cmd_timeline(args) -> int:
@@ -241,7 +266,8 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_client_proxy)
 
     p = sub.add_parser("list", help="list cluster state")
-    p.add_argument("what", choices=["nodes", "actors", "jobs"])
+    p.add_argument("what", choices=["nodes", "actors", "jobs",
+                                    "tasks", "objects"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
 

@@ -80,3 +80,126 @@ def stop_proxy():
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
+
+
+# --------------------------------------------------------------------------
+# Per-node proxy fleet (reference: serve/_private/proxy_state.py — one
+# health-checked, drainable proxy per node behind an external LB, so
+# ingress survives any single node's death).
+# --------------------------------------------------------------------------
+
+class ProxyActor:
+    """One HTTP ingress on its node; resolves deployment handles
+    inside its own process so routing state is node-local."""
+
+    def __init__(self, deployment_names, port: int = 0):
+        from . import get_deployment_handle
+
+        handles = {n: get_deployment_handle(n)
+                   for n in deployment_names}
+        import ray_tpu
+
+        rt = ray_tpu.get_runtime()
+        host = "127.0.0.1"
+        if rt.cluster is not None:
+            host = rt.cluster.address.rsplit(":", 1)[0]
+        self._proxy = _Proxy(host, port, handles)
+        self._host = host
+        self._draining = False
+
+    def address(self) -> str:
+        return f"{self._host}:{self._proxy.port}"
+
+    def healthy(self) -> bool:
+        return not self._draining
+
+    def refresh(self, deployment_names) -> bool:
+        """Redeploy/membership refresh: REBUILD the routing table —
+        deployments absent from the list stop being routable (deleted
+        apps must 404, not hit dead replicas)."""
+        from . import get_deployment_handle
+
+        fresh = {n: get_deployment_handle(n) for n in deployment_names}
+        self._proxy.handles.clear()
+        self._proxy.handles.update(fresh)
+        return True
+
+    def drain(self) -> bool:
+        """Stop accepting (reference: draining before node removal).
+        In-flight requests finish; the LB health check goes false."""
+        self._draining = True
+        self._proxy.shutdown()
+        return True
+
+
+class ProxyFleet:
+    """One ProxyActor per alive node (NodeAffinity-pinned)."""
+
+    def __init__(self, deployment_names, port: int = 0):
+        import ray_tpu
+        from ray_tpu.core.task_spec import (
+            NodeAffinitySchedulingStrategy)
+
+        rt = ray_tpu.get_runtime()
+        Actor = ray_tpu.remote(ProxyActor)
+        self.proxies = {}
+        nodes = (rt.cluster.list_nodes() if rt.cluster is not None
+                 else [])
+        alive = [n for n in nodes if n.get("alive")]
+        if not alive:
+            self.proxies["local"] = Actor.remote(
+                list(deployment_names), port)
+        else:
+            for n in alive:
+                self.proxies[n["node_id"]] = Actor.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=n["node_id"], soft=False),
+                    num_cpus=0).remote(list(deployment_names), port)
+        self.address_of = dict(zip(
+            self.proxies,
+            ray_tpu.get([p.address.remote()
+                         for p in self.proxies.values()], timeout=60)))
+        self.addresses = list(self.address_of.values())
+
+    def healthy_addresses(self):
+        """The LB target list: addresses whose proxy answers healthy.
+        All probes fly in parallel, so the poll costs ONE timeout even
+        with several dead nodes."""
+        import ray_tpu
+
+        refs = {nid: p.healthy.remote()
+                for nid, p in self.proxies.items()}
+        out = []
+        for nid, ref in refs.items():
+            try:
+                if ray_tpu.get(ref, timeout=5):
+                    out.append(self.address_of[nid])
+            except Exception:
+                pass  # dead node's proxy: excluded
+        return out
+
+    def drain(self, node_id: str) -> bool:
+        p = self.proxies.get(node_id)
+        if p is None:
+            return False
+        import ray_tpu
+
+        return ray_tpu.get(p.drain.remote(), timeout=30)
+
+    def shutdown(self):
+        import ray_tpu
+
+        # Drain first: kill() alone leaves each ThreadingHTTPServer's
+        # daemon thread bound and serving in its node process.
+        drains = [p.drain.remote() for p in self.proxies.values()]
+        for ref in drains:
+            try:
+                ray_tpu.get(ref, timeout=30)
+            except Exception:
+                pass
+        for p in self.proxies.values():
+            try:
+                ray_tpu.kill(p)
+            except Exception:
+                pass
+        self.proxies = {}

@@ -408,3 +408,62 @@ class TestGrpcIngress:
         finally:
             client.close()
             serve.shutdown()
+
+
+class TestProxyFleet:
+    """Per-node ingress proxies (reference serve/_private/
+    proxy_state.py): every node serves HTTP; draining one removes it
+    from the healthy set while the rest keep serving."""
+
+    def test_per_node_proxies_and_drain(self):
+        import json
+        import urllib.request
+
+        import ray_tpu
+        from ray_tpu import serve
+        from ray_tpu.cluster.cluster_utils import Cluster
+        from ray_tpu.serve.http_proxy import ProxyFleet
+
+        ray_tpu.shutdown()
+        c = Cluster()
+        c.add_node(num_cpus=2, name="px0")
+        c.add_node(num_cpus=2, name="px1")
+        c.connect(num_cpus=2)
+        try:
+            @serve.deployment(num_replicas=2)
+            class Hello:
+                def __call__(self, payload):
+                    return {"hello": payload}
+
+            serve.run(Hello.bind())
+            fleet = ProxyFleet(["Hello"])
+            try:
+                assert len(fleet.addresses) == 3  # driver + 2 workers
+                # Every node's proxy serves.
+                for addr in fleet.healthy_addresses():
+                    req = urllib.request.Request(
+                        f"http://{addr}/Hello",
+                        data=json.dumps("x").encode(),
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        out = json.loads(r.read())
+                    assert out["result"] == {"hello": "x"}
+                # Drain one: it leaves the healthy set; others serve.
+                victim = next(iter(fleet.proxies))
+                assert fleet.drain(victim)
+                healthy = fleet.healthy_addresses()
+                assert len(healthy) == 2
+                addr = healthy[0]
+                req = urllib.request.Request(
+                    f"http://{addr}/Hello",
+                    data=json.dumps("y").encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    assert json.loads(r.read())["result"] == {
+                        "hello": "y"}
+            finally:
+                fleet.shutdown()
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()
+            c.shutdown()
