@@ -175,27 +175,36 @@ def nodes():
     }]
 
 
+def _sum_view(rt, key: str) -> Dict[str, float]:
+    """Aggregate over the heartbeat-synced resource view (ray_syncer
+    role: no head RPC on the hot path); falls back to one list_nodes
+    RPC when the view is stale."""
+    view = rt.cluster.resource_view()
+    total: Dict[str, float] = {}
+    if view is not None:
+        for rec in view.values():
+            if rec["alive"]:
+                for k, v in rec.get(key, {}).items():
+                    total[k] = total.get(k, 0) + v
+        return total
+    for n in rt.cluster.list_nodes():
+        if n["alive"]:
+            for k, v in n[key].items():
+                total[k] = total.get(k, 0) + v
+    return total
+
+
 def cluster_resources() -> Dict[str, float]:
     rt = get_runtime()
     if rt.cluster is not None:
-        total: Dict[str, float] = {}
-        for n in rt.cluster.list_nodes():
-            if n["alive"]:
-                for k, v in n["total"].items():
-                    total[k] = total.get(k, 0) + v
-        return total
+        return _sum_view(rt, "total")
     return rt.node_resources.total
 
 
 def available_resources() -> Dict[str, float]:
     rt = get_runtime()
     if rt.cluster is not None:
-        total: Dict[str, float] = {}
-        for n in rt.cluster.list_nodes():
-            if n["alive"]:
-                for k, v in n["available"].items():
-                    total[k] = total.get(k, 0) + v
-        return total
+        return _sum_view(rt, "available")
     return rt.node_resources.available()
 
 

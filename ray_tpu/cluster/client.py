@@ -88,6 +88,13 @@ class ClusterClient:
         self._stopped = threading.Event()
         # (expiry, demand) of the last failed spill placement.
         self._spill_noroom = (0.0, {})
+        # Synced cluster resource view (ray_syncer.h:83, hub-routed):
+        # availability piggybacks on every heartbeat reply; totals
+        # arrive when membership changes.  {node_id: {"available",
+        # "total", "alive"}} + a freshness stamp.
+        self._view: Dict[str, Dict[str, Any]] = {}
+        self._view_version = None
+        self._view_stamp = 0.0
 
         self.server = NodeServer(runtime, self)
         self.address = self.server.address
@@ -122,7 +129,9 @@ class ClusterClient:
                 resp = self.head.call("heartbeat", {
                     "node_id": self.node_id,
                     "available": self.runtime.node_resources.available(),
+                    "view_version": self._view_version,
                 }, timeout=5.0)
+                self._absorb_view(resp)
                 if resp.get("reregister"):
                     # The head restarted and lost (or never had) this
                     # node: re-attach (reference: raylets re-register
@@ -143,6 +152,33 @@ class ClusterClient:
                 time.sleep(_HEARTBEAT_S)
             except Exception:
                 traceback.print_exc()
+
+    def _absorb_view(self, resp) -> None:
+        view = resp.get("view")
+        if view is None:
+            return
+        totals = resp.get("view_totals")
+        with self._loc_lock:
+            fresh = {}
+            for nid, rec in view.items():
+                old = self._view.get(nid, {})
+                fresh[nid] = {
+                    "available": rec["available"],
+                    "alive": rec["alive"],
+                    "total": (totals or {}).get(
+                        nid, old.get("total", {})),
+                }
+            self._view = fresh
+            self._view_version = resp.get("view_version")
+            self._view_stamp = time.monotonic()
+
+    def resource_view(self, max_age_s: float = 3.0):
+        """The synced cluster resource view, or None if stale (no
+        recent heartbeat reply) — callers fall back to list_nodes."""
+        with self._loc_lock:
+            if time.monotonic() - self._view_stamp > max_age_s:
+                return None
+            return {nid: dict(rec) for nid, rec in self._view.items()}
 
     # ------------------------------------------------------------- pubsub
     def _pubsub_loop(self):

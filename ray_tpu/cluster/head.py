@@ -85,6 +85,9 @@ class HeadServer:
         # pg_id -> {bundles: [...], nodes: [node_id per bundle]}
         self._pgs: Dict[str, Dict[str, Any]] = {}
         self._spread_rr = 0
+        # Bumped on node register/death: heartbeat replies resend the
+        # totals half of the resource view when a node is stale.
+        self._membership_version = 0
         # (monotonic_ts, demand) of recent infeasible placements — the
         # autoscaler's scale-up signal.
         self._unmet_demands: List[Tuple[float, Dict[str, float]]] = []
@@ -195,6 +198,7 @@ class HeadServer:
                           p.get("labels", {}), p.get("name", ""))
         with self._lock:
             self._nodes[p["node_id"]] = entry
+            self._membership_version += 1
         return {"ok": True, "num_nodes": len(self._nodes)}
 
     def _heartbeat(self, p):
@@ -210,11 +214,33 @@ class HeadServer:
                 for k, v in p["add_resources"].items():
                     entry.total[k] = entry.total.get(k, 0) + v
                     entry.available[k] = entry.available.get(k, 0) + v
+                # Totals changed: stale cached views must refetch them.
+                self._membership_version += 1
             if "remove_resources" in p:
                 for k in p["remove_resources"]:
                     entry.total.pop(k, None)
                     entry.available.pop(k, None)
-        return {"ok": True}
+                self._membership_version += 1
+            # Resource-view sync, hub-routed (reference: ray_syncer —
+            # per-node resource views fan out through the GCS hub,
+            # ray_syncer.h:83).  Availability piggybacks on every
+            # periodic reply (the one-off PG-capacity calls carry no
+            # view_version and skip the assembly); totals only when
+            # membership/totals changed since the node's cached
+            # version.  Dead nodes are excluded — they'd otherwise
+            # grow the payload forever under churn.
+            reply = {"ok": True}
+            if "view_version" in p:
+                reply["view"] = {
+                    e.node_id: {"available": dict(e.available),
+                                "alive": True}
+                    for e in self._nodes.values() if e.alive}
+                reply["view_version"] = self._membership_version
+                if p.get("view_version") != self._membership_version:
+                    reply["view_totals"] = {
+                        e.node_id: dict(e.total)
+                        for e in self._nodes.values() if e.alive}
+        return reply
 
     def _drain_node(self, p):
         with self._lock:
@@ -231,6 +257,7 @@ class HeadServer:
             was_alive = entry is not None and entry.alive
             if entry is not None:
                 entry.alive = False
+                self._membership_version += 1
             dead_actors = self._forget_actors_on(p["node_id"])
         if was_alive:
             self._publish_node_death(p["node_id"], entry.address)
@@ -371,6 +398,7 @@ class HeadServer:
                 for e in self._nodes.values():
                     if e.alive and e.last_heartbeat < cutoff:
                         e.alive = False
+                        self._membership_version += 1
                         self._forget_actors_on(e.node_id)
                         dead.append((e.node_id, e.address))
                 if (self._replay_grace_until

@@ -593,3 +593,61 @@ class TestPubsub:
         pub.publish("c", {"i": 5})
         out3 = pub.poll({"c": out["c"]["seq"]}, timeout_s=1.0)
         assert [e["i"] for e in out3["c"]["events"]] == [5]
+
+
+
+def test_heartbeat_synced_resource_view():
+    """ray_syncer role (ray_syncer.h:83, hub-routed): availability
+    piggybacks on heartbeat replies; cluster_resources() answers from
+    the cached view, and a dead node's capacity drops out.  Asserts
+    RELATIVE changes: the in-process head is shared across tests, so
+    absolute totals may include other tests' reaping nodes."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.connect(num_cpus=2)
+    try:
+        rt = ray_tpu.get_runtime()
+
+        def settled_cpu(timeout=40.0):
+            """Wait until two consecutive view reads agree (reaper +
+            heartbeats quiesced), then return the alive-CPU total."""
+            deadline = time.monotonic() + timeout
+            prev = None
+            while time.monotonic() < deadline:
+                view = rt.cluster.resource_view()
+                if view is not None:
+                    cur = sum(rec["total"].get("CPU", 0)
+                              for rec in view.values() if rec["alive"])
+                    if prev is not None and cur == prev:
+                        return cur
+                    prev = cur
+                time.sleep(1.0)
+            return prev
+
+        base = settled_cpu()
+        assert base is not None and base >= 2.0  # driver counted
+
+        p = c.add_node(num_cpus=3, name="rv0")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= base + 3:
+                break
+            time.sleep(0.5)
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= base + 3
+
+        # Kill the worker: its capacity leaves the synced view.
+        p.kill()
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) <= base:
+                break
+            time.sleep(0.5)
+        assert ray_tpu.cluster_resources().get("CPU", 0) <= base
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
