@@ -125,6 +125,59 @@ def _object_plane_bench(size_bytes: int) -> dict:
         c.shutdown()
 
 
+def _dag_roundtrip_bench(n_iters: int = 150) -> dict:
+    """2-actor compiled-DAG ping-pong (64 KiB payload), actors in two
+    worker processes on this host: per-pass round-trip latency with the
+    native shm-channel transport vs the same plan forced onto the
+    object plane (compiled_dag_node.py:691 aDAG data-plane payoff)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2, resources={"d0": 10})
+    c.add_node(num_cpus=2, resources={"d1": 10})
+    c.connect(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Stage:
+            def step(self, x):
+                return x
+
+        def run(**opts):
+            payload = np.zeros(16384, dtype=np.float32)
+            with InputNode() as inp:
+                a = Stage.options(resources={"d0": 1}).bind()
+                b = Stage.options(resources={"d1": 1}).bind()
+                dag = b.step.bind(a.step.bind(inp))
+            compiled = dag.experimental_compile(**opts)
+            for _ in range(15):
+                ray_tpu.get(compiled.execute(payload))
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                ray_tpu.get(compiled.execute(payload))
+            dt = time.perf_counter() - t0
+            used_channels = bool(compiled._channel_edges)
+            compiled.teardown()
+            return dt / n_iters * 1e6, used_channels
+
+        chan_us, used = run()
+        plane_us, _ = run(channel_transport=False)
+        out = {"dag_roundtrip_object_plane_us": round(plane_us, 1)}
+        if used:
+            out["dag_roundtrip_us"] = round(chan_us, 1)
+        else:  # channel lib unavailable: report the fallback number
+            out["dag_roundtrip_us"] = round(plane_us, 1)
+            out["dag_roundtrip_channel_unavailable"] = True
+        return out
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def _broadcast_bench(size_bytes: int, n_nodes: int = 3) -> dict:
     """Push-based broadcast tree (push_manager.h:30 analogue): driver
     fans one object out to ``n_nodes`` workers; aggregate GB/s =
@@ -261,6 +314,12 @@ def main():
             256 * 1024 * 1024 if on_tpu else 32 * 1024 * 1024))
     except Exception as e:  # noqa: BLE001
         extra["broadcast_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: dag roundtrip phase start", file=sys.stderr, flush=True)
+    try:
+        extra.update(_dag_roundtrip_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["dag_roundtrip_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

@@ -54,6 +54,108 @@ def _try_mmap_shm(shm_path, size: int, meta):
         return None  # different host (or raced a free)
 
 
+class _PushStreamSession:
+    """Recipient side of one pipelined push stream: chunks land in a
+    preallocated buffer AND forward to this node's relay children the
+    moment they arrive (the hop never store-and-forwards the payload).
+    ``finish`` seals the buffer into plasma's foreign cache and waits
+    for the whole subtree."""
+
+    def __init__(self, client, oid, owner: str, meta, size: int,
+                 relay: List[str], timeout: float, fanout: int):
+        import struct as _struct
+        import uuid as _uuid
+
+        import numpy as _np
+
+        self._client = client
+        self.oid = oid
+        self.owner = owner
+        self.meta = meta
+        self.size = size
+        self.timeout = timeout
+        self._deadline = time.monotonic() + timeout
+        # np.empty, NOT bytearray: bytearray zero-fills the whole
+        # buffer up front (a second full pass over the payload).
+        self._buf = _np.empty(size, dtype=_np.uint8)
+        self._received = 0
+        self._lock = threading.Lock()
+        self._done = threading.Condition(self._lock)
+        self._off8 = _struct.Struct(">Q")
+        # Open onward sessions NOW (before any chunk arrives), so the
+        # first chunk can relay immediately.
+        self._children: List[Tuple[Any, bytes]] = []
+        self._pending: List[Any] = []
+        groups = [relay[i::fanout] for i in range(fanout)]
+        for group in [g for g in groups if g]:
+            child = client.pool.get(group[0])
+            csid = _uuid.uuid4().hex
+            resp = child.call("push_stream_begin", {
+                "sid": csid, "oid": oid, "owner": owner, "meta": meta,
+                "size": size, "relay": group[1:], "timeout": timeout},
+                timeout=timeout)
+            if not resp.get("ok"):
+                raise ConnectionError(str(resp.get("error")))
+            self._children.append((child, csid.encode()))
+
+    def expired(self) -> bool:
+        return time.monotonic() > self._deadline
+
+    def chunk(self, frame) -> None:
+        import numpy as _np
+
+        view = memoryview(frame)
+        (offset,) = self._off8.unpack(view[32:40])
+        data = view[40:]
+        n = len(data)
+        body = None
+        for child, csid in self._children:
+            if body is None:
+                body = bytes(data)
+            self._pending.append(child.call_async(
+                "push_stream_chunk",
+                b"".join((csid, self._off8.pack(offset), body))))
+        self._buf[offset:offset + n] = _np.frombuffer(data,
+                                                      dtype=_np.uint8)
+        with self._lock:
+            self._received += n
+            if self._received >= self.size:
+                self._done.notify_all()
+
+    def finish(self) -> None:
+        from .serialization import sealed_from_flat
+
+        with self._lock:
+            while self._received < self.size:
+                left = self._deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"push stream for {self.oid!r} stalled at "
+                        f"{self._received}/{self.size} bytes")
+                self._done.wait(left)
+        for call in self._pending:
+            call.result(max(0.1, self._deadline - time.monotonic()))
+        for child, csid in self._children:
+            resp = child.call("push_stream_end",
+                              {"sid": csid.decode()},
+                              timeout=max(
+                                  0.1,
+                                  self._deadline - time.monotonic()))
+            if not resp.get("ok"):
+                raise ConnectionError(str(resp.get("error")))
+        plasma = self._client.runtime.plasma
+        if not plasma.contains(self.oid) \
+                and self.owner != self._client.address:
+            plasma.serve_foreign(self.oid, sealed_from_flat(
+                self.meta, memoryview(self._buf)))
+        self._buf = None
+
+    def abort(self) -> None:
+        self._buf = None
+        self._children = []
+        self._pending = []
+
+
 class ClusterClient:
     """Attached to a Runtime; makes it a cluster node."""
 
@@ -95,6 +197,10 @@ class ClusterClient:
         self._view: Dict[str, Dict[str, Any]] = {}
         self._view_version = None
         self._view_stamp = 0.0
+        # In-flight inbound push-stream sessions (pipelined broadcast):
+        # sid -> _PushStreamSession.
+        self._push_streams: Dict[str, "_PushStreamSession"] = {}
+        self._push_streams_lock = threading.Lock()
 
         self.server = NodeServer(runtime, self)
         self.address = self.server.address
@@ -660,31 +766,27 @@ class ClusterClient:
             return 0
         owner = ref.owner_address() or self.address
         shm_path = self.runtime.plasma.ensure_shm(oid)
-        # Lazy: read the flat bytes only if some recipient can't mmap
-        # the shm file (cross-host).
-        data_cell = [None]
 
-        def get_data():
-            if data_cell[0] is None:
-                data_cell[0] = self.runtime.plasma.read_chunk(
-                    oid, 0, m["size"])
-            return data_cell[0]
+        def get_chunk(offset, length):
+            return self.runtime.plasma.read_chunk(oid, offset, length)
 
         self._relay_push(oid, owner, m["meta"], m["size"], shm_path,
-                         get_data, list(addresses),
+                         get_chunk, list(addresses),
                          max(1, GLOBAL_CONFIG.object_broadcast_fanout()),
                          timeout)
         return len(addresses)
 
     def _relay_push(self, oid, owner: str, meta, size: int,
-                    shm_path: Optional[str], get_data,
+                    shm_path: Optional[str], get_chunk,
                     targets: List[str], fanout: int,
                     timeout: float) -> None:
         """Push to ``fanout`` children, each with its share of the
         remaining targets to relay onward.  Two-phase data: the first
         attempt ships only the shm path (same-host children mmap it —
-        free); a child that can't map it answers need_data and gets the
-        bytes.  A push RPC returns once its subtree stored the copy, so
+        free); a child that can't map it gets a pipelined CHUNK STREAM
+        (push_stream_* protocol) whose chunks relay onward hop-by-hop
+        as they arrive — no store-and-forward of whole payloads.  A
+        push RPC returns once its subtree stored the copy, so
         completion here = subtree completion."""
         groups = [targets[i::fanout] for i in range(fanout)]
         groups = [g for g in groups if g]
@@ -692,17 +794,18 @@ class ClusterClient:
 
         def push_one(group: List[str]):
             try:
-                base = {"oid": oid, "owner": owner, "meta": meta,
-                        "size": size, "shm_path": shm_path,
-                        "relay": group[1:], "timeout": timeout}
                 cl = self.pool.get(group[0])
-                resp = cl.call("push_object", {**base, "data": None},
-                               timeout=timeout) if shm_path else \
-                    {"need_data": True}
+                resp = {"need_data": True}
+                if shm_path:
+                    resp = cl.call("push_object", {
+                        "oid": oid, "owner": owner, "meta": meta,
+                        "size": size, "shm_path": shm_path,
+                        "relay": group[1:], "timeout": timeout,
+                        "data": None}, timeout=timeout)
                 if resp.get("need_data"):
-                    resp = cl.call("push_object",
-                                   {**base, "data": get_data()},
-                                   timeout=timeout)
+                    self._stream_push(cl, oid, owner, meta, size,
+                                      group[1:], timeout, get_chunk)
+                    return
                 if not resp.get("ok"):
                     raise ConnectionError(str(resp.get("error")))
             except BaseException as e:  # noqa: BLE001
@@ -751,16 +854,92 @@ class ClusterClient:
         if relay:
             from ..core.config import GLOBAL_CONFIG
 
-            def get_data():
-                if data is not None:
-                    return data
-                m2 = plasma.wire_meta(oid)
-                return plasma.read_chunk(oid, 0, m2["size"])
+            def get_chunk(offset, length):
+                return plasma.read_chunk(oid, offset, length)
 
             self._relay_push(
-                oid, owner, meta, size, shm_path, get_data, relay,
+                oid, owner, meta, size, shm_path, get_chunk, relay,
                 max(1, GLOBAL_CONFIG.object_broadcast_fanout()), timeout)
         return True
+
+    # ------------------------------------------------ streamed push
+    # Pipelined broadcast data plane (reference: push_manager.h:30 —
+    # chunked pushes with a bounded in-flight window).  A recipient
+    # that cannot mmap the pusher's shm file gets BEGIN / CHUNK* / END:
+    # chunks write into a preallocated buffer AND forward to the
+    # recipient's own relay children as they arrive, so a depth-d tree
+    # streams at ~line rate instead of d serial store-and-forwards.
+
+    def _stream_push(self, cl, oid, owner: str, meta, size: int,
+                     relay: List[str], timeout: float, get_chunk) -> None:
+        import struct as _struct
+        import uuid as _uuid
+
+        from ..core.config import GLOBAL_CONFIG
+
+        sid = _uuid.uuid4().hex
+        resp = cl.call("push_stream_begin", {
+            "sid": sid, "oid": oid, "owner": owner, "meta": meta,
+            "size": size, "relay": relay, "timeout": timeout},
+            timeout=timeout)
+        if not resp.get("ok"):
+            raise ConnectionError(str(resp.get("error")))
+        chunk = max(64 * 1024, GLOBAL_CONFIG.object_chunk_bytes())
+        off8 = _struct.Struct(">Q")
+        sid_b = sid.encode()
+        window: List[Any] = []
+        offset = 0
+        while offset < size:
+            n = min(chunk, size - offset)
+            piece = get_chunk(offset, n)
+            # Raw frame (no pickle): 32-byte sid + 8-byte offset + data.
+            frame = b"".join((sid_b, off8.pack(offset),
+                              piece if isinstance(piece, bytes)
+                              else bytes(piece)))
+            window.append(cl.call_async("push_stream_chunk", frame))
+            if len(window) >= 8:
+                window.pop(0).result(timeout)
+            offset += n
+        for call in window:
+            call.result(timeout)
+        resp = cl.call("push_stream_end", {"sid": sid}, timeout=timeout)
+        if not resp.get("ok"):
+            raise ConnectionError(str(resp.get("error")))
+
+    def _push_stream_begin(self, p) -> dict:
+        from ..core.config import GLOBAL_CONFIG
+
+        session = _PushStreamSession(
+            self, p["oid"], p["owner"], p["meta"], int(p["size"]),
+            list(p.get("relay") or []),
+            float(p.get("timeout") or 600.0),
+            max(1, GLOBAL_CONFIG.object_broadcast_fanout()))
+        with self._push_streams_lock:
+            # Sweep sessions whose sender never finished (deadline
+            # passed) so abandoned streams can't accumulate buffers.
+            stale = [s for s, sess in self._push_streams.items()
+                     if sess.expired()]
+            for s in stale:
+                self._push_streams.pop(s).abort()
+            self._push_streams[p["sid"]] = session
+        return {"ok": True}
+
+    def _push_stream_chunk(self, frame) -> dict:
+        sid = bytes(frame[:32]).decode()
+        with self._push_streams_lock:
+            session = self._push_streams.get(sid)
+        if session is None:
+            raise KeyError(f"no push stream {sid!r}")
+        session.chunk(frame)
+        return {"ok": True}
+
+    def _push_stream_end(self, p) -> dict:
+        with self._push_streams_lock:
+            session = self._push_streams.pop(p["sid"], None)
+        if session is None:
+            raise KeyError(f"no push stream {p['sid']!r}")
+        session.finish()
+        return {"ok": True}
 
     def fetch_object(self, ref) -> None:
         """Pull an object and seal a local copy.  Small values ride the
@@ -1269,12 +1448,17 @@ class NodeServer:
             "create_actor": self._create_actor,
             "actor_call": self._actor_call,
             "actor_ready": self._actor_ready,
+            "actor_info": self._actor_info,
+            "channel_destroy": self._channel_destroy,
             "kill_actor": self._kill_actor,
             "get_object": self._get_object,
             "release_borrower": self._release_borrower,
             "object_meta": self._object_meta,
             "object_chunk": self._object_chunk,
             "push_object": self._push_object,
+            "push_stream_begin": self._push_stream_begin,
+            "push_stream_chunk": self._push_stream_chunk,
+            "push_stream_end": self._push_stream_end,
             "free_primary": self._free_primary,
             "report_object_lost": self._report_object_lost,
             "stream_item": self._stream_item,
@@ -1438,6 +1622,29 @@ class NodeServer:
                                 no_restart=p.get("no_restart", True))
         return {"ok": True}
 
+    def _channel_destroy(self, p):
+        """Close + unlink a channel ring hosted by this node and drop
+        this process's cached endpoints (driver-side CompiledDAG /
+        CrossSlicePipeline teardown reaches remote rings through
+        this)."""
+        from ..experimental.channel import destroy_channel
+
+        destroy_channel(p["path"])
+        return {"ok": True}
+
+    def _actor_info(self, p):
+        """Execution properties of a locally-hosted actor — the channel
+        planner asks these to decide whether an edge may ride a shm
+        ring (experimental.channel.channel_host)."""
+        core = self.runtime.actor_manager.get_core(p["actor_id"])
+        if core is None:
+            return {"found": False}
+        info = core.info
+        return {"found": True,
+                "max_concurrency": info.max_concurrency,
+                "is_async": info.is_async,
+                "isolate": info.isolate}
+
     def _get_object(self, p):
         """Owner-side object service.  Small sealed values ship inline;
         big ones (and values whose primary copy is pinned elsewhere)
@@ -1483,6 +1690,21 @@ class NodeServer:
             if not ok:
                 return {"ok": False, "need_data": True}
             return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _push_stream_begin(self, p):
+        try:
+            return self.client._push_stream_begin(p)
+        except BaseException as e:  # noqa: BLE001
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _push_stream_chunk(self, frame):
+        return self.client._push_stream_chunk(frame)
+
+    def _push_stream_end(self, p):
+        try:
+            return self.client._push_stream_end(p)
         except BaseException as e:  # noqa: BLE001
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 

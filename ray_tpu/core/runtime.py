@@ -567,6 +567,14 @@ class Runtime:
 
     def _lookup_callable(self, spec: TaskSpec, bound_instance):
         if bound_instance is not None and spec.is_actor_task:
+            # Channel-transport trampoline (experimental.channel
+            # CHANNEL_STEP_METHOD): resolves the edge's ring endpoints
+            # inside this actor, runs the real method, tees the result
+            # into the writer rings.
+            if spec.descriptor.function_name == "__rt_channel_step__":
+                from ..experimental.channel import bind_channel_step
+
+                return bind_channel_step(bound_instance)
             return getattr(bound_instance, spec.descriptor.function_name)
         return spec.function
 

@@ -15,39 +15,64 @@ What compiling buys here (TPU-first reading of the same idea):
   ``max_in_flight`` executions overlap (submission backpressure via
   completion callbacks) — the aDAG property that lets a pipeline
   schedule keep every stage busy.
-- The channel role is played by the object plane: in-process consumers
-  share sealed values zero-copy; cross-node consumers pull primary
-  copies over the chunk protocol.  (jax arrays additionally move
-  device-to-device only at true process boundaries.)
+- Same-host actor→actor edges ride the NATIVE CHANNEL data plane
+  (experimental.channel over native/channel.cc): compile pre-plans one
+  shm ring per edge, steady-state passes move payloads writer→reader
+  at memcpy speed with no object minting, no reference-counting
+  traffic.  Rings are sized from the first pass (or the
+  ``channel_slot_bytes`` option); an oversized payload falls back to
+  the object plane per-pass without breaking the plan.  Cross-host,
+  driver-facing, and non-actor edges keep riding the object plane:
+  in-process consumers share sealed values zero-copy; cross-node
+  consumers pull primary copies over the chunk protocol.  (jax arrays
+  additionally move device-to-device only at true process boundaries.)
+
+Options (``experimental_compile(**kw)``): ``channel_transport=True``
+(auto-off when the native lib cannot build), ``channel_slots`` (ring
+depth, default tracks ``max_in_flight``), ``channel_slot_bytes`` (slot
+size hint; default sizes from the first pass), ``channel_timeout``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from .dag_node import (ClassMethodNode, ClassNode, DAGNode, FunctionNode,
                        InputNode, MultiOutputNode)
 
+_NULL_CTX = contextlib.nullcontext()
+
 
 class _Step:
-    __slots__ = ("submit", "arg_plan", "kw_plan", "out_slot")
+    __slots__ = ("submit", "arg_plan", "kw_plan", "out_slot", "node")
 
-    def __init__(self, submit, arg_plan, kw_plan, out_slot):
+    def __init__(self, submit, arg_plan, kw_plan, out_slot, node=None):
         self.submit = submit      # fn(*args, **kwargs) -> ref
         self.arg_plan = arg_plan  # [("const", v) | ("slot", i) | ("input",)]
         self.kw_plan = kw_plan    # {k: same}
         self.out_slot = out_slot
+        self.node = node          # source DAGNode (channel planning)
 
 
 class CompiledDAG:
     def __init__(self, root: DAGNode, max_in_flight: int = 8,
                  **_options):
         self._root = root
-        self._in_flight = threading.Semaphore(max(1, max_in_flight))
+        self._max_in_flight = max(1, max_in_flight)
+        self._in_flight = threading.Semaphore(self._max_in_flight)
+        self._options = _options
         self._slots_of: Dict[int, int] = {}
         self._steps: List[_Step] = []
         self._multi_output: Optional[List[int]] = None
+        # Channel data plane: ring path per same-host actor edge
+        # (producer_step, consumer_step) -> path; torn down with us.
+        self._channel_edges: Dict[Tuple[int, int], str] = {}
+        # path -> endpoint-hosting node addresses (None = this
+        # process); teardown reaches remote rings through these.
+        self._channel_nodes: Dict[str, set] = {}
+        self._submit_order_lock = threading.Lock()
         # (class_node, handle): teardown kills AND clears the node's
         # cached handle so a recompile makes a fresh actor.
         self._actors: List[Tuple[Any, Any]] = []
@@ -95,7 +120,9 @@ class CompiledDAG:
             out_slot = len(self._slots_of)
             self._slots_of[id(node)] = out_slot
             self._steps.append(_Step(
-                self._make_submit(node), arg_plan, kw_plan, out_slot))
+                self._make_submit(node), arg_plan, kw_plan, out_slot,
+                node=node))
+        self._plan_channel_transport()
 
     def _plan_entry(self, v) -> Tuple:
         if isinstance(v, InputNode):
@@ -152,6 +179,125 @@ class CompiledDAG:
             return getattr(actor, node._method_name).remote
         raise TypeError(f"cannot compile node {type(node).__name__}")
 
+    # ----------------------------------------------------- channel plan
+    def _chan_actor(self, node, loc_cache):
+        """(handle, host_key, node_address) if this step can terminate
+        a channel edge, else None.  Two steps with EQUAL host keys
+        share a /dev/shm namespace (same machine), so the edge between
+        them may ride a ring — including edges between two actors that
+        are both remote to the driver but co-located.  node_address
+        (None = this process) is kept so teardown can reach the ring's
+        hosting process."""
+        from ..experimental.channel import channel_location
+
+        if not isinstance(node, ClassMethodNode):
+            return None
+        target = node._target
+        handle = (self._ensure_actor(target)
+                  if isinstance(target, ClassNode) else target)
+        actor_id = getattr(handle, "_actor_id", None)
+        if actor_id is None:
+            return None
+        if actor_id not in loc_cache:
+            loc_cache[actor_id] = channel_location(handle)
+        loc = loc_cache[actor_id]
+        return (handle,) + loc if loc is not None else None
+
+    def _plan_channel_transport(self):
+        """Pre-allocate one shm ring per same-host actor→actor edge and
+        rewrite those steps onto the channel trampoline.  Everything
+        not eligible (cross-host actors, plain tasks, driver-facing
+        outputs) keeps the object-plane plan untouched."""
+        if not self._steps or not self._options.get(
+                "channel_transport", True):
+            return
+        from ..experimental import channel as chx
+
+        if not chx.channels_available():
+            return
+        loc_cache: Dict[Any, Any] = {}
+        actor_of = [self._chan_actor(s.node, loc_cache)
+                    for s in self._steps]
+
+        # Driver-facing outputs must come back as object-plane values.
+        if self._multi_output is not None:
+            terminal = {e[1] for e in self._multi_output
+                        if e[0] == "slot"}
+        else:
+            terminal = {len(self._steps) - 1}
+
+        n_slots = int(self._options.get("channel_slots", 0)) or \
+            max(2, self._max_in_flight)
+        hint = int(self._options.get("channel_slot_bytes", 0))
+        timeout = float(self._options.get(
+            "channel_timeout", chx.DEFAULT_TIMEOUT_S))
+
+        # Edge discovery: (producer_step, consumer_step) once per pair
+        # (a consumer using the same output twice consumes ONE frame).
+        plane_consumers: set = set()   # producers with an object-plane consumer
+        for c_idx, step in enumerate(self._steps):
+            for e in list(step.arg_plan) + list(step.kw_plan.values()):
+                if e[0] != "slot":
+                    continue
+                p_idx = e[1]
+                if actor_of[c_idx] is not None \
+                        and actor_of[p_idx] is not None \
+                        and actor_of[c_idx][1] == actor_of[p_idx][1]:
+                    path = self._channel_edges.setdefault(
+                        (p_idx, c_idx),
+                        chx.channel_path(f"dag{p_idx}-{c_idx}"))
+                    # Endpoint-hosting nodes, for teardown (None =
+                    # this process).
+                    self._channel_nodes.setdefault(path, set()).update(
+                        (actor_of[p_idx][2], actor_of[c_idx][2]))
+                else:
+                    plane_consumers.add(p_idx)
+        if not self._channel_edges:
+            return
+
+        writes_of: Dict[int, list] = {}
+        for (p, c), path in self._channel_edges.items():
+            writes_of.setdefault(p, []).append(
+                chx.writer_spec(path, n_slots, hint, timeout))
+
+        for c_idx, step in enumerate(self._steps):
+            def rewrite(e, c_idx=c_idx):
+                if e[0] == "slot" and (e[1], c_idx) in self._channel_edges:
+                    return ("const", chx.ChannelArg(
+                        self._channel_edges[(e[1], c_idx)], timeout))
+                return e
+
+            step.arg_plan = [rewrite(e) for e in step.arg_plan]
+            step.kw_plan = {k: rewrite(e)
+                            for k, e in step.kw_plan.items()}
+
+        producers = {p for (p, _c) in self._channel_edges}
+        consumers = {c for (_p, c) in self._channel_edges}
+        for idx in producers | consumers:
+            step = self._steps[idx]
+            # A pure channel producer returns a token, not the payload;
+            # anything the driver or an object-plane consumer reads
+            # still comes back as a value.
+            returns_value = (idx in terminal or idx in plane_consumers
+                             or idx not in producers)
+            step.submit = self._make_channel_submit(
+                step.node, tuple(writes_of.get(idx, ())), returns_value)
+
+    def _make_channel_submit(self, node, writes, returns_value):
+        from ..experimental.channel import submit_channel_call
+
+        target = node._target
+        handle = (self._ensure_actor(target)
+                  if isinstance(target, ClassNode) else target)
+        method = node._method_name
+
+        def submit(*args, **kwargs):
+            return submit_channel_call(
+                handle, method, args, kwargs, writes=writes,
+                returns_value=returns_value)
+
+        return submit
+
     # ------------------------------------------------------------ execute
     def execute(self, *input_values) -> Any:
         """Run one pass over the static plan; returns the terminal
@@ -182,12 +328,18 @@ class CompiledDAG:
                 return input_value
 
             ref = None
-            for step in self._steps:
-                args = tuple(resolve(e) for e in step.arg_plan)
-                kwargs = {k: resolve(e)
-                          for k, e in step.kw_plan.items()}
-                ref = step.submit(*args, **kwargs)
-                slots[step.out_slot] = ref
+            # Channel transport matches ring frames to passes by
+            # per-actor FIFO order, so one pass's submissions must not
+            # interleave with another's (concurrent execute callers).
+            # Submissions only enqueue — the lock is held briefly.
+            with self._submit_order_lock if self._channel_edges \
+                    else _NULL_CTX:
+                for step in self._steps:
+                    args = tuple(resolve(e) for e in step.arg_plan)
+                    kwargs = {k: resolve(e)
+                              for k, e in step.kw_plan.items()}
+                    ref = step.submit(*args, **kwargs)
+                    slots[step.out_slot] = ref
             if self._multi_output is not None:
                 out = [resolve(e) for e in self._multi_output]
                 tails = [o for o in out
@@ -234,3 +386,16 @@ class CompiledDAG:
                 if node._handle is handle:
                     node._handle = None
         self._actors = []
+        if self._channel_edges:
+            from ..experimental.channel import destroy_channel_at
+
+            # After the kills so no new frames are produced; destroying
+            # wakes any task still blocked on a ring (ChannelClosed).
+            # Rings hosted by other node processes are destroyed there
+            # (channel_destroy RPC) so their files and cached endpoint
+            # mappings don't outlive the DAG.
+            for path in self._channel_edges.values():
+                destroy_channel_at(path,
+                                   self._channel_nodes.get(path, ()))
+            self._channel_edges = {}
+            self._channel_nodes = {}

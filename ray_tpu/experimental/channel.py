@@ -1,12 +1,468 @@
-"""Mutable-object channels (reference:
+"""Mutable-object channels: the compiled-DAG data plane (reference:
 python/ray/experimental/channel/shared_memory_channel.py:159).
 
-The native C++ ring (ray_tpu.native.channel) is the substrate: a
-compiled DAG's same-host actor pairs can move payloads through a
-pre-allocated mutable ring at memcpy speed instead of minting an
-object per pass.  Cross-host edges keep riding the object plane.
+The native C++ ring (ray_tpu.native.channel) is the substrate; this
+module is the adapter layer that puts it on the hot path:
+
+- **Typed serialization into the ring**: values cross as the same flat
+  wire layout the object plane uses (cluster/serialization.py extern
+  array table), so numpy / jax leaves move as raw bytes and rebuild
+  zero-copy on the reader side.
+- **In-actor endpoint resolution**: a ``ChannelArg`` placeholder in a
+  task's arguments resolves to the edge's reader endpoint inside the
+  executing actor (``__rt_channel_step__`` trampoline, dispatched by
+  ``Runtime._lookup_callable``); writer endpoints create the backing
+  ring lazily, sized from the first pass (or an explicit hint).
+- **Per-pass fallback**: a payload exceeding the ring's slot capacity
+  ships as an object-plane ref inside a tiny ring frame, so one huge
+  pass never breaks the compiled plan.
+- **Error propagation**: a producer failure writes an error frame
+  before re-raising, so blocked consumers fail fast instead of timing
+  out.
+
+Same-host producer→consumer actor edges of ``CompiledDAG`` and
+adjacent ``train.cross_pipeline`` stages ride these rings at memcpy
+speed — no per-pass object minting, no reference-counting traffic.
+Cross-host and driver-facing edges keep riding the object plane.
 """
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 from ray_tpu.native.channel import Channel, ChannelClosed
 
-__all__ = ["Channel", "ChannelClosed"]
+__all__ = [
+    "Channel", "ChannelClosed", "ChannelArg", "ChannelError",
+    "ChannelWriter", "ChannelReader", "channels_available",
+    "channel_path", "submit_channel_call", "channel_host",
+    "channel_location", "destroy_channel", "destroy_channel_at",
+    "CHANNEL_STEP_METHOD",
+]
+
+# Actor-task descriptor name dispatched to the channel trampoline by
+# Runtime._lookup_callable (core/runtime.py keeps the same literal).
+CHANNEL_STEP_METHOD = "__rt_channel_step__"
+
+DEFAULT_TIMEOUT_S = 120.0
+_MIN_SLOT_BYTES = 64 * 1024
+
+# Frame tags (first byte of every ring frame).
+_TAG_VALUE = 0x57   # "W": flat wire bytes follow
+_TAG_REF = 0x52     # "R": pickled ObjectRef (payload exceeded the slot)
+_TAG_ERROR = 0x45   # "E": pickled producer exception
+
+_available: Optional[bool] = None
+_avail_lock = threading.Lock()
+
+
+def channels_available() -> bool:
+    """True when the native ring builds/loads on this host (g++ in the
+    image); callers degrade to the object plane when False."""
+    global _available
+    if _available is None:
+        with _avail_lock:
+            if _available is None:
+                try:
+                    from ray_tpu.native.channel import _load
+
+                    _load()
+                    _available = True
+                except Exception:
+                    _available = False
+    return _available
+
+
+def channel_path(tag: str) -> str:
+    """Unique ring path in memory-backed storage."""
+    base = ("/dev/shm" if os.path.isdir("/dev/shm")
+            else tempfile.gettempdir())
+    return os.path.join(
+        base, f"rtchan-{os.getpid()}-{tag}-{uuid.uuid4().hex[:8]}")
+
+
+class ChannelError(RuntimeError):
+    """A producer upstream of this channel edge failed; carries the
+    original exception as ``__cause__``."""
+
+
+def _round_up_pow2(n: int) -> int:
+    p = _MIN_SLOT_BYTES
+    while p < n:
+        p <<= 1
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Endpoints (process-wide, resolved lazily inside the executing worker)
+# ---------------------------------------------------------------------------
+
+class ChannelWriter:
+    """Producer endpoint.  Creates the backing ring at first put, sized
+    from the first payload unless ``slot_bytes`` hints otherwise."""
+
+    def __init__(self, path: str, n_slots: int = 8, slot_bytes: int = 0,
+                 timeout: float = DEFAULT_TIMEOUT_S):
+        import collections
+
+        self.path = path
+        self.n_slots = max(2, int(n_slots))
+        self.slot_bytes_hint = int(slot_bytes)
+        self.timeout = timeout
+        self._chan: Optional[Channel] = None
+        self._lock = threading.Lock()
+        # Oversize-fallback refs pinned until their frame is long
+        # consumed.  The reader resolves a ref frame inline before its
+        # next read, and the ring caps the writer at n_slots frames
+        # ahead, so by the time a ref is evicted here (2*n_slots
+        # writes later) its get() has completed.
+        self._fallback_refs = collections.deque(
+            maxlen=2 * self.n_slots + 2)
+
+    def _ensure(self, frame_len: int) -> Channel:
+        with self._lock:
+            if self._chan is None:
+                slot = _round_up_pow2(
+                    max(self.slot_bytes_hint, frame_len))
+                Channel.create(self.path, n_slots=self.n_slots,
+                               slot_bytes=slot)
+                self._chan = Channel(self.path, writer=True)
+            return self._chan
+
+    def put_value(self, value: Any) -> None:
+        """Serialize ``value`` into the ring as its flat wire layout
+        (tag, meta pickle, payload, raw extern bytes) assembled
+        directly in slot memory — one memcpy.  A payload exceeding the
+        slot capacity falls back to an object-plane ref frame so the
+        pass completes without breaking the plan."""
+        from ..cluster.serialization import serialize, wire_layout
+
+        meta, bufs = wire_layout(serialize(value))
+        hdr = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
+        parts = [bytes([_TAG_VALUE]), len(hdr).to_bytes(4, "big"),
+                 hdr, *bufs]
+        total = 5 + len(hdr) + sum(len(b) for b in bufs)
+        chan = self._ensure(total)
+        if total > chan.slot_bytes:
+            parts = [self._ref_frame(value)]
+        chan.put_parts(parts, timeout=self.timeout)
+
+    def _ref_frame(self, value: Any) -> bytes:
+        from ..core.runtime import get_runtime
+
+        ref = get_runtime().put(value)
+        # Pin the ref: dropping our only reference here would let the
+        # out-of-scope reaper free the object before the consumer's
+        # get() resolves it.
+        self._fallback_refs.append(ref)
+        return bytes([_TAG_REF]) + pickle.dumps(
+            ref, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def put_error(self, err: BaseException) -> None:
+        """Best-effort: wake the consumer with the producer's failure
+        instead of letting it block out its timeout."""
+        try:
+            payload = pickle.dumps(err, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            payload = pickle.dumps(
+                RuntimeError(f"{type(err).__name__}: {err}"))
+        try:
+            chan = self._ensure(len(payload) + 1)
+            chan.put(bytes([_TAG_ERROR]) + payload, timeout=5.0)
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        """Close (wakes both sides) and unlink.  The mapping itself is
+        freed when the last reference to the Channel drops — a task
+        thread still blocked inside put() holds one, so we never unmap
+        under it."""
+        with self._lock:
+            chan, self._chan = self._chan, None
+        self._fallback_refs.clear()
+        if chan is not None:
+            chan.close()
+            try:
+                os.unlink(chan.path)
+            except OSError:
+                pass
+
+
+class ChannelReader:
+    """Consumer endpoint.  Waits for the writer-created ring to appear
+    on first use (creation is writer-side, sized from the first pass)."""
+
+    def __init__(self, path: str, timeout: float = DEFAULT_TIMEOUT_S):
+        self.path = path
+        self.timeout = timeout
+        self._chan: Optional[Channel] = None
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> Channel:
+        with self._lock:
+            if self._chan is None:
+                deadline = time.monotonic() + self.timeout
+                while True:
+                    try:
+                        self._chan = Channel(self.path, writer=False)
+                        break
+                    except FileNotFoundError:
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"channel {self.path} was never created "
+                                f"by its writer "
+                                f"(waited {self.timeout:.0f}s)")
+                        time.sleep(0.001)
+            return self._chan
+
+    def get_value(self) -> Any:
+        from ..cluster.serialization import deserialize, sealed_from_flat
+
+        data = self._ensure().get_buffer(timeout=self.timeout)
+        if not data:
+            raise ChannelError(f"empty frame on channel {self.path}")
+        tag = data[0]
+        if tag == _TAG_VALUE:
+            mv = memoryview(data)
+            hl = int.from_bytes(mv[1:5], "big")
+            meta = pickle.loads(mv[5:5 + hl])
+            # Array leaves are zero-copy views into the frame buffer
+            # (already our private copy straight out of the slot).
+            return deserialize(sealed_from_flat(meta, mv[5 + hl:]))
+        if tag == _TAG_REF:
+            from ..core.runtime import get_runtime
+
+            ref = pickle.loads(memoryview(data)[1:])
+            return get_runtime().get(ref)
+        if tag == _TAG_ERROR:
+            err = pickle.loads(memoryview(data)[1:])
+            raise ChannelError(
+                f"producer feeding channel {self.path} failed: "
+                f"{type(err).__name__}: {err}") from err
+        raise ChannelError(
+            f"unknown frame tag {tag:#x} on channel {self.path}")
+
+    def close(self) -> None:
+        with self._lock:
+            chan, self._chan = self._chan, None
+        if chan is not None:
+            chan.close()
+
+
+# Per-process endpoint caches: the same ring is written/read by exactly
+# one endpoint object per process regardless of how many actor tasks
+# touch it (SPSC ring contract).
+_writers: Dict[str, ChannelWriter] = {}
+_readers: Dict[str, ChannelReader] = {}
+_ep_lock = threading.Lock()
+
+
+def _writer_for(spec: Tuple) -> ChannelWriter:
+    path, n_slots, slot_bytes, timeout = spec
+    with _ep_lock:
+        w = _writers.get(path)
+        if w is None:
+            w = _writers[path] = ChannelWriter(
+                path, n_slots=n_slots, slot_bytes=slot_bytes,
+                timeout=timeout)
+        return w
+
+
+def _reader_for(path: str, timeout: float) -> ChannelReader:
+    with _ep_lock:
+        r = _readers.get(path)
+        if r is None:
+            r = _readers[path] = ChannelReader(path, timeout=timeout)
+        return r
+
+
+def destroy_channel(path: str) -> None:
+    """Teardown: close + unlink the ring, waking any blocked peer.
+    Safe to call for rings that were never created or already gone."""
+    with _ep_lock:
+        writer = _writers.pop(path, None)
+        reader = _readers.pop(path, None)
+    if reader is not None:
+        try:
+            reader.close()
+        except Exception:
+            pass
+    if writer is not None:
+        try:
+            writer.destroy()
+            return
+        except Exception:
+            pass
+    try:
+        chan = Channel(path, writer=False)
+    except Exception:
+        return  # never created, already unlinked, or lib unavailable
+    try:
+        chan.destroy()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The in-actor trampoline
+# ---------------------------------------------------------------------------
+
+class ChannelArg:
+    """Placeholder in a task's arguments: resolved to the value read
+    from ``path`` inside the executing actor.  Duplicate placeholders
+    for the same path within one call consume ONE frame."""
+
+    __slots__ = ("path", "timeout")
+
+    def __init__(self, path: str, timeout: float = DEFAULT_TIMEOUT_S):
+        self.path = path
+        self.timeout = timeout
+
+    def __repr__(self):
+        return f"ChannelArg({os.path.basename(self.path)})"
+
+
+def bind_channel_step(instance):
+    """Build the executable for a ``__rt_channel_step__`` actor task:
+    read channel args, run the real method, tee the result into the
+    edge's writer rings (Runtime._lookup_callable dispatches here)."""
+
+    def run(_rt_chan_plan, *args, **kwargs):
+        method_name, writes, returns_value = _rt_chan_plan
+        seen: Dict[str, Any] = {}
+
+        def resolve(v):
+            if isinstance(v, ChannelArg):
+                if v.path not in seen:
+                    seen[v.path] = _reader_for(
+                        v.path, v.timeout).get_value()
+                return seen[v.path]
+            return v
+
+        args = tuple(resolve(a) for a in args)
+        kwargs = {k: resolve(v) for k, v in kwargs.items()}
+        try:
+            result = getattr(instance, method_name)(*args, **kwargs)
+        except BaseException as e:
+            for w in writes:
+                _writer_for(w).put_error(e)
+            raise
+        for w in writes:
+            _writer_for(w).put_value(result)
+        return result if returns_value else None
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Submission helpers (compiled DAG + cross-pipeline share these)
+# ---------------------------------------------------------------------------
+
+def writer_spec(path: str, n_slots: int = 8, slot_bytes: int = 0,
+                timeout: float = DEFAULT_TIMEOUT_S) -> Tuple:
+    """Picklable writer-endpoint description carried in the task plan."""
+    return (path, int(n_slots), int(slot_bytes), float(timeout))
+
+
+def submit_channel_call(handle, method_name: str, args: Sequence[Any],
+                        kwargs: Optional[dict] = None, *,
+                        writes: Sequence[Tuple] = (),
+                        returns_value: bool = True):
+    """Submit an actor method whose args may contain ``ChannelArg``
+    markers and whose result tees into ``writes`` rings.  Returns the
+    usual ObjectRef (carrying the result, or None when
+    ``returns_value`` is False)."""
+    from ..core.runtime import get_runtime
+    from ..core.task_spec import TaskOptions
+
+    plan = (method_name, tuple(writes), bool(returns_value))
+    opts = TaskOptions(max_retries=0,
+                       name=f"{method_name}[chan]")
+    return get_runtime().submit_actor_task(
+        handle._actor_id, CHANNEL_STEP_METHOD,
+        (plan,) + tuple(args), kwargs or {}, opts,
+        klass=handle._klass)
+
+
+def channel_location(handle_or_id) -> Optional[Tuple[str, Optional[str]]]:
+    """``(host_key, node_address)`` for this actor's channel endpoints,
+    or None if the actor cannot terminate a channel edge at all.  Two
+    actors whose host keys are EQUAL share a /dev/shm namespace, so a
+    ring between them is valid; everything else stays on the object
+    plane.  ``node_address`` is None when the actor is hosted by THIS
+    process (teardown is local), else the hosting node's RPC address
+    (teardown sends ``channel_destroy`` there).
+
+    Channel-capable means: sync, max_concurrency == 1 (the per-actor
+    FIFO is what keeps ring frames in pass order), and not isolate
+    (the trampoline must run in the process the ring lives in).  For an
+    actor hosted by this process the key is our node's IP (or "local"
+    outside cluster mode); for a cluster actor the hosting node answers
+    an ``actor_info`` RPC and the key is its address's IP — a compiled
+    DAG whose producer and consumer landed on one machine rides the
+    ring even though both are remote to the driver."""
+    from ..core.runtime import try_get_runtime
+
+    rt = try_get_runtime()
+    if rt is None:
+        return None
+    actor_id = getattr(handle_or_id, "_actor_id", handle_or_id)
+    core = rt.actor_manager.get_core(actor_id)
+    if core is not None:
+        info = core.info
+        if info.is_async or info.max_concurrency != 1 or info.isolate:
+            return None
+        host = (rt.address.rsplit(":", 1)[0] if rt.cluster is not None
+                else "local")
+        return (host, None)
+    if rt.cluster is None:
+        return None
+    try:
+        loc, state = rt.cluster.locate_actor_with_state(actor_id)
+    except Exception:
+        return None
+    if loc is None or state != "ALIVE":
+        return None
+    _node_id, address = loc
+    try:
+        resp = rt.cluster.pool.get(address).call(
+            "actor_info", {"actor_id": actor_id}, timeout=30.0)
+    except Exception:
+        return None
+    if not resp.get("found") or resp.get("is_async") \
+            or resp.get("max_concurrency") != 1 or resp.get("isolate"):
+        return None
+    return (address.rsplit(":", 1)[0], address)
+
+
+def channel_host(handle_or_id) -> Optional[str]:
+    """Just the host key of :func:`channel_location`."""
+    loc = channel_location(handle_or_id)
+    return loc[0] if loc is not None else None
+
+
+def destroy_channel_at(path: str,
+                       addresses: Sequence[Optional[str]] = ()) -> None:
+    """Teardown for a ring whose endpoints may live in OTHER processes:
+    ask each hosting node (``channel_destroy`` RPC) to close + unlink
+    and drop its cached endpoints, then clean up locally.  None entries
+    (this process) and unreachable nodes are fine — local cleanup
+    always runs and a missing file is not an error."""
+    from ..core.runtime import try_get_runtime
+
+    rt = try_get_runtime()
+    for address in {a for a in addresses if a}:
+        if rt is None or rt.cluster is None:
+            break
+        try:
+            rt.cluster.pool.get(address).call(
+                "channel_destroy", {"path": path}, timeout=10.0)
+        except Exception:
+            pass
+    destroy_channel(path)

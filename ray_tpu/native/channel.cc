@@ -288,6 +288,113 @@ int64_t rtchan_next_len(void* chan, double timeout_s) {
   return len;
 }
 
+// In-place slot access (SPSC makes it safe: the writer owns an
+// unpublished slot exclusively, the reader owns the head slot until it
+// advances read_idx).  The Python adapter assembles/parses frames
+// directly in slot memory — one memcpy per side instead of three.
+
+// Wait for a free slot and return its base pointer, or null with
+// *err = -ETIMEDOUT / -EPIPE / -EINVAL.  Caller writes <= slot_bytes
+// then calls rtchan_write_commit(len).
+uint8_t* rtchan_write_begin(void* chan, double timeout_s, int64_t* err) {
+  Chan* c = static_cast<Chan*>(chan);
+  Header* h = c->h;
+  timespec ts;
+  abs_deadline(&ts, timeout_s);
+  if (lock_robust(h) != 0) { *err = -EINVAL; return nullptr; }
+  while (h->write_idx - h->read_idx >= h->n_slots) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      *err = -EPIPE;
+      return nullptr;
+    }
+    int rc = timedwait_robust(&h->not_full, h, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      *err = -ETIMEDOUT;
+      return nullptr;
+    }
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    *err = -EPIPE;
+    return nullptr;
+  }
+  uint64_t slot = h->write_idx % h->n_slots;
+  pthread_mutex_unlock(&h->mu);
+  *err = 0;
+  return c->slots + slot * h->slot_bytes;
+}
+
+int rtchan_write_commit(void* chan, uint64_t len) {
+  Chan* c = static_cast<Chan*>(chan);
+  Header* h = c->h;
+  if (len > h->slot_bytes) return -EMSGSIZE;
+  if (lock_robust(h) != 0) return -EINVAL;
+  uint64_t slot = h->write_idx % h->n_slots;
+  h->lengths[slot] = len;
+  h->write_idx += 1;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Wait for a sealed slot; returns its base pointer with *len_or_err =
+// payload length, or null with *len_or_err = -ETIMEDOUT / -EPIPE /
+// -EINVAL.  The slot stays valid until rtchan_read_commit.
+uint8_t* rtchan_read_begin(void* chan, double timeout_s,
+                           int64_t* len_or_err) {
+  Chan* c = static_cast<Chan*>(chan);
+  Header* h = c->h;
+  timespec ts;
+  abs_deadline(&ts, timeout_s);
+  if (lock_robust(h) != 0) { *len_or_err = -EINVAL; return nullptr; }
+  while (h->read_idx == h->write_idx) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      *len_or_err = -EPIPE;
+      return nullptr;
+    }
+    int rc = timedwait_robust(&h->not_empty, h, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      *len_or_err = -ETIMEDOUT;
+      return nullptr;
+    }
+  }
+  uint64_t slot = h->read_idx % h->n_slots;
+  *len_or_err = static_cast<int64_t>(h->lengths[slot]);
+  pthread_mutex_unlock(&h->mu);
+  return c->slots + slot * h->slot_bytes;
+}
+
+int rtchan_read_commit(void* chan) {
+  Chan* c = static_cast<Chan*>(chan);
+  Header* h = c->h;
+  if (lock_robust(h) != 0) return -EINVAL;
+  h->read_idx += 1;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Geometry getters: the adapter layer sizes frames against the slot
+// capacity (oversize payloads fall back to the object plane per-pass).
+int64_t rtchan_slot_bytes(void* chan) {
+  return static_cast<int64_t>(static_cast<Chan*>(chan)->h->slot_bytes);
+}
+
+int64_t rtchan_n_slots(void* chan) {
+  return static_cast<int64_t>(static_cast<Chan*>(chan)->h->n_slots);
+}
+
+// Test hook: take the shared mutex and DON'T release it.  A process
+// calling this then dying exercises the robust-mutex recovery path
+// (EOWNERDEAD → pthread_mutex_consistent) from a real peer death.
+int rtchan_debug_lock(void* chan) {
+  return lock_robust(static_cast<Chan*>(chan)->h);
+}
+
 int rtchan_size(void* chan) {
   Chan* c = static_cast<Chan*>(chan);
   Header* h = c->h;

@@ -5,7 +5,11 @@ distribution instead of N pulls hammering one holder; the reference's
 release envelope includes 1 GiB broadcast to 50+ nodes
 (release/benchmarks/README.md:15-19).  The transport is a fanout tree
 (cluster/client.py broadcast_object): the source uploads ``fanout``
-copies, recipients relay to their subtrees.
+copies, recipients relay to their subtrees.  Same-host recipients mmap
+the source's /dev/shm flat layout (no bytes move); everyone else gets
+a PIPELINED CHUNK STREAM (push_stream_* RPCs) whose chunks forward to
+the next hop as they arrive — a depth-d relay tree streams at ~line
+rate instead of d serial whole-payload store-and-forwards.
 
 Typical use: ship a big read-only array (tokenizer table, eval set,
 model shard) to every node before a task wave, so the wave's

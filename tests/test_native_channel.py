@@ -100,6 +100,101 @@ def test_cross_process_ring(tmp_path):
         r.destroy()
 
 
+def test_writer_close_wakes_blocked_reader(tmp_path):
+    """A reader already parked in get() must fail over promptly when
+    the writer closes — not sit out its full timeout."""
+    path = Channel.create(str(tmp_path / "ch"))
+    w = Channel(path, writer=True)
+    r = Channel(path, writer=False)
+    outcome = []
+
+    def blocked_get():
+        t0 = time.perf_counter()
+        try:
+            r.get(timeout=60.0)
+            outcome.append(("value", time.perf_counter() - t0))
+        except ChannelClosed:
+            outcome.append(("closed", time.perf_counter() - t0))
+
+    t = threading.Thread(target=blocked_get)
+    t.start()
+    time.sleep(0.3)  # let the reader park on the condvar
+    w.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert outcome and outcome[0][0] == "closed"
+    assert outcome[0][1] < 10.0  # woke on close, not on timeout
+
+
+def _lock_and_die(path):
+    ch = Channel(path, writer=False)
+    ch._debug_lock()  # take the shared robust mutex ...
+    import os
+
+    os._exit(0)       # ... and die holding it
+
+
+def test_reader_crash_releases_robust_mutex(tmp_path):
+    """A peer dying while holding the shared mutex must not wedge the
+    ring: the robust-mutex EOWNERDEAD path hands the lock to the next
+    acquirer (channel.cc lock_robust)."""
+    path = Channel.create(str(tmp_path / "ch"), n_slots=4,
+                          slot_bytes=1024)
+    proc = mp.get_context("spawn").Process(
+        target=_lock_and_die, args=(path,))
+    proc.start()
+    proc.join(timeout=30)
+    assert proc.exitcode == 0
+    w = Channel(path, writer=True)
+    r = Channel(path, writer=False)
+    try:
+        w.put(b"survived", timeout=10.0)  # EOWNERDEAD recovered here
+        assert r.get(timeout=10.0) == b"survived"
+    finally:
+        w.destroy()
+
+
+def test_payload_larger_than_ring_clean_error(tmp_path):
+    """Oversize payloads surface a clean ValueError naming the slot
+    capacity — on both the copy and the in-place write paths — and
+    leave the ring usable."""
+    path = Channel.create(str(tmp_path / "ch"), n_slots=2,
+                          slot_bytes=4096)
+    w = Channel(path, writer=True)
+    r = Channel(path, writer=False)
+    try:
+        with pytest.raises(ValueError, match="exceeds slot size"):
+            w.put(b"x" * 8192)
+        with pytest.raises(ValueError, match="exceeds slot size"):
+            w.put_parts([b"x" * 4000, b"y" * 4000])
+        w.put(b"still works")
+        assert r.get(timeout=5.0) == b"still works"
+    finally:
+        w.destroy()
+
+
+def test_inplace_parts_roundtrip(tmp_path):
+    """put_parts assembles multi-piece frames directly in slot memory;
+    get_buffer returns them without staging copies."""
+    import numpy as np
+
+    path = Channel.create(str(tmp_path / "ch"), n_slots=4,
+                          slot_bytes=1 << 16)
+    w = Channel(path, writer=True)
+    r = Channel(path, writer=False)
+    try:
+        arr = np.arange(512, dtype=np.float32)
+        w.put_parts([b"hdr:", memoryview(arr)])
+        buf = r.get_buffer(timeout=5.0)
+        assert bytes(buf[:4]) == b"hdr:"
+        back = np.frombuffer(memoryview(buf)[4:], dtype=np.float32)
+        assert np.array_equal(back, arr)
+        assert w.slot_bytes == 1 << 16
+        assert w.n_slots == 4
+    finally:
+        w.destroy()
+
+
 def test_throughput_sanity(tmp_path):
     """Same-host channel beats the per-message-object path by a wide
     margin.  The bound is deliberately loose (0.3 GB/s) so a loaded CI
