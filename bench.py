@@ -178,6 +178,65 @@ def _dag_roundtrip_bench(n_iters: int = 150) -> dict:
         c.shutdown()
 
 
+def _dag_recovery_bench() -> dict:
+    """Kill→first-successful-pass latency of the channel data plane's
+    self-healing: a 2-actor compiled DAG's producer (max_restarts=1) is
+    chaos-killed mid-pass; measures the wall time from the kill firing
+    to the first subsequent pass completing on rebuilt rings (restart +
+    ring teardown + replan + pass)."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+    from ray_tpu.exceptions import ActorDiedError, ChannelError
+    from ray_tpu.experimental import chaos
+    from ray_tpu.experimental.channel import channels_available
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        if not channels_available():
+            return {"dag_recovery_channel_unavailable": True}
+
+        @ray_tpu.remote
+        class Stage:
+            def step(self, x):
+                return x + 1
+
+        with InputNode() as inp:
+            a = Stage.options(max_restarts=1).bind()
+            b = Stage.bind()
+            dag = b.step.bind(a.step.bind(inp))
+        compiled = dag.experimental_compile(channel_timeout=2.0)
+        for _ in range(3):
+            assert ray_tpu.get(compiled.execute(0)) == 2
+        if not compiled._channel_edges:
+            return {"dag_recovery_channel_unavailable": True}
+
+        sched = chaos.schedule().kill_at_ring_write(
+            "dag0-1", nth=4, no_restart=False)
+        with sched:
+            t0 = time.perf_counter()
+            try:
+                ray_tpu.get(compiled.execute(0), timeout=30.0)
+            except (ActorDiedError, ChannelError):
+                pass
+            deadline = time.perf_counter() + 60.0
+            while True:
+                try:
+                    assert ray_tpu.get(compiled.execute(0),
+                                       timeout=10.0) == 2
+                    break
+                except (ActorDiedError, ChannelError):
+                    if time.perf_counter() > deadline:
+                        raise
+                    time.sleep(0.05)
+            dt = time.perf_counter() - t0
+        assert sched.fired("ring_kill") == 1
+        compiled.teardown()
+        return {"dag_recovery_ms": round(dt * 1e3, 1)}
+    finally:
+        ray_tpu.shutdown()
+
+
 def _broadcast_bench(size_bytes: int, n_nodes: int = 3) -> dict:
     """Push-based broadcast tree (push_manager.h:30 analogue): driver
     fans one object out to ``n_nodes`` workers; aggregate GB/s =
@@ -320,6 +379,12 @@ def main():
         extra.update(_dag_roundtrip_bench())
     except Exception as e:  # noqa: BLE001
         extra["dag_roundtrip_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: dag recovery phase start", file=sys.stderr, flush=True)
+    try:
+        extra.update(_dag_recovery_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["dag_recovery_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

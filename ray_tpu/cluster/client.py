@@ -90,10 +90,11 @@ class _PushStreamSession:
         for group in [g for g in groups if g]:
             child = client.pool.get(group[0])
             csid = _uuid.uuid4().hex
-            resp = child.call("push_stream_begin", {
+            # Retried: the handler dedups a re-delivered begin by sid.
+            resp = child.call_with_retry("push_stream_begin", {
                 "sid": csid, "oid": oid, "owner": owner, "meta": meta,
                 "size": size, "relay": group[1:], "timeout": timeout},
-                timeout=timeout)
+                timeout=timeout, deadline_s=min(timeout, 30.0))
             if not resp.get("ok"):
                 raise ConnectionError(str(resp.get("error")))
             self._children.append((child, csid.encode()))
@@ -136,11 +137,13 @@ class _PushStreamSession:
         for call in self._pending:
             call.result(max(0.1, self._deadline - time.monotonic()))
         for child, csid in self._children:
-            resp = child.call("push_stream_end",
-                              {"sid": csid.decode()},
-                              timeout=max(
-                                  0.1,
-                                  self._deadline - time.monotonic()))
+            left = max(0.1, self._deadline - time.monotonic())
+            # Retried: a lost END response is acked by the handler's
+            # finished-sid ledger instead of re-finishing.
+            resp = child.call_with_retry("push_stream_end",
+                                         {"sid": csid.decode()},
+                                         timeout=left,
+                                         deadline_s=min(left, 30.0))
             if not resp.get("ok"):
                 raise ConnectionError(str(resp.get("error")))
         plasma = self._client.runtime.plasma
@@ -201,6 +204,20 @@ class ClusterClient:
         # sid -> _PushStreamSession.
         self._push_streams: Dict[str, "_PushStreamSession"] = {}
         self._push_streams_lock = threading.Lock()
+        # sids whose END already landed (retried ends are acked, not
+        # errored — the push_stream_* protocol is retry-safe).  A dict
+        # for its insertion order: trimming drops the OLDEST acks.
+        self._finished_streams: Dict[str, None] = {}
+        # sid -> Event while an END's finish() is still executing: a
+        # retried END parks here instead of KeyError-ing against the
+        # already-popped session.
+        self._ending_streams: Dict[str, threading.Event] = {}
+
+        # Listeners for head-published actor FSM transitions
+        # (fn(actor_id_bytes, state, event_dict)); the compiled-DAG /
+        # pipeline re-planners subscribe to tear down and rebuild rings
+        # on restarts.
+        self._actor_state_listeners: List[Any] = []
 
         self.server = NodeServer(runtime, self)
         self.address = self.server.address
@@ -209,12 +226,14 @@ class ClusterClient:
         from ..core.tpu_topology import detect_topology_labels
 
         self._labels = {**detect_topology_labels(), **(labels or {})}
-        self.head.call("register_node", {
+        # Idempotent + retried: a chaos-dropped or head-restart-raced
+        # registration must neither fail attachment nor double-apply.
+        self.head.call_idempotent("register_node", {
             "node_id": self.node_id,
             "address": self.address,
             "resources": dict(runtime.node_resources.total),
             "labels": self._labels, "name": node_name,
-        })
+        }, deadline_s=30.0)
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name=f"cluster-hb-{self.node_id[:8]}")
@@ -287,8 +306,22 @@ class ClusterClient:
             return {nid: dict(rec) for nid, rec in self._view.items()}
 
     # ------------------------------------------------------------- pubsub
+    def add_actor_state_listener(self, fn) -> None:
+        """Subscribe to head-published actor FSM transitions
+        (``fn(actor_id_bytes, state, event)``); used by the channel
+        data plane to re-plan rings around restarts."""
+        with self._loc_lock:
+            self._actor_state_listeners.append(fn)
+
+    def remove_actor_state_listener(self, fn) -> None:
+        with self._loc_lock:
+            try:
+                self._actor_state_listeners.remove(fn)
+            except ValueError:
+                pass
+
     def _pubsub_loop(self):
-        cursors = {"node_death": 0}
+        cursors = {"node_death": 0, "actor_state": 0}
         while not self._stopped.is_set():
             try:
                 out = self.head.call(
@@ -306,27 +339,57 @@ class ClusterClient:
                     return
                 continue
             ch = (out or {}).get("node_death")
-            if not ch:
-                continue
-            cursors["node_death"] = ch["seq"]
-            for event in ch["events"]:
-                nid = event.get("node_id", "")
-                addr = event.get("address", "")
-                if nid == self.node_id:
-                    continue  # our own (false-positive) death report
-                self.observed_dead_nodes.add(nid)
-                # Proactive cleanup instead of lazy on-access discovery:
-                # drop cached actor locations and the dead node's
-                # borrower holds at this owner.
-                with self._loc_lock:
-                    stale = [a for a, (n, ad) in
-                             self._actor_locations.items()
-                             if n == nid or (addr and ad == addr)]
-                    for aid in stale:
-                        del self._actor_locations[aid]
-                if addr:
-                    self.runtime.reference_counter.remove_borrower_node(
-                        addr)
+            if ch:
+                cursors["node_death"] = ch["seq"]
+                for event in ch["events"]:
+                    self._on_node_death_event(event)
+            ch = (out or {}).get("actor_state")
+            if ch:
+                cursors["actor_state"] = ch["seq"]
+                for event in ch["events"]:
+                    self._on_actor_state_event(event)
+
+    def _on_node_death_event(self, event):
+        nid = event.get("node_id", "")
+        addr = event.get("address", "")
+        if nid == self.node_id:
+            return  # our own (false-positive) death report
+        self.observed_dead_nodes.add(nid)
+        # Proactive cleanup instead of lazy on-access discovery:
+        # drop cached actor locations and the dead node's
+        # borrower holds at this owner.
+        with self._loc_lock:
+            stale = [a for a, (n, ad) in
+                     self._actor_locations.items()
+                     if n == nid or (addr and ad == addr)]
+            for aid in stale:
+                del self._actor_locations[aid]
+        if addr:
+            self.runtime.reference_counter.remove_borrower_node(
+                addr)
+
+    def _on_actor_state_event(self, event):
+        """Head-driven actor FSM transition: keep the location cache
+        honest (RESTARTING actors must not be pushed to their dead
+        address; ALIVE events carry the NEW endpoint) and fan out to
+        re-planner listeners."""
+        aid_bytes = event.get("actor_id")
+        state = event.get("state", "")
+        with self._loc_lock:
+            stale = [a for a in self._actor_locations
+                     if getattr(a, "binary", lambda: a)() == aid_bytes]
+            for a in stale:
+                if state == "ALIVE" and event.get("address"):
+                    self._actor_locations[a] = (
+                        event["node_id"], event["address"])
+                else:
+                    del self._actor_locations[a]
+            listeners = list(self._actor_state_listeners)
+        for fn in listeners:
+            try:
+                fn(aid_bytes, state, event)
+            except Exception:
+                traceback.print_exc()
 
     # ------------------------------------------------------------- tasks
     def placement_params(self, spec) -> dict:
@@ -878,10 +941,10 @@ class ClusterClient:
         from ..core.config import GLOBAL_CONFIG
 
         sid = _uuid.uuid4().hex
-        resp = cl.call("push_stream_begin", {
+        resp = cl.call_with_retry("push_stream_begin", {
             "sid": sid, "oid": oid, "owner": owner, "meta": meta,
             "size": size, "relay": relay, "timeout": timeout},
-            timeout=timeout)
+            timeout=timeout, deadline_s=min(timeout, 30.0))
         if not resp.get("ok"):
             raise ConnectionError(str(resp.get("error")))
         chunk = max(64 * 1024, GLOBAL_CONFIG.object_chunk_bytes())
@@ -902,43 +965,115 @@ class ClusterClient:
             offset += n
         for call in window:
             call.result(timeout)
-        resp = cl.call("push_stream_end", {"sid": sid}, timeout=timeout)
+        resp = cl.call_with_retry("push_stream_end", {"sid": sid},
+                                  timeout=timeout,
+                                  deadline_s=min(timeout, 30.0))
         if not resp.get("ok"):
             raise ConnectionError(str(resp.get("error")))
 
     def _push_stream_begin(self, p) -> dict:
         from ..core.config import GLOBAL_CONFIG
 
-        session = _PushStreamSession(
-            self, p["oid"], p["owner"], p["meta"], int(p["size"]),
-            list(p.get("relay") or []),
-            float(p.get("timeout") or 600.0),
-            max(1, GLOBAL_CONFIG.object_broadcast_fanout()))
         with self._push_streams_lock:
-            # Sweep sessions whose sender never finished (deadline
-            # passed) so abandoned streams can't accumulate buffers.
-            stale = [s for s, sess in self._push_streams.items()
-                     if sess.expired()]
-            for s in stale:
-                self._push_streams.pop(s).abort()
+            # Claim the sid under ONE lock acquisition: a retried
+            # begin (response lost to rpc chaos) racing the original,
+            # still-constructing delivery must neither stack a second
+            # session nor ack before the buffer exists.  The claim is
+            # an Event the duplicate (and early chunks) wait on.
+            cur = self._push_streams.get(p["sid"])
+            if cur is None:
+                claim = threading.Event()
+                self._push_streams[p["sid"]] = claim
+                # Sweep sessions whose sender never finished (deadline
+                # passed) so abandoned streams can't accumulate
+                # buffers.
+                stale = [s for s, sess in self._push_streams.items()
+                         if isinstance(sess, _PushStreamSession)
+                         and sess.expired()]
+                for s in stale:
+                    self._push_streams.pop(s).abort()
+        if cur is not None:
+            if isinstance(cur, threading.Event):
+                cur.wait(timeout=float(p.get("timeout") or 600.0))
+            return {"ok": True}
+        try:
+            session = _PushStreamSession(
+                self, p["oid"], p["owner"], p["meta"], int(p["size"]),
+                list(p.get("relay") or []),
+                float(p.get("timeout") or 600.0),
+                max(1, GLOBAL_CONFIG.object_broadcast_fanout()))
+        except BaseException:
+            with self._push_streams_lock:
+                if self._push_streams.get(p["sid"]) is claim:
+                    del self._push_streams[p["sid"]]
+            claim.set()
+            raise
+        with self._push_streams_lock:
             self._push_streams[p["sid"]] = session
+        claim.set()
         return {"ok": True}
+
+    def _push_stream_session(self, sid: str):
+        """The sid's live session, waiting out an in-construction
+        claim; None if unknown."""
+        with self._push_streams_lock:
+            session = self._push_streams.get(sid)
+        if isinstance(session, threading.Event):
+            session.wait(timeout=60.0)
+            with self._push_streams_lock:
+                session = self._push_streams.get(sid)
+        return session if isinstance(session, _PushStreamSession) \
+            else None
 
     def _push_stream_chunk(self, frame) -> dict:
         sid = bytes(frame[:32]).decode()
-        with self._push_streams_lock:
-            session = self._push_streams.get(sid)
+        session = self._push_stream_session(sid)
         if session is None:
             raise KeyError(f"no push stream {sid!r}")
         session.chunk(frame)
         return {"ok": True}
 
     def _push_stream_end(self, p) -> dict:
+        # Resolve an in-construction claim first (an END cannot
+        # legitimately race its own BEGIN, but a retried BEGIN's ack
+        # path must not make END see the bare Event).
+        sid = p["sid"]
+        self._push_stream_session(sid)
         with self._push_streams_lock:
-            session = self._push_streams.pop(p["sid"], None)
-        if session is None:
-            raise KeyError(f"no push stream {p['sid']!r}")
-        session.finish()
+            session = self._push_streams.get(sid)
+            if isinstance(session, _PushStreamSession):
+                self._push_streams.pop(sid)
+                ending = self._ending_streams[sid] = threading.Event()
+            else:
+                # Idempotent: a retried end after the first one landed
+                # (but its response was lost) is a success, not an
+                # error; one racing a STILL-EXECUTING finish() waits it
+                # out instead of KeyError-ing on the popped session.
+                in_flight = self._ending_streams.get(sid)
+                if in_flight is None:
+                    if sid in self._finished_streams:
+                        return {"ok": True}
+                    raise KeyError(f"no push stream {sid!r}")
+        if not isinstance(session, _PushStreamSession):
+            in_flight.wait(timeout=600.0)
+            with self._push_streams_lock:
+                if sid in self._finished_streams:
+                    return {"ok": True}
+            raise KeyError(f"push stream {sid!r} failed to finish")
+        try:
+            session.finish()
+        except BaseException:
+            with self._push_streams_lock:
+                self._ending_streams.pop(sid, None)
+            ending.set()
+            raise
+        with self._push_streams_lock:
+            self._finished_streams[sid] = None
+            while len(self._finished_streams) > 512:
+                self._finished_streams.pop(
+                    next(iter(self._finished_streams)))
+            self._ending_streams.pop(sid, None)
+        ending.set()
         return {"ok": True}
 
     def fetch_object(self, ref) -> None:
@@ -1090,7 +1225,7 @@ class ClusterClient:
             raise RuntimeError(resp.get("error", "actor creation failed"))
         with self._loc_lock:
             self._actor_locations[actor_id] = (node_id, address)
-        self.head.call("register_actor", {
+        self.head.call_idempotent("register_actor", {
             "actor_id": actor_id.binary(),
             "node_id": node_id, "address": address,
             "name": options.get("name", ""),
@@ -1292,7 +1427,9 @@ class ClusterClient:
                                "no_restart": no_restart}, timeout=30.0)
         except (ConnectionError, TimeoutError):
             pass
-        self.head.call("remove_actor", {"actor_id": actor_id.binary()})
+        self.head.call_idempotent(
+            "remove_actor", {"actor_id": actor_id.binary()},
+            deadline_s=15.0)
         with self._loc_lock:
             self._actor_locations.pop(actor_id, None)
 

@@ -20,7 +20,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .rpc import ClientPool, RpcServer
+from .rpc import (ClientPool, IdempotencyCache, RpcServer,
+                  idempotent_handler)
 from .serialization import loads
 
 _DEAD_AFTER_S = 10.0  # heartbeats missed before a node is declared dead
@@ -97,23 +98,32 @@ class HeadServer:
         self._replay_grace_until = 0.0
         if storage_path:
             self._load_snapshot()
+        # Mutating handlers dedup on client-minted idempotency keys:
+        # a retried register/remove whose first RESPONSE was lost (rpc
+        # chaos, head hiccup) replays the original reply instead of
+        # re-applying (e.g. a spurious "name already taken").
+        self._idem = IdempotencyCache()
+
+        def _mut(fn):
+            return idempotent_handler(fn, self._idem)
+
         self._server = RpcServer({
-            "register_node": self._register_node,
+            "register_node": _mut(self._register_node),
             "heartbeat": self._heartbeat,
-            "drain_node": self._drain_node,
+            "drain_node": _mut(self._drain_node),
             "list_nodes": self._list_nodes,
             "place": self._place,
-            "kv_put": self._kv_put,
+            "kv_put": _mut(self._kv_put),
             "kv_get": self._kv_get,
-            "kv_del": self._kv_del,
+            "kv_del": _mut(self._kv_del),
             "kv_keys": self._kv_keys,
-            "register_actor": self._register_actor,
+            "register_actor": _mut(self._register_actor),
             "lookup_actor": self._lookup_actor,
             "lookup_named_actor": self._lookup_named_actor,
-            "remove_actor": self._remove_actor,
+            "remove_actor": _mut(self._remove_actor),
             "list_actors": self._list_actors_rpc,
-            "create_pg": self._create_pg,
-            "remove_pg": self._remove_pg,
+            "create_pg": _mut(self._create_pg),
+            "remove_pg": _mut(self._remove_pg),
             "report_node_failure": self._report_node_failure,
             "pubsub_poll": self._pubsub_poll,
             "pending_demand": self._pending_demand,

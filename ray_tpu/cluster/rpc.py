@@ -15,11 +15,18 @@ followed by the payload.  Separating the two means a payload that fails
 can still be correlated to its request id and fail ONLY that call,
 instead of killing the connection's reader thread and hanging every
 pending call.  kind is "req" / "resp" / "err".
+
+Fault injection: every outgoing call consults the process's active
+``experimental.chaos`` schedule (programmable drops/delays) AND the
+legacy per-client ``RAY_TPU_TESTING_RPC_FAILURE`` budget the schedule
+API superseded.  Mutating control-plane calls ride
+``call_idempotent`` — exponential backoff under a deadline, with an
+idempotency key the server deduplicates on, so a chaos-dropped
+``register_actor`` retries without double-apply.
 """
 
 from __future__ import annotations
 
-import os
 import pickle
 import socket
 import struct
@@ -28,37 +35,119 @@ import time
 import uuid
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..experimental import chaos as _chaos
+
 _LEN = struct.Struct(">Q")
 
+# The idempotency-key field injected into dict payloads by
+# call_idempotent and consumed by idempotent_handler on the server.
+IDEMPOTENCY_KEY = "_idem"
 
-# ---------------------------------------------------------------------------
-# Chaos injection (reference: rpc_chaos.h:23 — RAY_testing_rpc_failure)
-# ---------------------------------------------------------------------------
 
-class _Chaos:
-    """Parses ``RAY_TPU_TESTING_RPC_FAILURE="method=N,method2=M"`` and
-    drops the first N calls of each listed method (raises ConnectionError
-    at the caller, exercising retry/failover paths)."""
+def retry_call(call_fn: Callable[..., Any], method: str, payload: Any,
+               *, timeout: Optional[float], deadline_s: float,
+               base_backoff_s: float = 0.05,
+               max_backoff_s: float = 2.0) -> Any:
+    """Drive ``call_fn(method, payload, timeout)`` to completion under
+    a total deadline, retrying ConnectionError/TimeoutError with
+    exponential backoff (reference: retryable_grpc_client.h).  The
+    FINAL attempt's error propagates."""
+    deadline = time.monotonic() + deadline_s
+    backoff = base_backoff_s
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise TimeoutError(
+                f"rpc {method!r} exhausted its {deadline_s:.0f}s "
+                f"retry deadline")
+        per_call = left if timeout is None else min(timeout, left)
+        try:
+            return call_fn(method, payload, per_call)
+        except (ConnectionError, TimeoutError) as e:
+            if time.monotonic() + backoff >= deadline:
+                raise type(e)(
+                    f"rpc {method!r} still failing at its "
+                    f"{deadline_s:.0f}s retry deadline: {e}") from e
+            time.sleep(backoff)
+            backoff = min(backoff * 2, max_backoff_s)
 
-    def __init__(self):
-        self._budget: Dict[str, int] = {}
+
+def idempotent_handler(fn: Callable[[Any], Any],
+                       cache: "IdempotencyCache"):
+    """Server-side wrapper for a MUTATING handler: a payload carrying
+    an idempotency key returns the cached first reply on re-delivery
+    instead of re-applying the mutation (client retries after a lost
+    response must not double-apply).  A retry racing a STILL-EXECUTING
+    first delivery parks on its in-flight marker rather than running
+    the handler a second time concurrently."""
+
+    def wrapped(payload):
+        key = (payload.pop(IDEMPOTENCY_KEY, None)
+               if isinstance(payload, dict) else None)
+        if key is None:
+            return fn(payload)
+        while True:
+            hit, reply = cache.get(key)
+            if hit:
+                return reply
+            ev, mine = cache.claim(key)
+            if not mine:
+                # First delivery still executing: wait it out, then
+                # re-read (if it RAISED, nothing was cached and this
+                # retry claims the key and runs the handler itself).
+                ev.wait(timeout=60.0)
+                continue
+            try:
+                reply = fn(payload)
+                cache.put(key, reply)
+                return reply
+            finally:
+                cache.release(key)
+
+    return wrapped
+
+
+class IdempotencyCache:
+    """Bounded first-reply cache keyed by client-minted call keys,
+    with in-flight markers so duplicate deliveries serialize instead
+    of double-applying."""
+
+    def __init__(self, capacity: int = 4096):
+        self._capacity = capacity
         self._lock = threading.Lock()
-        spec = os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", "")
-        for part in spec.split(","):
-            if "=" in part:
-                method, n = part.split("=", 1)
-                try:
-                    self._budget[method.strip()] = int(n)
-                except ValueError:
-                    pass
+        self._replies: Dict[str, Any] = {}
+        self._order: list = []
+        self._inflight: Dict[str, threading.Event] = {}
 
-    def maybe_fail(self, method: str):
+    def get(self, key: str) -> Tuple[bool, Any]:
         with self._lock:
-            left = self._budget.get(method, 0)
-            if left > 0:
-                self._budget[method] = left - 1
-                raise ConnectionError(
-                    f"[chaos] injected rpc failure for {method!r}")
+            if key in self._replies:
+                return True, self._replies[key]
+        return False, None
+
+    def claim(self, key: str) -> Tuple[threading.Event, bool]:
+        """(event, True) when this caller now owns the key's first
+        execution; (other's event, False) when one is already running."""
+        with self._lock:
+            ev = self._inflight.get(key)
+            if ev is not None:
+                return ev, False
+            ev = self._inflight[key] = threading.Event()
+            return ev, True
+
+    def release(self, key: str) -> None:
+        with self._lock:
+            ev = self._inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+    def put(self, key: str, reply: Any) -> None:
+        with self._lock:
+            if key not in self._replies:
+                self._order.append(key)
+                while len(self._order) > self._capacity:
+                    self._replies.pop(self._order.pop(0), None)
+            self._replies[key] = reply
 
 
 class DeserializationError(RuntimeError):
@@ -296,7 +385,10 @@ class RpcClient:
 
     def __init__(self, address: str, connect_timeout: float = 10.0):
         self.address = address
-        self._chaos = _Chaos()
+        # Legacy env-var chaos budget (per client, so subprocess
+        # workers inherit faults); the programmable schedule is
+        # consulted globally in call_async.
+        self._chaos = _chaos.env_rpc_budget()
         self._lock = threading.Lock()      # connection state
         self._wlock = threading.Lock()     # socket writes
         self._pending: Dict[str, _PendingCall] = {}
@@ -368,9 +460,18 @@ class RpcClient:
              timeout: Optional[float] = None) -> Any:
         return self.call_async(method, payload).result(timeout)
 
+    def call_with_retry(self, method: str, payload: Any = None, *,
+                        timeout: Optional[float] = None,
+                        deadline_s: float = 30.0) -> Any:
+        """Retry transport failures under a deadline (idempotent or
+        read-only methods only — there is no dedup key on this path)."""
+        return retry_call(self.call, method, payload,
+                          timeout=timeout, deadline_s=deadline_s)
+
     def call_async(self, method: str, payload: Any = None,
                    callback: Optional[Callable[[Any, bool], None]] = None
                    ) -> "_PendingCall":
+        _chaos.on_rpc(method)
         self._chaos.maybe_fail(method)
         req_id = uuid.uuid4().hex
         call = _PendingCall(method, callback)
@@ -470,6 +571,27 @@ class ReconnectingClient:
             return self._client.call(method, payload, timeout)
         except ConnectionError:
             return self._reconnect().call(method, payload, timeout)
+
+    def call_retry(self, method: str, payload: Any = None, *,
+                   timeout: Optional[float] = None,
+                   deadline_s: float = 30.0) -> Any:
+        """Read-only/naturally-idempotent calls: backoff-retry
+        transport failures until ``deadline_s``."""
+        return retry_call(self.call, method, payload,
+                          timeout=timeout, deadline_s=deadline_s)
+
+    def call_idempotent(self, method: str, payload: Dict[str, Any], *,
+                        timeout: Optional[float] = None,
+                        deadline_s: float = 30.0) -> Any:
+        """MUTATING calls: mint one idempotency key for the logical
+        call, then backoff-retry under the deadline.  The server's
+        idempotent_handler wrapper replays the first reply for a
+        duplicate key, so a retry after a lost RESPONSE does not
+        double-apply the mutation."""
+        keyed = {**payload, IDEMPOTENCY_KEY: uuid.uuid4().hex}
+        return retry_call(
+            lambda m, p, t: self.call(m, dict(p), t), method, keyed,
+            timeout=timeout, deadline_s=deadline_s)
 
     def call_async(self, method: str, payload: Any = None,
                    callback: Optional[Callable[[Any, bool], None]] = None):

@@ -22,7 +22,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from .ids import ActorID
 from .task_spec import TaskSpec
-from ..exceptions import (ActorDiedError, PendingCallsLimitExceededError)
+from ..exceptions import (ActorDiedError, PendingCallsLimitExceededError,
+                          TaskError)
+from ..experimental import chaos as _chaos
 
 
 class ActorState(Enum):
@@ -187,8 +189,34 @@ class _ActorCore:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)
             return
+        if self._chaos_gate(spec):
+            return
         self._runtime.execute_task_inline(
             spec, bound_instance=self.instance, actor_core=self)
+
+    def _chaos_gate(self, spec: TaskSpec) -> bool:
+        """Fault-injection hook before method dispatch: an active
+        chaos schedule may kill this actor (with or without restart
+        budget) or fail just this call.  Returns True when the spec was
+        consumed by an injected fault."""
+        action = _chaos.actor_task_action(spec.descriptor.function_name)
+        if action is None:
+            return False
+        method = spec.descriptor.function_name
+        if action[0] == "kill":
+            self._runtime.task_manager.complete_error(
+                spec, ActorDiedError(
+                    self.info.actor_id,
+                    "chaos: actor killed before dispatch",
+                    context={"method": method}),
+                allow_retry=False)
+            self._runtime.kill_actor(self.info.actor_id,
+                                     no_restart=action[1])
+            return True
+        self._runtime.task_manager.complete_error(
+            spec, TaskError(spec.repr_name(), action[1]),
+            allow_retry=False)
+        return True
 
     async def _run_one_async(self, spec: TaskSpec):
         if spec.is_actor_creation:
@@ -210,6 +238,8 @@ class _ActorCore:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)
             return
+        if self._chaos_gate(spec):
+            return
         await self._runtime.execute_task_inline_async(
             spec, bound_instance=self.instance, actor_core=self)
 
@@ -219,7 +249,9 @@ class _ActorCore:
             suffix = f" (creation failed: {self._creation_error!r})"
         return ActorDiedError(
             self.info.actor_id,
-            f"actor {self.info.display_name()} is dead{suffix}")
+            f"actor {self.info.display_name()} is dead{suffix}",
+            node_id=self._runtime.node_id.hex(),
+            context={"restarts_used": self.info.num_restarts})
 
     # -- teardown ------------------------------------------------------------
     def stop(self):

@@ -37,7 +37,14 @@ from .streaming import StreamingGeneratorManager
 from .task_manager import TaskManager
 from .task_spec import (STREAMING, FunctionDescriptor, TaskOptions,
                         TaskSpec, normalize_strategy)
-from ..exceptions import TaskCancelledError, TaskError
+from ..exceptions import (ActorError, ChannelError, ObjectLostError,
+                          TaskCancelledError, TaskError)
+
+# System fault-tolerance errors surface TYPED at the driver (reference:
+# RayActorError/ObjectLostError are not buried inside RayTaskError) —
+# a compiled-DAG pass that dies to a peer failure must be catchable as
+# ActorDiedError, not as a generic task wrapper.
+_FT_ERRORS = (TaskError, ActorError, ObjectLostError, ChannelError)
 
 _global_lock = threading.Lock()
 _global_runtime: Optional["Runtime"] = None
@@ -620,7 +627,7 @@ class Runtime:
             self.task_manager.complete_error(spec, e, allow_retry=False)
         except BaseException as e:  # noqa: BLE001
             outcome = "error"
-            err = e if isinstance(e, TaskError) else TaskError(
+            err = e if isinstance(e, _FT_ERRORS) else TaskError(
                 spec.repr_name(), e)
             self.task_manager.complete_error(spec, err)
         finally:
@@ -668,7 +675,7 @@ class Runtime:
             self.task_manager.complete_error(spec, e, allow_retry=False)
         except BaseException as e:  # noqa: BLE001
             outcome = "error"
-            err = e if isinstance(e, TaskError) else TaskError(
+            err = e if isinstance(e, _FT_ERRORS) else TaskError(
                 spec.repr_name(), e)
             self.task_manager.complete_error(spec, err)
         finally:
@@ -816,7 +823,7 @@ class Runtime:
             # location through the head, named or not.
             from ..cluster.serialization import dumps as _dumps
 
-            self.cluster.head.call("register_actor", {
+            self.cluster.head.call_idempotent("register_actor", {
                 "actor_id": actor_id.binary(),
                 "node_id": self.cluster.node_id,
                 "address": self.cluster.address,
@@ -1046,8 +1053,9 @@ class Runtime:
             # Locally-hosted actors are registered cluster-wide; a kill
             # must retire the head entry too.
             try:
-                self.cluster.head.call("remove_actor",
-                                       {"actor_id": actor_id.binary()})
+                self.cluster.head.call_idempotent(
+                    "remove_actor", {"actor_id": actor_id.binary()},
+                    deadline_s=10.0)
             except Exception:
                 pass
         if core is not None and core.info.state == ActorState.DEAD:

@@ -72,6 +72,19 @@ class CompiledDAG:
         # path -> endpoint-hosting node addresses (None = this
         # process); teardown reaches remote rings through these.
         self._channel_nodes: Dict[str, set] = {}
+        # Restart-aware re-planning state: the pristine (object-plane)
+        # per-step plans, restart counts of channel actors at plan
+        # time, and a dirty flag set by failures / head actor-state
+        # events.  A dirty plan is torn down and rebuilt against the
+        # actors' CURRENT endpoints at the next execute; an actor still
+        # RESTARTING at that point simply yields no ring (its edges
+        # fall back to the object plane) until a later replan.
+        self._plane_plans: Optional[List[Tuple]] = None
+        self._chan_recovery = False
+        self._chan_restarts: Dict[Any, int] = {}
+        self._chan_actor_bytes: set = set()
+        self._rings_dirty = False
+        self._state_listener = None
         self._submit_order_lock = threading.Lock()
         # (class_node, handle): teardown kills AND clears the node's
         # cached handle so a recompile makes a fresh actor.
@@ -215,9 +228,17 @@ class CompiledDAG:
 
         if not chx.channels_available():
             return
+        # Pristine object-plane plans, for restart-driven re-planning
+        # (snapshot once; replans restore from here before re-running
+        # this method).
+        if self._plane_plans is None:
+            self._plane_plans = [
+                (list(s.arg_plan), dict(s.kw_plan), s.submit)
+                for s in self._steps]
         loc_cache: Dict[Any, Any] = {}
         actor_of = [self._chan_actor(s.node, loc_cache)
                     for s in self._steps]
+        self._snapshot_chan_actors(actor_of)
 
         # Driver-facing outputs must come back as object-plane values.
         if self._multi_output is not None:
@@ -263,8 +284,13 @@ class CompiledDAG:
         for c_idx, step in enumerate(self._steps):
             def rewrite(e, c_idx=c_idx):
                 if e[0] == "slot" and (e[1], c_idx) in self._channel_edges:
+                    # The producer's actor id rides the marker so the
+                    # reader can probe its liveness while blocked.
+                    producer = getattr(actor_of[e[1]][0],
+                                       "_actor_id", None)
                     return ("const", chx.ChannelArg(
-                        self._channel_edges[(e[1], c_idx)], timeout))
+                        self._channel_edges[(e[1], c_idx)], timeout,
+                        producer=producer))
                 return e
 
             step.arg_plan = [rewrite(e) for e in step.arg_plan]
@@ -282,6 +308,103 @@ class CompiledDAG:
                              or idx not in producers)
             step.submit = self._make_channel_submit(
                 step.node, tuple(writes_of.get(idx, ())), returns_value)
+        self._chan_recovery = True
+        self._subscribe_actor_state()
+
+    # -------------------------------------------------- channel recovery
+    def _snapshot_chan_actors(self, actor_of):
+        """Record restart counts (local actors) and binary ids (for
+        head actor-state events) of every channel-capable actor, so
+        later executes can detect a restart and re-plan.  MERGES into
+        the existing tracking: a replan that runs while an actor is
+        mid-restart sees it as channel-incapable (not ALIVE), and
+        dropping it here would mean its later ALIVE event could never
+        mark the plan dirty again — its edges would silently ride the
+        object plane forever."""
+        from ..core.runtime import try_get_runtime
+
+        rt = try_get_runtime()
+        for entry in actor_of:
+            if entry is None:
+                continue
+            aid = getattr(entry[0], "_actor_id", None)
+            if aid is None:
+                continue
+            self._chan_actor_bytes.add(aid.binary())
+            if rt is not None:
+                self._chan_restarts[aid] = \
+                    rt.actor_manager.num_restarts(aid)
+
+    def _subscribe_actor_state(self):
+        """Cluster mode: head-published actor FSM transitions for our
+        channel actors mark the ring plan dirty (RESTARTING → tear
+        down, fall back to the object plane; ALIVE → rebuild against
+        the new endpoints)."""
+        from ..core.runtime import try_get_runtime
+
+        rt = try_get_runtime()
+        if (rt is None or rt.cluster is None
+                or self._state_listener is not None):
+            return
+
+        def on_state(aid_bytes, _state, _event):
+            if aid_bytes in self._chan_actor_bytes:
+                self._rings_dirty = True
+
+        self._state_listener = on_state
+        rt.cluster.add_actor_state_listener(on_state)
+
+    def _restarts_changed(self) -> bool:
+        from ..core.runtime import try_get_runtime
+
+        rt = try_get_runtime()
+        if rt is None:
+            return False
+        return any(rt.actor_manager.num_restarts(aid) != n
+                   for aid, n in self._chan_restarts.items())
+
+    def _maybe_replan(self):
+        """Called under _submit_order_lock at the top of execute: when
+        a channel actor restarted (or a pass died to a ring fault),
+        tear down the stale rings — waking anything still blocked on
+        them — restore the pristine object-plane plans, and re-run
+        channel planning against the actors' CURRENT endpoints.  An
+        actor still mid-restart contributes no ring this round (its
+        edges ride the object plane) and triggers another replan when
+        its ALIVE event lands."""
+        if not self._chan_recovery:
+            return
+        if not (self._rings_dirty or self._restarts_changed()):
+            return
+        from ..experimental.channel import (destroy_channel,
+                                            destroy_channel_at)
+
+        old_edges = dict(self._channel_edges)
+        old_nodes = dict(self._channel_nodes)
+        for step, (ap, kp, sub) in zip(self._steps, self._plane_plans):
+            step.arg_plan = list(ap)
+            step.kw_plan = dict(kp)
+            step.submit = sub
+        self._channel_edges = {}
+        self._channel_nodes = {}
+        self._rings_dirty = False
+        # Local teardown inline (fast; wakes blocked local endpoints).
+        # The REMOTE destroys ride a background thread: RPCs against a
+        # possibly-dead node cost seconds each, and we are under
+        # _submit_order_lock — concurrent execute() callers must not
+        # stall behind the teardown ("the lock is held briefly").
+        for path in old_edges.values():
+            destroy_channel(path)
+        remote_nodes = {path: nodes for path in old_edges.values()
+                        if (nodes := {a for a in
+                                      old_nodes.get(path, ()) if a})}
+        if remote_nodes:
+            threading.Thread(
+                target=lambda: [destroy_channel_at(p, ns)
+                                for p, ns in remote_nodes.items()],
+                daemon=True,
+                name="dag-ring-teardown").start()
+        self._plan_channel_transport()
 
     def _make_channel_submit(self, node, writes, returns_value):
         from ..experimental.channel import submit_channel_call
@@ -332,8 +455,13 @@ class CompiledDAG:
             # per-actor FIFO order, so one pass's submissions must not
             # interleave with another's (concurrent execute callers).
             # Submissions only enqueue — the lock is held briefly.
-            with self._submit_order_lock if self._channel_edges \
+            # The lock also covers re-planning (a channel-recovery DAG
+            # keeps taking it even while its edges ride the object
+            # plane, so an ALIVE event can swing them back to rings).
+            with self._submit_order_lock if (
+                    self._channel_edges or self._chan_recovery) \
                     else _NULL_CTX:
+                self._maybe_replan()
                 for step in self._steps:
                     args = tuple(resolve(e) for e in step.arg_plan)
                     kwargs = {k: resolve(e)
@@ -357,6 +485,17 @@ class CompiledDAG:
             pending = [len(tails)]
 
             def one_done(_obj=None):
+                # A pass dying to a data-plane fault marks the ring
+                # plan dirty: the next execute tears down and rebuilds
+                # (restart-aware recovery).
+                if self._chan_recovery:
+                    from ..exceptions import (ActorError, ChannelError,
+                                              ObjectLostError)
+
+                    err = getattr(_obj, "error", None)
+                    if isinstance(err, (ActorError, ChannelError,
+                                        ObjectLostError)):
+                        self._rings_dirty = True
                 with rel_lock:
                     pending[0] -= 1
                     last = pending[0] == 0
@@ -375,6 +514,15 @@ class CompiledDAG:
     def teardown(self):
         import ray_tpu
 
+        if self._state_listener is not None:
+            from ..core.runtime import try_get_runtime
+
+            rt = try_get_runtime()
+            if rt is not None and rt.cluster is not None:
+                rt.cluster.remove_actor_state_listener(
+                    self._state_listener)
+            self._state_listener = None
+        self._chan_recovery = False
         for node, handle in self._actors:
             try:
                 ray_tpu.kill(handle)

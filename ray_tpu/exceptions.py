@@ -16,6 +16,18 @@ class RayTpuError(Exception):
     """Base for all framework errors."""
 
 
+def _format_context(context) -> str:
+    """``" [k=v k2=v2]"`` suffix for FT error messages, or ""."""
+    if not context:
+        return ""
+    parts = []
+    for k, v in context.items():
+        if isinstance(v, bytes):
+            v = v.hex()[:16]
+        parts.append(f"{k}={v}")
+    return " [" + " ".join(parts) + "]"
+
+
 def _picklable_cause(cause: BaseException) -> BaseException:
     """Return ``cause`` if it survives a pickle round-trip, else a
     stringified stand-in.  Errors cross the RPC boundary inside task
@@ -66,15 +78,31 @@ class ActorError(RayTpuError):
 
 
 class ActorDiedError(ActorError):
-    """The actor is dead (creation failed, killed, or out of restarts)."""
+    """The actor is dead (creation failed, killed, or out of restarts).
 
-    def __init__(self, actor_id=None, reason: str = "actor died"):
+    Carries structured context so the message is actionable at the
+    driver: ``node_id`` (where it was hosted) and a free-form
+    ``context`` dict the failure site fills in (pass/step index,
+    originating channel edge, chaos detail, ...)."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died",
+                 node_id=None, context=None):
         self.actor_id = actor_id
         self.reason = reason
-        super().__init__(reason)
+        self.node_id = node_id
+        self.context = dict(context or {})
+        ctx = dict(self.context)
+        if actor_id is not None:
+            hexfn = getattr(actor_id, "hex", None)
+            ctx.setdefault("actor_id",
+                           hexfn()[:16] if callable(hexfn) else actor_id)
+        if node_id is not None:
+            ctx.setdefault("node_id", str(node_id)[:16])
+        super().__init__(reason + _format_context(ctx))
 
     def __reduce__(self):
-        return (type(self), (self.actor_id, self.reason))
+        return (type(self), (self.actor_id, self.reason, self.node_id,
+                             self.context))
 
 
 class ActorUnavailableError(ActorError):
@@ -82,15 +110,38 @@ class ActorUnavailableError(ActorError):
 
 
 class ObjectLostError(RayTpuError):
-    """Object value unrecoverable (all copies lost, lineage exhausted)."""
+    """Object value unrecoverable (all copies lost, lineage exhausted).
 
-    def __init__(self, object_ref=None, reason: str = "object lost"):
+    ``context`` mirrors ActorDiedError: holder node, originating edge,
+    pass index — whatever the failure site knows."""
+
+    def __init__(self, object_ref=None, reason: str = "object lost",
+                 context=None):
         self.object_ref = object_ref
         self.reason = reason
-        super().__init__(reason)
+        self.context = dict(context or {})
+        super().__init__(reason + _format_context(self.context))
 
     def __reduce__(self):
-        return (type(self), (self.object_ref, self.reason))
+        return (type(self), (self.object_ref, self.reason, self.context))
+
+
+class ChannelError(RayTpuError):
+    """A channel-data-plane edge failed: the producer feeding the ring
+    raised (its error frame rides here as ``__cause__``), the ring was
+    severed/closed mid-pass, or the read deadline expired.  ``context``
+    names the edge (ring path, producer actor, frame/pass index) so the
+    driver-side message is actionable.  Propagates UNWRAPPED through
+    task results (like the other FT errors) so callers can catch it
+    typed."""
+
+    def __init__(self, reason: str = "channel error", context=None):
+        self.reason = reason
+        self.context = dict(context or {})
+        super().__init__(reason + _format_context(self.context))
+
+    def __reduce__(self):
+        return (type(self), (self.reason, self.context))
 
 
 class ObjectFreedError(ObjectLostError):

@@ -17,8 +17,21 @@ module is the adapter layer that puts it on the hot path:
   ships as an object-plane ref inside a tiny ring frame, so one huge
   pass never breaks the compiled plan.
 - **Error propagation**: a producer failure writes an error frame
-  before re-raising, so blocked consumers fail fast instead of timing
-  out.
+  (with structured context: actor, method, frame index, ring) before
+  re-raising, so blocked consumers fail fast instead of timing out —
+  and a consumer that reads an error frame fans it out to ITS writer
+  rings before re-raising, so one dead producer fails the whole DAG
+  pass instead of wedging downstream readers (poison-pill fan-out).
+- **Self-healing reads**: ring reads are deadline-bounded and probe
+  peer liveness between poll slices — both the peer PROCESS (pid probe
+  in native/channel.cc, promoted from a test hook to the blocked-wait
+  path) and the producer ACTOR's FSM state (a thread-actor in this
+  process, or a remote actor via the head) — so a producer dying
+  mid-pass without flushing an error frame surfaces as a typed
+  ``ActorDiedError`` within one probe slice, never a wedged reader.
+- **Chaos hooks**: every frame write consults the active
+  ``experimental.chaos`` schedule (kill-at-Nth-write, sever-mid-frame),
+  which is how the recovery paths above are tested deterministically.
 
 Same-host producer→consumer actor edges of ``CompiledDAG`` and
 adjacent ``train.cross_pipeline`` stages ride these rings at memcpy
@@ -36,14 +49,19 @@ import time
 import uuid
 from typing import Any, Dict, Optional, Sequence, Tuple
 
-from ray_tpu.native.channel import Channel, ChannelClosed
+from ray_tpu.native.channel import (Channel, ChannelClosed,
+                                    ChannelPeerDied)
+
+from ..exceptions import (ActorDiedError, ActorError, ChannelError,
+                          ObjectLostError, _picklable_cause)
+from . import chaos as _chaos
 
 __all__ = [
-    "Channel", "ChannelClosed", "ChannelArg", "ChannelError",
-    "ChannelWriter", "ChannelReader", "channels_available",
-    "channel_path", "submit_channel_call", "channel_host",
-    "channel_location", "destroy_channel", "destroy_channel_at",
-    "CHANNEL_STEP_METHOD",
+    "Channel", "ChannelClosed", "ChannelPeerDied", "ChannelArg",
+    "ChannelError", "ChannelWriter", "ChannelReader",
+    "channels_available", "channel_path", "submit_channel_call",
+    "channel_host", "channel_location", "destroy_channel",
+    "destroy_channel_at", "CHANNEL_STEP_METHOD",
 ]
 
 # Actor-task descriptor name dispatched to the channel trampoline by
@@ -52,11 +70,17 @@ CHANNEL_STEP_METHOD = "__rt_channel_step__"
 
 DEFAULT_TIMEOUT_S = 120.0
 _MIN_SLOT_BYTES = 64 * 1024
+# Blocked reads poll in slices this long, probing producer liveness
+# between slices (native pid probe + actor FSM state).
+_READ_POLL_S = 0.2
+# Actor-state probes (may cost a head RPC for remote producers) are
+# throttled to this period.
+_PROBE_PERIOD_S = 0.5
 
 # Frame tags (first byte of every ring frame).
 _TAG_VALUE = 0x57   # "W": flat wire bytes follow
 _TAG_REF = 0x52     # "R": pickled ObjectRef (payload exceeded the slot)
-_TAG_ERROR = 0x45   # "E": pickled producer exception
+_TAG_ERROR = 0x45   # "E": pickled {"err": exc, "ctx": {...}} dict
 
 _available: Optional[bool] = None
 _avail_lock = threading.Lock()
@@ -87,9 +111,43 @@ def channel_path(tag: str) -> str:
         base, f"rtchan-{os.getpid()}-{tag}-{uuid.uuid4().hex[:8]}")
 
 
-class ChannelError(RuntimeError):
-    """A producer upstream of this channel edge failed; carries the
-    original exception as ``__cause__``."""
+# ChannelError now lives in ray_tpu.exceptions (imported above) so the
+# runtime can propagate it typed through task results.
+
+
+def _producer_state(producer) -> Optional[str]:
+    """FSM state of the producer actor feeding a ring, from wherever
+    this process can see it: the local actor table (thread actors in
+    this process), else the head's registry.  None = unknown (no
+    runtime, no producer recorded, or the lookup failed) — callers
+    treat unknown as alive and keep waiting out their deadline."""
+    if producer is None:
+        return None
+    from ..core.runtime import try_get_runtime
+
+    rt = try_get_runtime()
+    if rt is None:
+        return None
+    core = rt.actor_manager.get_core(producer)
+    if core is not None:
+        state = core.info.state.value
+        return "ALIVE" if state == "PENDING_CREATION" else state
+    if rt.cluster is None:
+        return None
+    try:
+        _loc, state = rt.cluster.locate_actor_with_state(producer)
+    except Exception:
+        return None
+    return state
+
+
+def _raise_if_producer_gone(producer, path: str) -> None:
+    state = _producer_state(producer)
+    if state in ("DEAD", "RESTARTING"):
+        raise ActorDiedError(
+            producer,
+            f"producer of channel ring died mid-pass (state={state})",
+            context={"ring": os.path.basename(path)})
 
 
 def _round_up_pow2(n: int) -> int:
@@ -117,6 +175,10 @@ class ChannelWriter:
         self.timeout = timeout
         self._chan: Optional[Channel] = None
         self._lock = threading.Lock()
+        # Value frames written so far ≙ this edge's pass index (FIFO
+        # submission keeps frames in pass order); rides error-frame
+        # context and is the chaos kill/sever trigger coordinate.
+        self._seq = 0
         # Oversize-fallback refs pinned until their frame is long
         # consumed.  The reader resolves a ref frame inline before its
         # next read, and the ring caps the writer at n_slots frames
@@ -128,12 +190,32 @@ class ChannelWriter:
     def _ensure(self, frame_len: int) -> Channel:
         with self._lock:
             if self._chan is None:
+                # A stale producer must not re-create a torn-down ring.
+                _check_not_destroyed(self.path)
                 slot = _round_up_pow2(
                     max(self.slot_bytes_hint, frame_len))
                 Channel.create(self.path, n_slots=self.n_slots,
                                slot_bytes=slot)
                 self._chan = Channel(self.path, writer=True)
             return self._chan
+
+    def _chaos_gate(self) -> None:
+        """Consult the active chaos schedule before a frame write: may
+        raise ChaosKill (producer dies mid-pass, nothing flushed) or
+        sever the ring (both sides observe ChannelClosed)."""
+        action = _chaos.ring_write_action(self.path, self._seq)
+        if action is None:
+            return
+        if action[0] == "kill":
+            raise _chaos.ChaosKill(
+                f"killed at write #{self._seq} of "
+                f"{os.path.basename(self.path)}",
+                no_restart=action[1])
+        if action[0] == "sever":
+            try:
+                self._ensure(1).close()
+            except Exception:
+                pass
 
     def put_value(self, value: Any) -> None:
         """Serialize ``value`` into the ring as its flat wire layout
@@ -143,6 +225,8 @@ class ChannelWriter:
         pass completes without breaking the plan."""
         from ..cluster.serialization import serialize, wire_layout
 
+        self._seq += 1
+        self._chaos_gate()
         meta, bufs = wire_layout(serialize(value))
         hdr = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
         parts = [bytes([_TAG_VALUE]), len(hdr).to_bytes(4, "big"),
@@ -164,14 +248,23 @@ class ChannelWriter:
         return bytes([_TAG_REF]) + pickle.dumps(
             ref, protocol=pickle.HIGHEST_PROTOCOL)
 
-    def put_error(self, err: BaseException) -> None:
+    def put_error(self, err: BaseException,
+                  ctx: Optional[dict] = None) -> None:
         """Best-effort: wake the consumer with the producer's failure
-        instead of letting it block out its timeout."""
+        instead of letting it block out its timeout.  The frame carries
+        structured context (ring, frame/pass index, plus whatever the
+        caller knows: actor, method) so the error surfacing at the
+        driver names the originating edge."""
+        frame_ctx = {"ring": os.path.basename(self.path),
+                     "frame_seq": self._seq, **(ctx or {})}
         try:
-            payload = pickle.dumps(err, protocol=pickle.HIGHEST_PROTOCOL)
+            payload = pickle.dumps({"err": _picklable_cause(err),
+                                    "ctx": frame_ctx},
+                                   protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:
             payload = pickle.dumps(
-                RuntimeError(f"{type(err).__name__}: {err}"))
+                {"err": RuntimeError(f"{type(err).__name__}: {err}"),
+                 "ctx": frame_ctx})
         try:
             chan = self._ensure(len(payload) + 1)
             chan.put(bytes([_TAG_ERROR]) + payload, timeout=5.0)
@@ -203,30 +296,95 @@ class ChannelReader:
         self.timeout = timeout
         self._chan: Optional[Channel] = None
         self._lock = threading.Lock()
+        # Lets close() break a reader still waiting for the ring FILE
+        # to appear (the native close flag can only wake waits on an
+        # existing ring).
+        self._closed = threading.Event()
 
-    def _ensure(self) -> Channel:
+    def _ensure(self, producer=None,
+                deadline: Optional[float] = None) -> Channel:
         with self._lock:
             if self._chan is None:
-                deadline = time.monotonic() + self.timeout
+                if deadline is None:
+                    deadline = time.monotonic() + self.timeout
+                probe_at = time.monotonic() + _PROBE_PERIOD_S
                 while True:
+                    if self._closed.is_set():
+                        raise ChannelError(
+                            "ring torn down while waiting for its "
+                            "writer to create it",
+                            context={"ring":
+                                     os.path.basename(self.path)})
+                    _check_not_destroyed(self.path)
                     try:
                         self._chan = Channel(self.path, writer=False)
                         break
                     except FileNotFoundError:
-                        if time.monotonic() > deadline:
-                            raise TimeoutError(
-                                f"channel {self.path} was never created "
-                                f"by its writer "
-                                f"(waited {self.timeout:.0f}s)")
+                        now = time.monotonic()
+                        if now >= probe_at:
+                            # The writer creates the ring at its first
+                            # put: a dead producer means it never will.
+                            probe_at = now + _PROBE_PERIOD_S
+                            _raise_if_producer_gone(producer, self.path)
+                        if now > deadline:
+                            # Typed (not a bare TimeoutError): the
+                            # poison-pill fan-out and replan paths key
+                            # on FT error types.
+                            raise ChannelError(
+                                "ring was never created by its writer "
+                                f"(waited {self.timeout:.0f}s)",
+                                context={"ring":
+                                         os.path.basename(self.path)})
                         time.sleep(0.001)
             return self._chan
 
-    def get_value(self) -> Any:
+    def _read_frame(self, producer) -> bytearray:
+        """Deadline-bounded blocking read.  Polls in short slices and
+        probes producer liveness between them; a producer dying WITHOUT
+        flushing an error frame (hard kill) surfaces as a typed
+        ActorDiedError within ~one probe period instead of wedging the
+        reader until its full timeout.  ONE timeout budget covers both
+        waiting for the ring to exist and waiting for the frame."""
+        deadline = time.monotonic() + self.timeout
+        chan = self._ensure(producer, deadline)
+        probe_at = 0.0
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise ChannelError(
+                    f"read deadline ({self.timeout:.0f}s) expired",
+                    context={"ring": os.path.basename(self.path)})
+            try:
+                return chan.get_buffer(timeout=min(_READ_POLL_S, left))
+            except ChannelPeerDied as e:
+                # Native pid probe: the writer PROCESS is gone.
+                raise ActorDiedError(
+                    producer,
+                    "producer process of channel ring died mid-pass",
+                    context={"ring": os.path.basename(self.path)}) from e
+            except ChannelClosed as e:
+                # Severed / torn down under us: typed, not a raw
+                # ConnectionError, so one close fails the pass fast.
+                raise ChannelError(
+                    f"ring closed mid-pass: {e}",
+                    context={"ring": os.path.basename(self.path)}) from e
+            except TimeoutError:
+                now = time.monotonic()
+                if now >= probe_at:
+                    probe_at = now + _PROBE_PERIOD_S
+                    # Actor-FSM probe: catches thread actors in this
+                    # process (same pid, invisible to the native probe)
+                    # and head-reported remote deaths/restarts.
+                    _raise_if_producer_gone(producer, self.path)
+
+    def get_value(self, producer=None) -> Any:
         from ..cluster.serialization import deserialize, sealed_from_flat
 
-        data = self._ensure().get_buffer(timeout=self.timeout)
+        data = self._read_frame(producer)
         if not data:
-            raise ChannelError(f"empty frame on channel {self.path}")
+            raise ChannelError(
+                "empty frame",
+                context={"ring": os.path.basename(self.path)})
         tag = data[0]
         if tag == _TAG_VALUE:
             mv = memoryview(data)
@@ -241,14 +399,28 @@ class ChannelReader:
             ref = pickle.loads(memoryview(data)[1:])
             return get_runtime().get(ref)
         if tag == _TAG_ERROR:
-            err = pickle.loads(memoryview(data)[1:])
+            payload = pickle.loads(memoryview(data)[1:])
+            if isinstance(payload, dict):
+                err, ctx = payload.get("err"), dict(
+                    payload.get("ctx") or {})
+            else:  # legacy bare-exception frame
+                err, ctx = payload, {}
+            if isinstance(err, (ActorError, ObjectLostError,
+                                ChannelError)):
+                # Already typed + contextual (poison-pill fan-out keeps
+                # the ORIGINATING edge's context intact hop to hop).
+                raise err
             raise ChannelError(
-                f"producer feeding channel {self.path} failed: "
-                f"{type(err).__name__}: {err}") from err
+                f"producer failed: {type(err).__name__}: {err}",
+                context=ctx) from err
         raise ChannelError(
-            f"unknown frame tag {tag:#x} on channel {self.path}")
+            f"unknown frame tag {tag:#x}",
+            context={"ring": os.path.basename(self.path)})
 
     def close(self) -> None:
+        # Flag first: a waiter inside _ensure's creation loop (which
+        # holds the lock) exits within one iteration, releasing it.
+        self._closed.set()
         with self._lock:
             chan, self._chan = self._chan, None
         if chan is not None:
@@ -261,6 +433,29 @@ class ChannelReader:
 _writers: Dict[str, ChannelWriter] = {}
 _readers: Dict[str, ChannelReader] = {}
 _ep_lock = threading.Lock()
+# Tombstones of destroyed ring paths (paths are uuid-unique, never
+# reused).  A STALE task from an aborted pass touching a torn-down
+# edge gets a fresh endpoint (its cached one was popped at destroy) —
+# without the tombstone a stale reader would wait its full timeout for
+# a ring file that will never reappear (wedging the actor FIFO behind
+# it), and a stale producer's error path would re-CREATE the destroyed
+# ring file.  Bounded FIFO (dict preserves insertion order).
+_destroyed: Dict[str, None] = {}
+_MAX_TOMBSTONES = 1024
+
+
+def _mark_destroyed(path: str) -> None:
+    """Caller holds _ep_lock."""
+    _destroyed[path] = None
+    while len(_destroyed) > _MAX_TOMBSTONES:
+        _destroyed.pop(next(iter(_destroyed)))
+
+
+def _check_not_destroyed(path: str) -> None:
+    if path in _destroyed:
+        raise ChannelError(
+            "ring was torn down (stale edge from an aborted pass)",
+            context={"ring": os.path.basename(path)})
 
 
 def _writer_for(spec: Tuple) -> ChannelWriter:
@@ -284,8 +479,12 @@ def _reader_for(path: str, timeout: float) -> ChannelReader:
 
 def destroy_channel(path: str) -> None:
     """Teardown: close + unlink the ring, waking any blocked peer.
-    Safe to call for rings that were never created or already gone."""
+    Safe to call for rings that were never created or already gone.
+    The path is tombstoned: endpoints created for it afterwards (stale
+    tasks of an aborted pass) fail fast instead of waiting out their
+    timeout or re-creating the file."""
     with _ep_lock:
+        _mark_destroyed(path)
         writer = _writers.pop(path, None)
         reader = _readers.pop(path, None)
     if reader is not None:
@@ -316,13 +515,17 @@ def destroy_channel(path: str) -> None:
 class ChannelArg:
     """Placeholder in a task's arguments: resolved to the value read
     from ``path`` inside the executing actor.  Duplicate placeholders
-    for the same path within one call consume ONE frame."""
+    for the same path within one call consume ONE frame.  ``producer``
+    (the writing actor's id, when the planner knows it) powers the
+    reader's liveness probing."""
 
-    __slots__ = ("path", "timeout")
+    __slots__ = ("path", "timeout", "producer")
 
-    def __init__(self, path: str, timeout: float = DEFAULT_TIMEOUT_S):
+    def __init__(self, path: str, timeout: float = DEFAULT_TIMEOUT_S,
+                 producer=None):
         self.path = path
         self.timeout = timeout
+        self.producer = producer
 
     def __repr__(self):
         return f"ChannelArg({os.path.basename(self.path)})"
@@ -331,30 +534,77 @@ class ChannelArg:
 def bind_channel_step(instance):
     """Build the executable for a ``__rt_channel_step__`` actor task:
     read channel args, run the real method, tee the result into the
-    edge's writer rings (Runtime._lookup_callable dispatches here)."""
+    edge's writer rings (Runtime._lookup_callable dispatches here).
+
+    Failure semantics:
+    - an UPSTREAM failure (error frame / dead producer detected while
+      resolving channel args) fans out to this step's own writer rings
+      before re-raising — the poison pill that fails the whole pass
+      fast instead of wedging readers further downstream;
+    - this step's OWN failure writes context-rich error frames;
+    - an injected ChaosKill kills the actor and flushes NOTHING (a
+      simulated hard death: downstream must detect via liveness
+      probing, which is exactly what it exercises)."""
 
     def run(_rt_chan_plan, *args, **kwargs):
+        from ..core import runtime_context as rc_mod
+        from ..core.runtime import try_get_runtime
+
         method_name, writes, returns_value = _rt_chan_plan
+        tctx = rc_mod.current_task_context()
+        actor_id = tctx.actor_id if tctx is not None else None
+        frame_ctx = {"method": method_name}
+        if actor_id is not None:
+            frame_ctx["actor_id"] = actor_id.hex()[:16]
         seen: Dict[str, Any] = {}
 
         def resolve(v):
             if isinstance(v, ChannelArg):
                 if v.path not in seen:
                     seen[v.path] = _reader_for(
-                        v.path, v.timeout).get_value()
+                        v.path, v.timeout).get_value(
+                            producer=v.producer)
                 return seen[v.path]
             return v
 
-        args = tuple(resolve(a) for a in args)
-        kwargs = {k: resolve(v) for k, v in kwargs.items()}
+        try:
+            args = tuple(resolve(a) for a in args)
+            kwargs = {k: resolve(v) for k, v in kwargs.items()}
+        except (ChannelError, ActorError, ObjectLostError) as e:
+            for w in writes:
+                _writer_for(w).put_error(e, frame_ctx)
+            raise
+        # Rings that already received this pass's VALUE frame must not
+        # also get the error frame — that would leave them one frame
+        # ahead (their consumer completes this pass, then reads a
+        # stale, misattributed error next pass).
+        written: set = set()
         try:
             result = getattr(instance, method_name)(*args, **kwargs)
+            for w in writes:
+                _writer_for(w).put_value(result)
+                written.add(w)
+        except _chaos.ChaosKill as ck:
+            rt = try_get_runtime()
+            if rt is not None and actor_id is not None:
+                rt.kill_actor(actor_id, no_restart=ck.no_restart)
+            raise ActorDiedError(
+                actor_id, f"chaos: {ck}",
+                context={"method": method_name})
+        except ChannelClosed as e:
+            err = ChannelError(
+                f"ring closed mid-pass under {method_name!r}: {e}",
+                context=frame_ctx)
+            err.__cause__ = e
+            for w in writes:
+                if w not in written:
+                    _writer_for(w).put_error(err, frame_ctx)
+            raise err
         except BaseException as e:
             for w in writes:
-                _writer_for(w).put_error(e)
+                if w not in written:
+                    _writer_for(w).put_error(e, frame_ctx)
             raise
-        for w in writes:
-            _writer_for(w).put_value(result)
         return result if returns_value else None
 
     return run
@@ -461,8 +711,11 @@ def destroy_channel_at(path: str,
         if rt is None or rt.cluster is None:
             break
         try:
-            rt.cluster.pool.get(address).call(
-                "channel_destroy", {"path": path}, timeout=10.0)
+            # channel_destroy is naturally idempotent (a missing file
+            # is not an error), so transport drops are simply retried.
+            rt.cluster.pool.get(address).call_with_retry(
+                "channel_destroy", {"path": path}, timeout=10.0,
+                deadline_s=15.0)
         except Exception:
             pass
     destroy_channel(path)

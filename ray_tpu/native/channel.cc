@@ -12,8 +12,12 @@
 // with PTHREAD_PROCESS_SHARED set, living in the mapping's header (the
 // reference uses the same pthread-in-shm technique).  The producer
 // blocks when the ring is full (backpressure), the consumer when it is
-// empty.  A peer death is detected by a heartbeat-free close flag plus
-// ETIMEDOUT on the condvar waits.
+// empty.  Peer death is detected two ways, both on the blocking paths
+// (not just the close flag): the robust mutex surfaces EOWNERDEAD when
+// a holder dies mid-critical-section, and each side records its pid in
+// the header at open so a blocked wait can probe the peer process
+// (kill(pid, 0)) between condvar slices and return -ECONNRESET instead
+// of sleeping out the full timeout against a corpse.
 //
 // Build: g++ -O2 -shared -fPIC channel.cc -o libray_tpu_channel.so
 // (the Python wrapper compiles this lazily and loads it with ctypes —
@@ -26,13 +30,18 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/types.h>
 #include <unistd.h>
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52544348414E4E31ULL;  // "RTCHANN1"
+constexpr uint64_t kMagic = 0x52544348414E4E32ULL;  // "RTCHANN2"
+
+// Blocked waits wake at least this often to probe peer liveness.
+constexpr double kProbeSliceS = 0.2;
 
 struct Header {
   uint64_t magic;
@@ -45,6 +54,8 @@ struct Header {
   uint64_t read_idx;    // next slot the consumer drains
   uint32_t closed;      // either side closed
   uint32_t _pad;
+  uint64_t writer_pid;  // recorded at open; 0 = side never attached
+  uint64_t reader_pid;
   uint64_t lengths[];   // per-slot payload length
 };
 
@@ -77,6 +88,12 @@ void abs_deadline(timespec* ts, double timeout_s) {
     ts->tv_sec += 1;
     ts->tv_nsec -= 1000000000L;
   }
+}
+
+double now_mono() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + ts.tv_nsec * 1e-9;
 }
 
 }  // namespace
@@ -149,12 +166,30 @@ void* rtchan_open(const char* path, int writable) {
     munmap(mem, static_cast<size_t>(st.st_size));
     return nullptr;
   }
+  // Record this side's pid so the peer's blocked waits can probe our
+  // liveness (single producer / single consumer: one pid per side).
+  if (writable) {
+    h->writer_pid = static_cast<uint64_t>(getpid());
+  } else {
+    h->reader_pid = static_cast<uint64_t>(getpid());
+  }
   Chan* c = new Chan;
   c->h = h;
   c->slots = slot_base(h);
   c->map_bytes = static_cast<size_t>(st.st_size);
   c->writable = writable;
   return c;
+}
+
+// 1 if the OTHER side attached and its process no longer exists.  A
+// same-pid ring (both endpoints in one process, e.g. in-process actors)
+// never reports a dead peer — thread death is the actor runtime's to
+// detect.
+static int peer_is_dead(Chan* c) {
+  uint64_t pid =
+      c->writable ? c->h->reader_pid : c->h->writer_pid;
+  if (pid == 0 || pid == static_cast<uint64_t>(getpid())) return 0;
+  return kill(static_cast<pid_t>(pid), 0) == -1 && errno == ESRCH;
 }
 
 static int lock_robust(Header* h) {
@@ -182,30 +217,42 @@ static int timedwait_robust(pthread_cond_t* cv, Header* h,
   return rc;
 }
 
+// Block until the ring has data (reader) / a free slot (writer), with
+// the mutex held on entry AND exit.  Waits in <= kProbeSliceS slices,
+// probing the peer process between slices — a peer dying mid-pass
+// surfaces as -ECONNRESET in one slice instead of a full-timeout hang.
+// Returns 0 (condition holds), -EPIPE (closed), -ETIMEDOUT, or
+// -ECONNRESET (peer process gone).
+static int wait_ring(Chan* c, int for_reader, double timeout_s) {
+  Header* h = c->h;
+  double deadline = now_mono() + timeout_s;
+  while (for_reader ? (h->read_idx == h->write_idx)
+                    : (h->write_idx - h->read_idx >= h->n_slots)) {
+    if (h->closed) return -EPIPE;
+    double left = deadline - now_mono();
+    if (left <= 0) return -ETIMEDOUT;
+    if (peer_is_dead(c)) return -ECONNRESET;
+    timespec ts;
+    abs_deadline(&ts, left < kProbeSliceS ? left : kProbeSliceS);
+    timedwait_robust(for_reader ? &h->not_empty : &h->not_full, h, &ts);
+  }
+  return 0;
+}
+
 // Producer: wait for a free slot, copy payload in, publish.
-// Returns 0, -ETIMEDOUT, -EPIPE (closed), -EMSGSIZE, or -errno.
+// Returns 0, -ETIMEDOUT, -EPIPE (closed), -ECONNRESET (reader process
+// died), -EMSGSIZE, or -errno.
 int rtchan_put(void* chan, const uint8_t* data, uint64_t len,
                double timeout_s) {
   Chan* c = static_cast<Chan*>(chan);
   Header* h = c->h;
   if (len > h->slot_bytes) return -EMSGSIZE;
-  timespec ts;
-  abs_deadline(&ts, timeout_s);
   if (lock_robust(h) != 0) return -EINVAL;
-  while (h->write_idx - h->read_idx >= h->n_slots) {
-    if (h->closed) {
-      pthread_mutex_unlock(&h->mu);
-      return -EPIPE;
-    }
-    int rc = timedwait_robust(&h->not_full, h, &ts);
-    if (rc == ETIMEDOUT) {
-      pthread_mutex_unlock(&h->mu);
-      return -ETIMEDOUT;
-    }
-  }
-  if (h->closed) {
+  int rc = wait_ring(c, /*for_reader=*/0, timeout_s);
+  if (rc == 0 && h->closed) rc = -EPIPE;
+  if (rc != 0) {
     pthread_mutex_unlock(&h->mu);
-    return -EPIPE;
+    return rc;
   }
   uint64_t slot = h->write_idx % h->n_slots;
   // Copy OUTSIDE the lock would race the consumer's release; with one
@@ -223,25 +270,18 @@ int rtchan_put(void* chan, const uint8_t* data, uint64_t len,
 
 // Consumer: wait for a sealed slot; copies payload into out (cap
 // out_cap) and releases the slot.  Returns payload length, -ETIMEDOUT,
-// -EPIPE (closed AND drained), or -EMSGSIZE if out_cap is too small
-// (slot is NOT released so the caller can retry with a bigger buffer).
+// -EPIPE (closed AND drained), -ECONNRESET (writer process died), or
+// -EMSGSIZE if out_cap is too small (slot is NOT released so the
+// caller can retry with a bigger buffer).
 int64_t rtchan_get(void* chan, uint8_t* out, uint64_t out_cap,
                    double timeout_s) {
   Chan* c = static_cast<Chan*>(chan);
   Header* h = c->h;
-  timespec ts;
-  abs_deadline(&ts, timeout_s);
   if (lock_robust(h) != 0) return -EINVAL;
-  while (h->read_idx == h->write_idx) {
-    if (h->closed) {
-      pthread_mutex_unlock(&h->mu);
-      return -EPIPE;
-    }
-    int rc = timedwait_robust(&h->not_empty, h, &ts);
-    if (rc == ETIMEDOUT) {
-      pthread_mutex_unlock(&h->mu);
-      return -ETIMEDOUT;
-    }
+  int wrc = wait_ring(c, /*for_reader=*/1, timeout_s);
+  if (wrc != 0) {
+    pthread_mutex_unlock(&h->mu);
+    return wrc;
   }
   uint64_t slot = h->read_idx % h->n_slots;
   uint64_t len = h->lengths[slot];
@@ -264,23 +304,16 @@ int64_t rtchan_get(void* chan, uint8_t* out, uint64_t out_cap,
 int64_t rtchan_next_len(void* chan, double timeout_s) {
   Chan* c = static_cast<Chan*>(chan);
   Header* h = c->h;
-  timespec ts;
-  abs_deadline(&ts, timeout_s);
   if (lock_robust(h) != 0) return -EINVAL;
-  while (h->read_idx == h->write_idx) {
-    if (h->closed) {
-      pthread_mutex_unlock(&h->mu);
-      return -EPIPE;
-    }
-    if (timeout_s <= 0) {
-      pthread_mutex_unlock(&h->mu);
-      return -EAGAIN;
-    }
-    int rc = timedwait_robust(&h->not_empty, h, &ts);
-    if (rc == ETIMEDOUT) {
-      pthread_mutex_unlock(&h->mu);
-      return -ETIMEDOUT;
-    }
+  if (timeout_s <= 0 && h->read_idx == h->write_idx) {
+    int empty_rc = h->closed ? -EPIPE : -EAGAIN;
+    pthread_mutex_unlock(&h->mu);
+    return empty_rc;
+  }
+  int wrc = wait_ring(c, /*for_reader=*/1, timeout_s);
+  if (wrc != 0) {
+    pthread_mutex_unlock(&h->mu);
+    return wrc;
   }
   int64_t len =
       static_cast<int64_t>(h->lengths[h->read_idx % h->n_slots]);
@@ -299,25 +332,12 @@ int64_t rtchan_next_len(void* chan, double timeout_s) {
 uint8_t* rtchan_write_begin(void* chan, double timeout_s, int64_t* err) {
   Chan* c = static_cast<Chan*>(chan);
   Header* h = c->h;
-  timespec ts;
-  abs_deadline(&ts, timeout_s);
   if (lock_robust(h) != 0) { *err = -EINVAL; return nullptr; }
-  while (h->write_idx - h->read_idx >= h->n_slots) {
-    if (h->closed) {
-      pthread_mutex_unlock(&h->mu);
-      *err = -EPIPE;
-      return nullptr;
-    }
-    int rc = timedwait_robust(&h->not_full, h, &ts);
-    if (rc == ETIMEDOUT) {
-      pthread_mutex_unlock(&h->mu);
-      *err = -ETIMEDOUT;
-      return nullptr;
-    }
-  }
-  if (h->closed) {
+  int wrc = wait_ring(c, /*for_reader=*/0, timeout_s);
+  if (wrc == 0 && h->closed) wrc = -EPIPE;
+  if (wrc != 0) {
     pthread_mutex_unlock(&h->mu);
-    *err = -EPIPE;
+    *err = wrc;
     return nullptr;
   }
   uint64_t slot = h->write_idx % h->n_slots;
@@ -346,21 +366,12 @@ uint8_t* rtchan_read_begin(void* chan, double timeout_s,
                            int64_t* len_or_err) {
   Chan* c = static_cast<Chan*>(chan);
   Header* h = c->h;
-  timespec ts;
-  abs_deadline(&ts, timeout_s);
   if (lock_robust(h) != 0) { *len_or_err = -EINVAL; return nullptr; }
-  while (h->read_idx == h->write_idx) {
-    if (h->closed) {
-      pthread_mutex_unlock(&h->mu);
-      *len_or_err = -EPIPE;
-      return nullptr;
-    }
-    int rc = timedwait_robust(&h->not_empty, h, &ts);
-    if (rc == ETIMEDOUT) {
-      pthread_mutex_unlock(&h->mu);
-      *len_or_err = -ETIMEDOUT;
-      return nullptr;
-    }
+  int wrc = wait_ring(c, /*for_reader=*/1, timeout_s);
+  if (wrc != 0) {
+    pthread_mutex_unlock(&h->mu);
+    *len_or_err = wrc;
+    return nullptr;
   }
   uint64_t slot = h->read_idx % h->n_slots;
   *len_or_err = static_cast<int64_t>(h->lengths[slot]);
@@ -393,6 +404,12 @@ int64_t rtchan_n_slots(void* chan) {
 // (EOWNERDEAD → pthread_mutex_consistent) from a real peer death.
 int rtchan_debug_lock(void* chan) {
   return lock_robust(static_cast<Chan*>(chan)->h);
+}
+
+// Non-blocking peer-liveness probe for the adapter layer (the same
+// check the blocked waits run between condvar slices).
+int rtchan_peer_dead(void* chan) {
+  return peer_is_dead(static_cast<Chan*>(chan));
 }
 
 int rtchan_size(void* chan) {

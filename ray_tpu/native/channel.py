@@ -30,6 +30,13 @@ class ChannelClosed(ConnectionError):
     """The peer closed the channel (and, for readers, it is drained)."""
 
 
+class ChannelPeerDied(ChannelClosed):
+    """The peer PROCESS died without closing the channel (detected by
+    the pid probe between blocked-wait slices).  Distinct from a clean
+    close: recovery layers treat it as an actor/process death, not a
+    drained stream."""
+
+
 def _build_lib() -> str:
     src = os.path.join(os.path.dirname(__file__), "channel.cc")
     with open(src, "rb") as f:
@@ -79,6 +86,8 @@ def _load():
         lib.rtchan_n_slots.restype = ctypes.c_int64
         lib.rtchan_debug_lock.argtypes = [ctypes.c_void_p]
         lib.rtchan_debug_lock.restype = ctypes.c_int
+        lib.rtchan_peer_dead.argtypes = [ctypes.c_void_p]
+        lib.rtchan_peer_dead.restype = ctypes.c_int
         lib.rtchan_write_begin.argtypes = [
             ctypes.c_void_p, ctypes.c_double,
             ctypes.POINTER(ctypes.c_int64)]
@@ -140,6 +149,9 @@ class Channel:
                                   float(timeout))
         if rc == 0:
             return
+        if rc == -errno.ECONNRESET:
+            raise ChannelPeerDied(
+                f"reader process of channel {self.path} died")
         if rc == -errno.EPIPE:
             raise ChannelClosed(f"channel {self.path} closed")
         if rc == -errno.ETIMEDOUT:
@@ -153,6 +165,9 @@ class Channel:
     def get(self, timeout: float = 60.0) -> bytes:
         n = self._lib.rtchan_next_len(self._h, float(timeout))
         if n < 0:
+            if n == -errno.ECONNRESET:
+                raise ChannelPeerDied(
+                    f"writer process of channel {self.path} died")
             if n == -errno.EPIPE:
                 raise ChannelClosed(
                     f"channel {self.path} closed and drained")
@@ -200,6 +215,9 @@ class Channel:
             self._raise_put_err(rc, total)
 
     def _raise_put_err(self, rc: int, length: int):
+        if rc == -errno.ECONNRESET:
+            raise ChannelPeerDied(
+                f"reader process of channel {self.path} died")
         if rc == -errno.EPIPE:
             raise ChannelClosed(f"channel {self.path} closed")
         if rc == -errno.ETIMEDOUT:
@@ -219,6 +237,9 @@ class Channel:
                                            ctypes.byref(n))
         if not base:
             v = int(n.value)
+            if v == -errno.ECONNRESET:
+                raise ChannelPeerDied(
+                    f"writer process of channel {self.path} died")
             if v == -errno.EPIPE:
                 raise ChannelClosed(
                     f"channel {self.path} closed and drained")
@@ -243,6 +264,11 @@ class Channel:
     @property
     def n_slots(self) -> int:
         return int(self._lib.rtchan_n_slots(self._h))
+
+    def peer_dead(self) -> bool:
+        """True when the OTHER endpoint's process attached and has since
+        died (same pid probe the blocked waits run between slices)."""
+        return bool(self._lib.rtchan_peer_dead(self._h))
 
     def _debug_lock(self) -> None:
         """Test hook: take the shared robust mutex and never release it

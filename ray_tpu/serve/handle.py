@@ -23,6 +23,21 @@ import time
 from typing import Any, Dict, List, Optional
 
 _REFRESH_PERIOD_S = 1.0
+# Bounded retries against dead replicas (routing re-resolves over the
+# refreshed membership between attempts, with exponential backoff).
+_DEAD_REPLICA_RETRIES = 3
+_RETRY_BACKOFF_S = 0.05
+
+
+class NoLiveReplicasError(RuntimeError):
+    """Every known replica is dead/evicted.  Retried like a dead
+    replica (the controller's health check replaces replicas and bumps
+    the membership version moments later); surfaces only once the
+    bounded retries are exhausted."""
+
+
+def _retry_backoff(attempt: int) -> None:
+    time.sleep(min(_RETRY_BACKOFF_S * (2 ** attempt), 1.0))
 
 
 class DeploymentResponse:
@@ -44,13 +59,16 @@ class DeploymentResponse:
             try:
                 return ray_tpu.get(self._ref, timeout=timeout)
             except ActorDiedError:
-                # The replica was stopped (autoscale-down / rolling
-                # update) between our membership snapshot and the call:
-                # re-route over the refreshed set (reference: the
-                # router retries failed replicas).
+                # The replica died or was stopped (crash, autoscale-
+                # down, rolling update) between our membership snapshot
+                # and the call: re-resolve routing over the refreshed
+                # set and retry against a live replica, with backoff so
+                # a controller mid-update has time to converge
+                # (reference: the router retries failed replicas).
                 attempts += 1
-                if self._retry is None or attempts > 3:
+                if self._retry is None or attempts > _DEAD_REPLICA_RETRIES:
                     raise
+                _retry_backoff(attempts - 1)
                 self._ref = self._retry()
 
     def _settle(self):
@@ -172,7 +190,7 @@ class _Router:
         with self._lock:
             n = len(self._replicas)
             if n == 0:
-                raise RuntimeError(
+                raise NoLiveReplicasError(
                     f"deployment {self.deployment_name!r} has no live "
                     f"replicas")
             if model_id:
@@ -206,6 +224,20 @@ class _Router:
             if key in self._outstanding:
                 self._outstanding[key] -= 1
 
+    def mark_dead(self, key):
+        """Evict a replica observed dead (ActorDiedError) from the
+        routing set.  Without this, power-of-two keeps choosing it: a
+        dead replica fails instantly, so its outstanding count reads
+        as least-loaded.  The next membership VERSION bump (controller
+        health check replacing the replica) repopulates the set."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if self._key(r) != key]
+            self._outstanding.pop(key, None)
+            self._model_affinity = {m: k for m, k in
+                                    self._model_affinity.items()
+                                    if k != key}
+
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, replicas: List[Any],
@@ -223,15 +255,20 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         if self._stream:
             return self._remote_streaming(args, kwargs)
-        ref, release = self._issue(args, kwargs)
+        ref, release, key = self._issue(args, kwargs)
+        last_key = [key]
 
         def retry():
             # The failed attempt's slot was already released by its
             # completion callback (error seals fire it too) — releasing
             # here again would drive the dead replica's count negative
-            # and bias the router TOWARD it.
+            # and bias the router TOWARD it.  Evict the dead replica
+            # from the routing set, THEN re-resolve membership and
+            # re-route.
+            self._router.mark_dead(last_key[0])
             self._router.force_refresh()
-            new_ref, new_release = self._issue(args, kwargs)
+            new_ref, new_release, new_key = self._issue(args, kwargs)
+            last_key[0] = new_key
             resp._on_done = new_release
             new_ref._on_completed(lambda _o: new_release())
             return new_ref
@@ -247,28 +284,57 @@ class DeploymentHandle:
         handle.py:496): routes to the replica's generator endpoint;
         returns a DeploymentResponseGenerator yielding values as the
         replica yields them (cross-node: streaming-generator item
-        reporting)."""
-        replica, key = self._router.pick(self._model_id)
-        try:
-            gen = replica.handle_request_streaming.options(
+        reporting).  Submission-time dead replicas get the same
+        evict + refresh + backoff treatment as unary calls (mid-stream
+        failures are NOT retried — items already yielded would
+        duplicate)."""
+        gen, key = self._submit_with_failover(
+            lambda replica: replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
-                self._method, args, kwargs, self._model_id)
-        except BaseException:
-            self._router.release(key)
-            raise
+                self._method, args, kwargs, self._model_id))
         return DeploymentResponseGenerator(
             gen, on_done=lambda: self._router.release(key))
 
+    def _submit_with_failover(self, submit):
+        """Route + submit with dead-replica failover: a replica whose
+        actor table already reports it dead is evicted from the router
+        and the request re-routed over refreshed membership (bounded
+        retries with backoff).  Returns (ref_or_gen, routing key); the
+        caller owns releasing the key."""
+        from ray_tpu.exceptions import ActorDiedError
+
+        for attempt in range(_DEAD_REPLICA_RETRIES + 1):
+            try:
+                replica, key = self._router.pick(self._model_id)
+            except NoLiveReplicasError:
+                # Router drained by mark_dead: ride out the window
+                # until the controller's health check repopulates the
+                # membership (same backoff as a dead replica).
+                if attempt >= _DEAD_REPLICA_RETRIES:
+                    raise
+                _retry_backoff(attempt)
+                self._router.force_refresh()
+                continue
+            try:
+                return submit(replica), key
+            except ActorDiedError:
+                self._router.release(key)
+                self._router.mark_dead(key)
+                if attempt >= _DEAD_REPLICA_RETRIES:
+                    raise
+                _retry_backoff(attempt)
+                self._router.force_refresh()
+            except BaseException:
+                # e.g. PendingCallsLimitExceededError: give the slot
+                # back or the router is permanently biased away from
+                # this replica.
+                self._router.release(key)
+                raise
+
     def _issue(self, args, kwargs):
-        replica, key = self._router.pick(self._model_id)
-        try:
-            ref = replica.handle_request.remote(self._method, args,
-                                                kwargs, self._model_id)
-        except BaseException:
-            # e.g. PendingCallsLimitExceededError: give the slot back or
-            # the router is permanently biased away from this replica.
-            self._router.release(key)
-            raise
+        ref, key = self._submit_with_failover(
+            lambda replica: replica.handle_request.remote(
+                self._method, args, kwargs, self._model_id))
         fired = [False]
 
         def release_once():
@@ -278,7 +344,7 @@ class DeploymentHandle:
                 fired[0] = True
                 self._router.release(key)
 
-        return ref, release_once
+        return ref, release_once, key
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,

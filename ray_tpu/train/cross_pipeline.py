@@ -179,6 +179,15 @@ class _StageWorker:
             jnp.sum(jnp.square(g / m))
             for g in self._jax.tree.leaves(self._grad_acc)))
 
+    def reset_accum(self) -> bool:
+        """Recovery: drop partial microbatch state from an aborted
+        step so the retried step starts clean."""
+        self._grad_acc = None
+        self._losses = []
+        self._n_mb = 0
+        self._inputs.clear()
+        return True
+
     def apply_update(self, global_sqnorm: float) -> Dict[str, float]:
         jax, jnp = self._jax, self._jax.numpy
         m = float(max(self._n_mb, 1))
@@ -300,15 +309,91 @@ class CrossSlicePipeline:
 
     def _edge_in(self, boundary: int, ref, forward: bool = True):
         """The consumer-side argument for a stage boundary: a channel
-        marker when the boundary has a ring, else the producer ref."""
+        marker when the boundary has a ring, else the producer ref.
+        The marker carries the producing stage's actor id so the
+        reader's liveness probing can name (and detect) a dead
+        producer."""
         from ray_tpu.experimental import channel as chx
 
         path = (self._fwd_ch if forward else self._bwd_ch)[boundary]
-        return chx.ChannelArg(path) if path is not None else ref
+        if path is None:
+            return ref
+        producer = self.stages[boundary if forward else boundary + 1]
+        return chx.ChannelArg(
+            path, producer=getattr(producer, "_actor_id", None))
 
     def train_step(self, tokens: np.ndarray) -> Dict[str, float]:
         """One GPipe step over ``tokens`` (B, S) int32.  B must divide
-        by num_microbatches."""
+        by num_microbatches.
+
+        Fault tolerance: the microbatch WAVE (forward/backward
+        accumulation) is retried ONCE if it dies to a data-plane or
+        actor fault (severed ring, stage killed mid-pass) — wait out
+        any head-driven stage restart, drop the aborted wave's partial
+        microbatch state on every surviving stage, tear down the stale
+        rings and re-plan them against the stages' current endpoints.
+        The wave is side-effect-free until ``apply_update``, so the
+        retry is exact; the UPDATE phase is deliberately NOT retried
+        (some stages may already have applied — re-running it would
+        double-apply the optimizer step), its failures propagate
+        typed.  A restarted stage re-runs its constructor (same seed →
+        same init); a stage dead for good (no restart budget)
+        re-raises the typed error."""
+        from ray_tpu.exceptions import (ActorError, ChannelError,
+                                        ObjectLostError, TaskError)
+
+        try:
+            self._run_wave(tokens)
+        except (ActorError, ChannelError, ObjectLostError,
+                TaskError) as e:
+            cause = e.cause if isinstance(e, TaskError) else e
+            if not isinstance(cause, (ActorError, ChannelError,
+                                      ObjectLostError)):
+                raise
+            if not self._recover_stages():
+                raise
+            self._run_wave(tokens)
+        return self._apply_updates()
+
+    def _recover_stages(self, timeout_s: float = 60.0) -> bool:
+        """Wait for every stage to be ALIVE again (restarts included),
+        reset their partial step state, and rebuild the boundary rings.
+        False when some stage is dead for good."""
+        import time as _time
+
+        from ray_tpu.experimental.channel import (_producer_state,
+                                                  destroy_channel_at)
+
+        deadline = _time.monotonic() + timeout_s
+        for stage in self.stages:
+            aid = getattr(stage, "_actor_id", None)
+            while True:
+                state = _producer_state(aid)
+                if state in (None, "ALIVE"):
+                    break
+                if state == "DEAD" or _time.monotonic() > deadline:
+                    return False
+                _time.sleep(0.2)
+        # Destroy the stale rings BEFORE touching the stages: aborted
+        # channel-step tasks may still sit in the stage FIFOs blocked
+        # on these rings (a restarted-but-alive producer defeats the
+        # liveness probe), and reset_accum queues behind them — the
+        # destroy fails those reads immediately (ChannelClosed).
+        for path in (self._fwd_ch + self._bwd_ch):
+            if path is not None:
+                destroy_channel_at(path, self._ch_nodes.get(path, ()))
+        try:
+            ray_tpu.get([s.reset_accum.remote() for s in self.stages],
+                        timeout=timeout_s)
+        except Exception:
+            return False
+        self._plan_channels()
+        return True
+
+    def _run_wave(self, tokens: np.ndarray) -> None:
+        """The GPipe microbatch wave: all-forward then all-backward,
+        grads ACCUMULATED on the stages (no parameter mutation — this
+        whole phase is retryable after reset_accum)."""
         M = self.num_microbatches
         B = tokens.shape[0]
         if B % M:
@@ -342,6 +427,9 @@ class CrossSlicePipeline:
                 for i, r in enumerate(g)]
         ray_tpu.get(done)
 
+    def _apply_updates(self) -> Dict[str, float]:
+        """Two-phase clipped update over the accumulated grads.
+        Mutates stage parameters — never retried (see train_step)."""
         sq = sum(ray_tpu.get(
             [s.grad_sqnorm.remote() for s in self.stages]))
         metrics = ray_tpu.get(

@@ -24,6 +24,42 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Hard per-test hang guard for fault-injection tests: the failure mode
+# under test IS the hang (wedged ring readers), so a chaos-marked test
+# that exceeds this budget must die loudly instead of stalling the
+# whole tier-1 run.  SIGALRM fires in the main thread regardless of
+# what worker threads are blocked on.
+CHAOS_HARD_TIMEOUT_S = int(os.environ.get(
+    "RAY_TPU_CHAOS_TEST_TIMEOUT_S", "180"))
+
+
+class ChaosHangGuardTimeout(BaseException):
+    """BaseException on purpose: the framework's retry loops catch
+    (ConnectionError, TimeoutError) — an Exception-typed guard fired
+    inside one of those try blocks would be swallowed as a routine
+    retry, and SIGALRM is one-shot."""
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hang_guard(request):
+    if request.node.get_closest_marker("chaos") is None:
+        yield
+        return
+    import signal
+
+    def _on_alarm(_signum, _frame):
+        raise ChaosHangGuardTimeout(
+            f"chaos test exceeded its {CHAOS_HARD_TIMEOUT_S}s hard "
+            f"timeout (hang guard) — a recovery path is wedged")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(CHAOS_HARD_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
 
 @pytest.fixture
 def ray_start_regular():

@@ -1,0 +1,435 @@
+"""Chaos soak: the programmable fault-injection subsystem
+(experimental/chaos.py) driving the channel data plane's recovery
+paths (reference failure surface: rpc_chaos.h / RAY_testing_rpc_failure
+grown into schedules; recovery semantics: compiled-DAG + pipeline
+passes either complete or raise a TYPED error within their deadline —
+never a wedged reader).
+
+Everything here is marked ``chaos``: conftest arms a hard SIGALRM hang
+guard per test, because the failure mode under test IS the hang.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, ChannelError
+from ray_tpu.experimental import chaos
+
+pytestmark = pytest.mark.chaos
+
+
+def _channels_or_skip():
+    from ray_tpu.experimental.channel import channels_available
+
+    if not channels_available():
+        pytest.skip("native channel lib unavailable")
+
+
+# ---------------------------------------------------------------------------
+# The schedule API itself
+# ---------------------------------------------------------------------------
+
+class TestScheduleApi:
+    def test_rpc_drop_schedule_is_deterministic_and_queryable(self):
+        from ray_tpu.cluster.rpc import RpcClient, RpcServer
+
+        server = RpcServer({"echo": lambda p: p})
+        client = RpcClient(server.address)
+        try:
+            sched = chaos.schedule(seed=3).drop_rpc("echo", count=2)
+            with sched:
+                with pytest.raises(ConnectionError):
+                    client.call("echo", 1)
+                with pytest.raises(ConnectionError):
+                    client.call("echo", 2)
+                assert client.call("echo", 3) == 3
+            # Out of scope: no more injection.
+            assert client.call("echo", 4) == 4
+            assert sched.fired("rpc_drop", "echo") == 2
+            assert [e["method"] for e in sched.events()] == \
+                ["echo", "echo"]
+        finally:
+            client.close()
+            server.shutdown()
+
+    def test_retrying_call_rides_out_injected_drops(self):
+        from ray_tpu.cluster.rpc import ReconnectingClient, RpcServer
+
+        applied = []
+        server = RpcServer({"mutate": lambda p: applied.append(p) or
+                            {"ok": True, "n": len(applied)}})
+        client = ReconnectingClient(server.address)
+        try:
+            with chaos.schedule().drop_rpc("mutate", count=3):
+                resp = client.call_idempotent(
+                    "mutate", {"v": 1}, deadline_s=20.0)
+            assert resp["ok"]
+            assert len(applied) == 1  # retries did not double-apply
+        finally:
+            client.close()
+            server.shutdown()
+
+    def test_env_var_knob_still_honored(self, monkeypatch):
+        """The legacy RAY_TPU_TESTING_RPC_FAILURE parser is wrapped,
+        not broken (subprocess workers inherit faults through env)."""
+        from ray_tpu.cluster.rpc import RpcClient, RpcServer
+
+        monkeypatch.setenv("RAY_TPU_TESTING_RPC_FAILURE", "echo=1")
+        server = RpcServer({"echo": lambda p: p})
+        client = RpcClient(server.address)
+        try:
+            with pytest.raises(ConnectionError):
+                client.call("echo", 1)
+            assert client.call("echo", 2) == 2
+        finally:
+            client.close()
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Idempotent control plane
+# ---------------------------------------------------------------------------
+
+class TestIdempotentHead:
+    def test_duplicate_register_actor_replays_first_reply(self):
+        """A retried register_actor whose first RESPONSE was lost must
+        not double-apply (here: must not trip the name-taken check)."""
+        from ray_tpu.cluster.head import HeadServer
+        from ray_tpu.cluster.rpc import ReconnectingClient
+
+        head = HeadServer("127.0.0.1", 0)
+        client = ReconnectingClient(head.address)
+        try:
+            payload = {"actor_id": b"a" * 16, "node_id": "n1",
+                       "address": "127.0.0.1:1", "name": "singleton",
+                       "_idem": "key-1"}
+            r1 = client.call("register_actor", dict(payload))
+            r2 = client.call("register_actor", dict(payload))
+            assert r1["ok"] and r2["ok"]  # duplicate key: cached reply
+            # A DIFFERENT logical call hits the real handler and the
+            # name conflict fires — proving the dedup is key-scoped.
+            other = {**payload, "actor_id": b"b" * 16, "_idem": "key-2"}
+            assert not client.call("register_actor", other)["ok"]
+        finally:
+            client.close()
+            head.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Compiled-DAG recovery (the acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+class TestCompiledDagChaos:
+    def _three_stage_dag(self, channel_timeout, producer_opts=None):
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class Stage:
+            def step(self, x):
+                return x + 1
+
+        with InputNode() as inp:
+            a = (Stage.options(**producer_opts) if producer_opts
+                 else Stage).bind()
+            b = Stage.bind()
+            c = Stage.bind()
+            dag = c.step.bind(b.step.bind(a.step.bind(inp)))
+        return dag.experimental_compile(channel_timeout=channel_timeout)
+
+    def test_producer_killed_mid_pass_raises_typed_within_deadline(
+            self, ray_start_regular):
+        """Acceptance: producer hard-killed mid-pass (no error frame
+        flushed) → the driver sees a typed ActorDiedError within 2× the
+        configured read deadline, not a wedged reader."""
+        _channels_or_skip()
+        deadline = 2.0
+        compiled = self._three_stage_dag(channel_timeout=deadline)
+        assert compiled._channel_edges  # rings actually planned
+        assert ray_tpu.get(compiled.execute(0)) == 3
+
+        sched = chaos.schedule().kill_at_ring_write("dag0-1", nth=2)
+        with sched:
+            t0 = time.monotonic()
+            with pytest.raises(ActorDiedError):
+                ray_tpu.get(compiled.execute(0),
+                            timeout=4 * deadline)
+            elapsed = time.monotonic() - t0
+        assert sched.fired("ring_kill") == 1
+        assert elapsed < 2 * deadline, \
+            f"typed error took {elapsed:.1f}s (> 2x{deadline}s deadline)"
+        compiled.teardown()
+
+    def test_restart_and_replan_next_pass_succeeds(
+            self, ray_start_regular):
+        """Acceptance: producer with max_restarts=1 killed mid-DAG →
+        the in-flight pass fails typed, and a subsequent pass succeeds
+        on rings rebuilt against the restarted actor."""
+        _channels_or_skip()
+        compiled = self._three_stage_dag(
+            channel_timeout=2.0, producer_opts={"max_restarts": 1})
+        assert compiled._channel_edges
+        assert ray_tpu.get(compiled.execute(0)) == 3
+        old_paths = set(compiled._channel_edges.values())
+
+        with chaos.schedule().kill_at_ring_write(
+                "dag0-1", nth=2, no_restart=False):
+            with pytest.raises((ActorDiedError, ChannelError)):
+                ray_tpu.get(compiled.execute(0), timeout=10.0)
+
+        # Next passes: re-planned rings against the restarted actor.
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                assert ray_tpu.get(compiled.execute(0),
+                                   timeout=10.0) == 3
+                break
+            except (ActorDiedError, ChannelError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        new_paths = set(compiled._channel_edges.values())
+        assert new_paths and new_paths != old_paths, \
+            "expected rebuilt rings after the restart"
+        # Steady state again.
+        assert ray_tpu.get(compiled.execute(5), timeout=10.0) == 8
+        compiled.teardown()
+
+    def test_severed_ring_fails_pass_fast_not_wedged(
+            self, ray_start_regular):
+        """Severing a ring mid-frame fails the pass with a typed error
+        well inside the deadline (close wakes both sides), and the DAG
+        self-heals for the following pass."""
+        _channels_or_skip()
+        deadline = 5.0
+        compiled = self._three_stage_dag(channel_timeout=deadline)
+        assert ray_tpu.get(compiled.execute(0)) == 3
+
+        sched = chaos.schedule().sever_ring("dag1-2", at_frame=2)
+        with sched:
+            t0 = time.monotonic()
+            with pytest.raises((ChannelError, ActorDiedError)):
+                ray_tpu.get(compiled.execute(0), timeout=4 * deadline)
+            assert time.monotonic() - t0 < 2 * deadline
+        assert sched.fired("ring_sever") == 1
+        # Replan restores service.
+        t_end = time.monotonic() + 30.0
+        while True:
+            try:
+                assert ray_tpu.get(compiled.execute(0),
+                                   timeout=10.0) == 3
+                break
+            except (ChannelError, ActorDiedError):
+                if time.monotonic() > t_end:
+                    raise
+                time.sleep(0.2)
+        compiled.teardown()
+
+    def test_soak_seeded_schedule_no_hangs(self, ray_start_regular):
+        """Soak: a seeded kill/sever schedule over repeated passes of a
+        3-actor DAG — every pass either completes or raises a typed
+        error within its deadline (the hang guard would kill us
+        otherwise), and the DAG keeps recovering."""
+        _channels_or_skip()
+        deadline = 2.0
+        compiled = self._three_stage_dag(
+            channel_timeout=deadline, producer_opts={"max_restarts": -1})
+        assert ray_tpu.get(compiled.execute(0)) == 3
+
+        sched = (chaos.schedule(seed=11)
+                 .kill_at_ring_write("dag0-1", nth=3, no_restart=False)
+                 .sever_ring("dag1-2", at_frame=6))
+        completed, typed_errors = 0, 0
+        with sched:
+            for i in range(12):
+                t0 = time.monotonic()
+                try:
+                    assert ray_tpu.get(compiled.execute(i),
+                                       timeout=4 * deadline) == i + 3
+                    completed += 1
+                except (ActorDiedError, ChannelError):
+                    typed_errors += 1
+                    assert time.monotonic() - t0 < 3 * deadline
+                time.sleep(0.05)
+        assert completed >= 6, f"only {completed} passes completed"
+        assert typed_errors >= 1, "schedule never fired"
+        assert sched.events(), "no chaos events recorded"
+        compiled.teardown()
+
+
+# ---------------------------------------------------------------------------
+# Cross-pipeline recovery
+# ---------------------------------------------------------------------------
+
+class TestPipelineChaos:
+    def test_two_stage_pipeline_survives_severed_boundary(
+            self, ray_start_regular):
+        """A 2-stage GPipe step whose boundary ring is severed
+        mid-wave recovers within the step (reset + replan + retry) —
+        training continues with finite losses and rebuilt rings."""
+        _channels_or_skip()
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+        from ray_tpu.train.cross_pipeline import CrossSlicePipeline
+
+        cfg = llama.LlamaConfig.debug(tie_embeddings=False,
+                                      dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        batches = [rng.integers(0, cfg.vocab_size, (4, 16))
+                   .astype(np.int32) for _ in range(3)]
+        pipe = CrossSlicePipeline(cfg, n_stages=2, num_microbatches=2)
+        try:
+            if not any(pipe._fwd_ch):
+                pytest.skip("no same-host boundary rings planned")
+            old_ring = pipe._fwd_ch[0]
+            m0 = pipe.train_step(batches[0])
+            assert np.isfinite(m0["loss"])
+
+            sched = chaos.schedule().sever_ring("pp-fwd0", at_frame=3)
+            with sched:
+                m1 = pipe.train_step(batches[1])
+            assert sched.fired("ring_sever") == 1
+            assert np.isfinite(m1["loss"])
+            assert pipe._fwd_ch[0] != old_ring, \
+                "expected the severed boundary ring to be rebuilt"
+            m2 = pipe.train_step(batches[2])
+            assert np.isfinite(m2["loss"])
+        finally:
+            pipe.shutdown()
+
+    def test_stage_killed_mid_step_raises_typed_not_hang(
+            self, ray_start_regular):
+        """A stage hard-killed mid-wave (no restart budget): the step
+        raises a typed error within its deadline instead of hanging;
+        the error context names the edge."""
+        _channels_or_skip()
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+        from ray_tpu.train.cross_pipeline import CrossSlicePipeline
+
+        cfg = llama.LlamaConfig.debug(tie_embeddings=False,
+                                      dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, (4, 16)) \
+            .astype(np.int32)
+        pipe = CrossSlicePipeline(cfg, n_stages=2, num_microbatches=2)
+        try:
+            if not any(pipe._fwd_ch):
+                pytest.skip("no same-host boundary rings planned")
+            assert np.isfinite(pipe.train_step(tokens)["loss"])
+            with chaos.schedule().kill_at_ring_write("pp-fwd0", nth=3):
+                with pytest.raises((ActorDiedError, ChannelError)):
+                    pipe.train_step(tokens)
+        finally:
+            pipe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Structured error context
+# ---------------------------------------------------------------------------
+
+class TestErrorContext:
+    def test_actor_died_error_carries_and_pickles_context(self):
+        import pickle
+
+        err = ActorDiedError(None, "producer died mid-pass",
+                             node_id="deadbeef" * 4,
+                             context={"ring": "dag0-1", "frame_seq": 7})
+        assert "ring=dag0-1" in str(err)
+        assert "frame_seq=7" in str(err)
+        back = pickle.loads(pickle.dumps(err))
+        assert back.context["frame_seq"] == 7
+        assert back.node_id == err.node_id
+
+    def test_channel_error_frames_carry_edge_context(
+            self, ray_start_regular):
+        """A producer exception crosses the ring as an error frame
+        whose context names the originating edge (ring, actor, frame)
+        — surfaced in the driver-side message."""
+        _channels_or_skip()
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class P:
+            def boom(self, x):
+                raise RuntimeError("producer exploded")
+
+        @ray_tpu.remote
+        class C:
+            def use(self, v):
+                return v
+
+        with InputNode() as inp:
+            dag = C.bind().use.bind(P.bind().boom.bind(inp))
+        compiled = dag.experimental_compile(channel_timeout=10.0)
+        assert compiled._channel_edges
+        with pytest.raises(ChannelError) as ei:
+            ray_tpu.get(compiled.execute(1))
+        msg = str(ei.value)
+        assert "producer exploded" in msg
+        assert "ring=" in msg and "method=boom" in msg
+        assert ei.value.context.get("frame_seq") is not None
+        compiled.teardown()
+
+    def test_peer_process_death_detected_on_read_path(self, tmp_path):
+        """The native pid probe (promoted from test hook to the read
+        path): a writer process dying mid-stream surfaces as
+        ChannelPeerDied in ~one probe slice, not a full timeout."""
+        import subprocess
+        import sys
+
+        from ray_tpu.native.channel import Channel, ChannelPeerDied
+
+        _channels_or_skip()
+        path = str(tmp_path / "ring")
+        Channel.create(path, n_slots=4, slot_bytes=4096)
+        code = ("from ray_tpu.native.channel import Channel; import os;"
+                f"c = Channel({path!r}, writer=True);"
+                "c.put(b'one'); os._exit(9)")
+        subprocess.run([sys.executable, "-c", code], check=False)
+        reader = Channel(path, writer=False)
+        try:
+            assert reader.get(timeout=5.0) == b"one"  # drained first
+            t0 = time.monotonic()
+            with pytest.raises(ChannelPeerDied):
+                reader.get(timeout=30.0)
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            reader.destroy()
+
+
+# ---------------------------------------------------------------------------
+# Serve handle failover
+# ---------------------------------------------------------------------------
+
+class TestServeHandleFailover:
+    def test_handle_retries_onto_live_replica(self, shutdown_only):
+        """ActorDiedError from a stopped replica re-resolves routing
+        and lands on a live one instead of surfacing to the caller."""
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __call__(self, x):
+                return ("ok", id(self), x)
+
+        handle = serve.run(Echo.bind())
+        try:
+            assert handle.remote(1).result(timeout=30)[0] == "ok"
+            # Kill one replica out from under the router.
+            controller = serve._get_controller(create=False)
+            replicas = ray_tpu.get(
+                controller.get_replicas.remote("Echo"), timeout=10)
+            ray_tpu.kill(replicas[0])
+            time.sleep(0.2)
+            # Enough calls that the router MUST hit the dead slot at
+            # least once without failover.
+            for i in range(8):
+                assert handle.remote(i).result(timeout=30)[0] == "ok"
+        finally:
+            serve.shutdown()
