@@ -237,6 +237,108 @@ def _dag_recovery_bench() -> dict:
         ray_tpu.shutdown()
 
 
+def _obs_overhead_bench(n_pairs: int = 220) -> dict:
+    """Observability-plane overhead on ``dag_roundtrip_us``: the same
+    cross-process 2-actor compiled-DAG ping-pong as the roundtrip
+    phase, measured in PAIRED adjacent passes — tracing toggled
+    cluster-wide between passes (driver via ``tracing.disable()``,
+    workers via a pinned remote task flipping their process-local
+    flag).  The median per-pair ratio cancels the box's load drift,
+    which is larger than the overhead itself on shared CI hardware.
+    Guard target: obs_overhead_pct < 5."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+    from ray_tpu.observability import tracing
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2, resources={"d0": 10})
+    c.add_node(num_cpus=2, resources={"d1": 10})
+    c.connect(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Stage:
+            def step(self, x):
+                return x
+
+        @ray_tpu.remote
+        def set_tracing(on: bool):
+            from ray_tpu.observability import tracing as t
+
+            t.enable() if on else t.disable()
+            return on
+
+        def toggle(on: bool):
+            if on:
+                tracing.enable()
+            else:
+                tracing.disable()
+            ray_tpu.get([
+                set_tracing.options(resources={"d0": 1}).remote(on),
+                set_tracing.options(resources={"d1": 1}).remote(on)])
+
+        payload = np.zeros(16384, dtype=np.float32)
+        with InputNode() as inp:
+            a = Stage.options(resources={"d0": 1}).bind()
+            b = Stage.options(resources={"d1": 1}).bind()
+            dag = b.step.bind(a.step.bind(inp))
+        compiled = dag.experimental_compile()
+        for _ in range(15):
+            ray_tpu.get(compiled.execute(payload))
+
+        def one_pass(on: bool) -> float:
+            toggle(on)
+            t0 = time.perf_counter()
+            ray_tpu.get(compiled.execute(payload))
+            return (time.perf_counter() - t0) * 1e6
+
+        # PER-PASS adjacent pairs, order alternating within pairs: the
+        # pass time is bimodal (thread-scheduling regimes lasting
+        # seconds dwarf the plane's cost), so only back-to-back passes
+        # are comparable; the median of per-pair on/off ratios is
+        # robust to pairs straddling a regime shift.  Toggles happen
+        # OUTSIDE the timed region.
+        ratios: list = []
+        on_samples: list = []
+        off_samples: list = []
+        try:
+            for i in range(n_pairs):
+                if i % 2 == 0:
+                    on_b = one_pass(True)
+                    off_b = one_pass(False)
+                else:
+                    off_b = one_pass(False)
+                    on_b = one_pass(True)
+                on_samples.append(on_b)
+                off_samples.append(off_b)
+                ratios.append(on_b / off_b)
+        finally:
+            toggle(True)
+        compiled.teardown()
+        # A pair straddling a scheduling-regime shift shows a 2-10x
+        # ratio in either direction — that is the box, not the plane
+        # (whose true cost is tens of µs on a multi-ms pass).  Trim
+        # those artifacts, then take the median.
+        kept = [r for r in ratios if 0.5 <= r <= 2.0] or ratios
+        kept.sort()
+        med_ratio = kept[len(kept) // 2]
+        on_samples.sort()
+        off_samples.sort()
+        return {
+            "obs_overhead_pct": round((med_ratio - 1.0) * 100.0, 2),
+            "obs_traced_roundtrip_us": round(
+                on_samples[len(on_samples) // 2], 1),
+            "obs_untraced_roundtrip_us": round(
+                off_samples[len(off_samples) // 2], 1),
+        }
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def _broadcast_bench(size_bytes: int, n_nodes: int = 3) -> dict:
     """Push-based broadcast tree (push_manager.h:30 analogue): driver
     fans one object out to ``n_nodes`` workers; aggregate GB/s =
@@ -385,6 +487,12 @@ def main():
         extra.update(_dag_recovery_bench())
     except Exception as e:  # noqa: BLE001
         extra["dag_recovery_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: obs overhead phase start", file=sys.stderr, flush=True)
+    try:
+        extra.update(_obs_overhead_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["obs_overhead_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

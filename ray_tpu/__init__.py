@@ -210,7 +210,10 @@ def available_resources() -> Dict[str, float]:
 
 def timeline(filename: Optional[str] = None):
     """Chrome-trace export of task events (reference: ray.timeline,
-    _private/state.py:948)."""
-    from .observability.timeline import export_timeline
+    _private/state.py:948).  In cluster mode this is the MERGED
+    cluster timeline: every node's shipped events in one trace, one
+    pid lane per process, with flow arrows stitching cross-process
+    ring edges."""
+    from .observability.events import export_cluster_timeline
 
-    return export_timeline(filename)
+    return export_cluster_timeline(filename)

@@ -246,6 +246,13 @@ class ClusterClient:
             target=self._pubsub_loop, daemon=True,
             name=f"cluster-sub-{self.node_id[:8]}")
         self._sub_thread.start()
+        # Task-event shipping: this process's timeline ring + metric
+        # snapshots batch to the head's per-node stores (periodic +
+        # on-detach flush) — the worker half of the merged cluster
+        # timeline / aggregated /metrics (observability/events.py).
+        from ..observability.events import EventShipper
+
+        self.shipper = EventShipper(self)
 
     # ---------------------------------------------------------- heartbeat
     def _heartbeat_loop(self):
@@ -477,6 +484,10 @@ class ClusterClient:
             # ids (primary copies); streaming items report back here.
             "return_ids": list(spec.return_ids),
             "owner": self.address,
+            # Trace context rides the bundle (not just the RPC
+            # envelope): retries re-pushed from completion callbacks
+            # run on threads with no installed tracing scope.
+            "trace": spec.trace_ctx(),
         })
 
         def on_done(result, is_error):
@@ -1378,6 +1389,7 @@ class ClusterClient:
             "num_returns": spec.num_returns,
             "return_ids": list(spec.return_ids),
             "owner": self.address,
+            "trace": spec.trace_ctx(),
         })
 
         def on_done(result, is_error):
@@ -1467,6 +1479,12 @@ class ClusterClient:
     # ------------------------------------------------------------ teardown
     def detach(self):
         self._stopped.set()
+        # On-exit event flush BEFORE draining: a drained node can still
+        # tell the story of its last tasks in the merged timeline.
+        try:
+            self.shipper.stop()
+        except Exception:
+            pass
         try:
             self.head.call("drain_node", {"node_id": self.node_id},
                            timeout=2.0)
@@ -1686,6 +1704,7 @@ class NodeServer:
 
     def _push_task(self, wire):
         from ..core.task_spec import STREAMING, TaskOptions
+        from ..observability import tracing
 
         bundle = loads(wire)
         self.client.ensure_args_local(bundle["args"], bundle["kwargs"])
@@ -1696,9 +1715,10 @@ class NodeServer:
                            num_cpus=0,
                            isolate=bundle.get("isolate", False),
                            resources=dict(bundle.get("resources") or {}))
-        refs = self.runtime.submit_task(
-            bundle["function"], bundle["args"], bundle["kwargs"], opts,
-            local_only=True)
+        with tracing.scope_from(bundle.get("trace")):
+            refs = self.runtime.submit_task(
+                bundle["function"], bundle["args"], bundle["kwargs"],
+                opts, local_only=True)
         if bundle["num_returns"] == STREAMING:
             return self._forward_stream(refs, bundle["return_ids"][0],
                                         bundle["owner"])
@@ -1729,12 +1749,16 @@ class NodeServer:
         calls from one caller enter the actor queue in send order."""
         from ..core.task_spec import STREAMING, TaskOptions
 
+        from ..observability import tracing
+
         b = loads(wire)
         self.client.ensure_args_local(b["args"], b["kwargs"])
         opts = TaskOptions(num_returns=b["num_returns"], max_retries=0)
         try:
-            refs = self.runtime.submit_actor_task(
-                b["actor_id"], b["method"], b["args"], b["kwargs"], opts)
+            with tracing.scope_from(b.get("trace")):
+                refs = self.runtime.submit_actor_task(
+                    b["actor_id"], b["method"], b["args"], b["kwargs"],
+                    opts)
         except BaseException as e:  # noqa: BLE001
             return ("error", e)
         if b["num_returns"] == STREAMING:

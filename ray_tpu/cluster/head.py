@@ -93,6 +93,28 @@ class HeadServer:
         # autoscaler's scale-up signal.
         self._unmet_demands: List[Tuple[float, Dict[str, float]]] = []
         self._storage_path = storage_path
+        # Observability plane: per-node task-event stores + latest
+        # metric snapshots shipped by the workers' EventShippers
+        # (reference: GCS task-event aggregation, gcs_task_manager).
+        # Bounded per node (drop-oldest) — event history is a window,
+        # not a ledger.
+        import collections as _collections
+        import os as _os
+
+        self._events_max = int(_os.environ.get(
+            "RAY_TPU_HEAD_EVENTS_MAX", "100000"))
+        # The node DIMENSION is bounded too: under autoscaler churn,
+        # retired nodes must not pin event windows on the head forever.
+        # Dead nodes' stores are kept (a killed worker's lane is
+        # exactly what a post-mortem merged timeline needs) until the
+        # cap forces out the stalest one.
+        self._event_nodes_max = int(_os.environ.get(
+            "RAY_TPU_HEAD_EVENT_NODES_MAX", "64"))
+        self._node_events: Dict[str, Any] = {}
+        self._node_event_meta: Dict[str, Dict[str, Any]] = {}
+        self._node_metrics: Dict[str, Dict] = {}
+        self._events_lock = threading.Lock()
+        self._deque = _collections.deque
         # After a restart, actors replay before their nodes reattach:
         # give nodes a grace window before declaring them dead.
         self._replay_grace_until = 0.0
@@ -127,6 +149,9 @@ class HeadServer:
             "report_node_failure": self._report_node_failure,
             "pubsub_poll": self._pubsub_poll,
             "pending_demand": self._pending_demand,
+            "push_events": self._push_events,
+            "cluster_timeline": self._cluster_timeline,
+            "cluster_metrics": self._cluster_metrics,
             "ping": lambda p: "pong",
         }, host=host, port=port)
         # Batched long-poll pubsub: node deaths and actor FSM
@@ -288,6 +313,72 @@ class HeadServer:
         return self._publisher.poll(p.get("cursors", {}),
                                     timeout_s=min(60.0, float(
                                         p.get("timeout_s", 30.0))))
+
+    # ------------------------------------------------- observability plane
+    def _push_events(self, p):
+        """Ingest one node's task-event batch + metric snapshot (the
+        worker-side EventShipper's flush target).  Per-node stores are
+        bounded drop-oldest rings, mirroring the worker buffers."""
+        node_id = p["node_id"]
+        events = p.get("events") or []
+        with self._events_lock:
+            store = self._node_events.get(node_id)
+            if store is None:
+                store = self._node_events[node_id] = self._deque(
+                    maxlen=self._events_max)
+                self._prune_event_nodes_locked(keep=node_id)
+            store.extend(events)
+            meta = self._node_event_meta.setdefault(node_id, {})
+            meta["pid"] = p.get("pid")
+            meta["node_dropped"] = int(p.get("dropped") or 0)
+            meta["received"] = meta.get("received", 0) + len(events)
+            meta["ts"] = time.monotonic()
+            if p.get("metrics") is not None:
+                self._node_metrics[node_id] = p["metrics"]
+        return {"ok": True, "stored": len(events)}
+
+    def _prune_event_nodes_locked(self, keep: str) -> None:
+        """Hold the node dimension at its cap: evict the
+        longest-silent node's store — preferring nodes no longer
+        registered alive — so churn can't grow head memory without
+        bound.  Caller holds _events_lock."""
+        while len(self._node_events) > self._event_nodes_max:
+            def staleness(nid: str):
+                alive = (nid in self._nodes
+                         and self._nodes[nid].alive)
+                return (alive,
+                        self._node_event_meta.get(nid, {}).get("ts", 0))
+
+            victim = min((n for n in self._node_events if n != keep),
+                         key=staleness, default=None)
+            if victim is None:
+                return
+            self._node_events.pop(victim, None)
+            self._node_event_meta.pop(victim, None)
+            self._node_metrics.pop(victim, None)
+
+    def _cluster_timeline(self, p):
+        """The merged event store: every node's shipped events in one
+        list (each process keeps its own Chrome-trace pid lane)."""
+        node_id = p.get("node_id") if isinstance(p, dict) else None
+        with self._events_lock:
+            if node_id is not None:
+                events = list(self._node_events.get(node_id, ()))
+                nodes = [node_id] if node_id in self._node_events else []
+            else:
+                events = [e for store in self._node_events.values()
+                          for e in store]
+                nodes = list(self._node_events)
+            meta = {nid: dict(m)
+                    for nid, m in self._node_event_meta.items()}
+        return {"events": events, "nodes": nodes, "meta": meta}
+
+    def _cluster_metrics(self, _p):
+        """Latest per-node metric snapshots ({node_id: export_state}),
+        for the aggregated /metrics exposition."""
+        with self._events_lock:
+            return {nid: state
+                    for nid, state in self._node_metrics.items()}
 
     def _publish_node_death(self, node_id: str, address: str = ""):
         self._publisher.publish("node_death",

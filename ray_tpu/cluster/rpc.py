@@ -36,12 +36,29 @@ import uuid
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..experimental import chaos as _chaos
+from ..observability import tracing as _tracing
 
 _LEN = struct.Struct(">Q")
 
 # The idempotency-key field injected into dict payloads by
 # call_idempotent and consumed by idempotent_handler on the server.
 IDEMPOTENCY_KEY = "_idem"
+
+
+def _rpc_metrics():
+    """Retry / idempotency counters (rebuilt after registry resets)."""
+    from ..observability import metrics as _metrics
+
+    return _metrics.metric_group("rpc", lambda: {
+        "retries": _metrics.Counter(
+            "ray_tpu_rpc_retries_total",
+            "rpc transport retries under retry_call deadlines",
+            tag_keys=("method",)),
+        "idem_hits": _metrics.Counter(
+            "ray_tpu_idempotency_hits_total",
+            "duplicate mutating calls answered from the "
+            "idempotency cache", tag_keys=("method",)),
+    })
 
 
 def retry_call(call_fn: Callable[..., Any], method: str, payload: Any,
@@ -68,6 +85,7 @@ def retry_call(call_fn: Callable[..., Any], method: str, payload: Any,
                 raise type(e)(
                     f"rpc {method!r} still failing at its "
                     f"{deadline_s:.0f}s retry deadline: {e}") from e
+            _rpc_metrics()["retries"].inc(tags={"method": method})
             time.sleep(backoff)
             backoff = min(backoff * 2, max_backoff_s)
 
@@ -89,6 +107,8 @@ def idempotent_handler(fn: Callable[[Any], Any],
         while True:
             hit, reply = cache.get(key)
             if hit:
+                _rpc_metrics()["idem_hits"].inc(
+                    tags={"method": getattr(fn, "__name__", "")})
                 return reply
             ev, mine = cache.claim(key)
             if not mine:
@@ -160,17 +180,24 @@ class DeserializationError(RuntimeError):
 
 
 def _send_msg(sock: socket.socket, kind: str, req_id: str, method: str,
-              payload: Any, lock: threading.Lock):
+              payload: Any, lock: threading.Lock,
+              trace: Optional[Tuple] = None):
     """Bytes-like payloads are framed RAW (kind gets a "+raw" suffix) —
     no pickle copy on either side; the data plane's chunk transfers and
-    pre-serialized task bundles ride this path at memcpy speed."""
+    pre-serialized task bundles ride this path at memcpy speed.
+
+    ``trace`` is the submitter's (trace_id, parent_span_id): it rides
+    the ENVELOPE (not the payload) so every RPC — including raw-framed
+    ones — propagates trace context without touching its body."""
     if isinstance(payload, (bytes, bytearray, memoryview)):
-        env = pickle.dumps((kind + "+raw", req_id, method),
-                           protocol=pickle.HIGHEST_PROTOCOL)
+        head = ((kind + "+raw", req_id, method) if trace is None
+                else (kind + "+raw", req_id, method, trace))
+        env = pickle.dumps(head, protocol=pickle.HIGHEST_PROTOCOL)
         body = payload
     else:
-        env = pickle.dumps((kind, req_id, method),
-                           protocol=pickle.HIGHEST_PROTOCOL)
+        head = ((kind, req_id, method) if trace is None
+                else (kind, req_id, method, trace))
+        env = pickle.dumps(head, protocol=pickle.HIGHEST_PROTOCOL)
         body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
         # Scatter-gather write: no concatenation copy of the body.
@@ -207,17 +234,20 @@ def _recv_segment(sock: socket.socket) -> bytearray:
     return _recv_exact(sock, length)
 
 
-def _recv_msg(sock: socket.socket) -> Tuple[str, str, str, bytes, bool]:
-    """Returns (kind, req_id, method, raw_payload, is_raw).  A pickled
-    payload is NOT deserialized here: the caller decodes it after
-    correlation so a bad payload fails one call, not the connection.
-    Raw payloads skip pickle entirely."""
+def _recv_msg(sock: socket.socket
+              ) -> Tuple[str, str, str, bytes, bool, Optional[Tuple]]:
+    """Returns (kind, req_id, method, raw_payload, is_raw, trace).  A
+    pickled payload is NOT deserialized here: the caller decodes it
+    after correlation so a bad payload fails one call, not the
+    connection.  Raw payloads skip pickle entirely.  ``trace`` is the
+    optional 4th envelope field (trace_id, parent_span_id)."""
     env = pickle.loads(_recv_segment(sock))
     body = _recv_segment(sock)
-    kind, req_id, method = env
+    kind, req_id, method = env[0], env[1], env[2]
+    trace = env[3] if len(env) > 3 else None
     if kind.endswith("+raw"):
-        return kind[:-4], req_id, method, body, True
-    return kind, req_id, method, body, False
+        return kind[:-4], req_id, method, body, True, trace
+    return kind, req_id, method, body, False, trace
 
 
 def _tune_socket(sock: socket.socket) -> None:
@@ -288,7 +318,8 @@ class RpcServer:
         wlock = threading.Lock()
         try:
             while not self._stopped.is_set():
-                kind, req_id, method, raw, is_raw = _recv_msg(conn)
+                kind, req_id, method, raw, is_raw, trace = \
+                    _recv_msg(conn)
                 try:
                     payload = raw if is_raw else pickle.loads(raw)
                 except BaseException as e:  # noqa: BLE001
@@ -302,11 +333,12 @@ class RpcServer:
                     # Inline submission phase; Deferred completion runs
                     # on its own thread.
                     self._handle_one(conn, wlock, req_id, method, payload,
-                                     inline=True)
+                                     inline=True, trace=trace)
                 else:
                     threading.Thread(
                         target=self._handle_one,
                         args=(conn, wlock, req_id, method, payload),
+                        kwargs={"trace": trace},
                         daemon=True).start()
         except (ConnectionError, EOFError, OSError):
             pass
@@ -336,12 +368,17 @@ class RpcServer:
             pass
 
     def _handle_one(self, conn, wlock, req_id, method, payload,
-                    inline: bool = False):
+                    inline: bool = False, trace=None):
         try:
             fn = self.handlers.get(method)
             if fn is None:
                 raise AttributeError(f"no rpc method {method!r}")
-            result = fn(payload)
+            # Re-install the caller's trace context around the handler
+            # so anything it submits (task specs, nested RPCs) inherits
+            # the trace — and restore after: handler threads (and the
+            # inline reader thread) are reused across requests.
+            with _tracing.scope_from(trace):
+                result = fn(payload)
             if isinstance(result, Deferred):
                 threading.Thread(
                     target=self._finish_deferred,
@@ -420,7 +457,8 @@ class RpcClient:
     def _read_loop(self, sock: socket.socket):
         try:
             while True:
-                kind, req_id, method, raw, is_raw = _recv_msg(sock)
+                kind, req_id, method, raw, is_raw, _trace = \
+                    _recv_msg(sock)
                 with self._lock:
                     call = self._pending.pop(req_id, None)
                 if call is None:
@@ -475,13 +513,15 @@ class RpcClient:
         self._chaos.maybe_fail(method)
         req_id = uuid.uuid4().hex
         call = _PendingCall(method, callback)
+        trace = _tracing.current()
         with self._lock:
             sock = self._sock
             if sock is None or self._closed:
                 raise ConnectionError(f"not connected to {self.address}")
             self._pending[req_id] = call
         try:
-            _send_msg(sock, "req", req_id, method, payload, self._wlock)
+            _send_msg(sock, "req", req_id, method, payload, self._wlock,
+                      trace=trace)
         except (ConnectionError, OSError) as e:
             with self._lock:
                 self._pending.pop(req_id, None)

@@ -391,6 +391,21 @@ class ActorManager:
             # matches reference restart semantics).
             info.num_restarts += 1
             info.state = ActorState.RESTARTING
+            # The restart is a load-bearing moment in any recovery
+            # story — mark it in the (shipped) timeline so the merged
+            # trace shows WHERE the gap in an actor's lane came from.
+            try:
+                from ..observability.timeline import (process_pid,
+                                                      record_event)
+
+                record_event(
+                    "actor_restart", "i", pid=process_pid(),
+                    tid=threading.current_thread().name,
+                    args={"actor_id": actor_id.hex()[:16],
+                          "name": info.display_name(),
+                          "restarts_used": info.num_restarts})
+            except Exception:
+                pass
             core.stop()
             new_core = _ActorCore(self._runtime, info)
             with self._lock:

@@ -39,6 +39,7 @@ from .task_spec import (STREAMING, FunctionDescriptor, TaskOptions,
                         TaskSpec, normalize_strategy)
 from ..exceptions import (ActorError, ChannelError, ObjectLostError,
                           TaskCancelledError, TaskError)
+from ..observability import tracing as _tracing
 
 # System fault-tolerance errors surface TYPED at the driver (reference:
 # RayActorError/ObjectLostError are not buried inside RayTaskError) —
@@ -371,6 +372,10 @@ class Runtime:
         else:
             return_ids = tuple(
                 ObjectID.for_return(task_id, i) for i in range(int(n)))
+        # Trace propagation: inherit the active trace (a parent task or
+        # a driver-side scope) or mint a root trace — each bare driver
+        # submission is its own root operation.
+        trace_id, parent_span = _tracing.for_submission()
         return TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -388,6 +393,8 @@ class Runtime:
             isolate=options.isolate,
             parent_task_id=parent,
             return_ids=return_ids,
+            trace_id=trace_id,
+            parent_span_id=parent_span,
         )
 
     def submit_task(self, function, args, kwargs, options: TaskOptions,
@@ -594,11 +601,16 @@ class Runtime:
             self.task_manager.complete_error(spec, dep_error,
                                              allow_retry=False)
             return
+        span_id = _tracing.new_span_id()
         ctx = TaskContext(spec.task_id, spec.repr_name(),
                           actor_id=spec.actor_id,
                           attempt_number=spec.attempt_number,
-                          parent_task_id=spec.parent_task_id)
+                          parent_task_id=spec.parent_task_id,
+                          trace_id=spec.trace_id, span_id=span_id)
         rc_mod.set_task_context(ctx)
+        # This task's span becomes the parent of everything it submits.
+        prev_trace = _tracing.set_current(
+            (spec.trace_id, span_id) if spec.trace_id else None)
         t_start = time.time()
         outcome = "ok"
         try:
@@ -632,7 +644,9 @@ class Runtime:
             self.task_manager.complete_error(spec, err)
         finally:
             rc_mod.set_task_context(None)
-            self._record_task_event(spec, t_start, outcome)
+            _tracing.set_current(prev_trace)
+            self._record_task_event(spec, t_start, outcome,
+                                    span_id=span_id)
 
     async def execute_task_inline_async(self, spec: TaskSpec,
                                         bound_instance=None,
@@ -648,10 +662,14 @@ class Runtime:
             self.task_manager.complete_error(spec, dep_error,
                                              allow_retry=False)
             return
+        span_id = _tracing.new_span_id()
         ctx = TaskContext(spec.task_id, spec.repr_name(),
                           actor_id=spec.actor_id,
-                          attempt_number=spec.attempt_number)
+                          attempt_number=spec.attempt_number,
+                          trace_id=spec.trace_id, span_id=span_id)
         rc_mod.set_task_context(ctx)
+        prev_trace = _tracing.set_current(
+            (spec.trace_id, span_id) if spec.trace_id else None)
         t_start = time.time()
         outcome = "ok"
         try:
@@ -680,10 +698,12 @@ class Runtime:
             self.task_manager.complete_error(spec, err)
         finally:
             rc_mod.set_task_context(None)
-            self._record_task_event(spec, t_start, outcome)
+            _tracing.set_current(prev_trace)
+            self._record_task_event(spec, t_start, outcome,
+                                    span_id=span_id)
 
     def _record_task_event(self, spec: TaskSpec, t_start: float,
-                           outcome: str):
+                           outcome: str, span_id: Optional[str] = None):
         """Timeline span + counters for one executed task (reference:
         TaskEventBuffer, task_event_buffer.h:220 → ray.timeline)."""
         from ..observability import metrics as _metrics
@@ -692,13 +712,19 @@ class Runtime:
         t_end = time.time()
         kind = ("actor_creation" if spec.is_actor_creation
                 else "actor_task" if spec.is_actor_task else "task")
+        args = {"task_id": spec.task_id.hex(), "kind": kind,
+                "outcome": outcome,
+                "attempt": spec.attempt_number}
+        if spec.trace_id is not None:
+            args["trace_id"] = spec.trace_id
+            args["span_id"] = span_id or _tracing.new_span_id()
+            if spec.parent_span_id:
+                args["parent_span_id"] = spec.parent_span_id
         record_span(
             spec.repr_name(), t_start, t_end,
             pid=f"node:{self.node_id.hex()[:8]}",
             tid=threading.current_thread().name,
-            args={"task_id": spec.task_id.hex(), "kind": kind,
-                  "outcome": outcome,
-                  "attempt": spec.attempt_number})
+            args=args)
         counters = _metrics.runtime_counters()
         tags = {"kind": kind}
         if outcome == "ok":
@@ -851,6 +877,7 @@ class Runtime:
             })
 
         creation_task_id = TaskID.for_task(actor_id)
+        trace_id, parent_span = _tracing.for_submission()
         creation_spec = TaskSpec(
             task_id=creation_task_id, job_id=self.job_id, function=None,
             descriptor=FunctionDescriptor.from_class(klass),
@@ -858,6 +885,7 @@ class Runtime:
             max_retries=0, retry_exceptions=False,
             actor_id=actor_id, is_actor_creation=True,
             return_ids=(ObjectID.for_return(creation_task_id, 0),),
+            trace_id=trace_id, parent_span_id=parent_span,
         )
         self.task_manager.register_pending(creation_spec)
         core.creation_spec = creation_spec
@@ -911,6 +939,7 @@ class Runtime:
 
     def submit_actor_creation_for_restart(self, core):
         creation_task_id = TaskID.for_task(core.info.actor_id)
+        trace_id, parent_span = _tracing.for_submission()
         spec = TaskSpec(
             task_id=creation_task_id, job_id=self.job_id, function=None,
             descriptor=FunctionDescriptor.from_class(core.info.klass),
@@ -918,6 +947,7 @@ class Runtime:
             max_retries=0, retry_exceptions=False,
             actor_id=core.info.actor_id, is_actor_creation=True,
             return_ids=(ObjectID.for_return(creation_task_id, 0),),
+            trace_id=trace_id, parent_span_id=parent_span,
         )
         self.task_manager.register_pending(spec)
         core.submit(spec)
@@ -940,6 +970,7 @@ class Runtime:
         else:
             return_ids = tuple(
                 ObjectID.for_return(task_id, i) for i in range(int(n)))
+        trace_id, parent_span = _tracing.for_submission()
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, function=None,
             descriptor=FunctionDescriptor(
@@ -949,7 +980,8 @@ class Runtime:
             resources={}, max_retries=options.max_retries,
             retry_exceptions=options.retry_exceptions,
             name=options.name, actor_id=actor_id, is_actor_task=True,
-            parent_task_id=self.current_task_id(), return_ids=return_ids)
+            parent_task_id=self.current_task_id(), return_ids=return_ids,
+            trace_id=trace_id, parent_span_id=parent_span)
         self.task_manager.register_pending(spec)
         arg_ids = [a.object_id() for a in spec.args
                    if isinstance(a, ObjectRef)]
@@ -1002,6 +1034,7 @@ class Runtime:
             task_id = TaskID.for_task(actor_id)
             return_ids = tuple(
                 ObjectID.for_return(task_id, i) for i in range(int(n)))
+        trace_id, parent_span = _tracing.for_submission()
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, function=None,
             descriptor=FunctionDescriptor(
@@ -1014,7 +1047,8 @@ class Runtime:
             max_retries=self.cluster.actor_task_retries(actor_id),
             retry_exceptions=options.retry_exceptions,
             name=options.name, actor_id=actor_id, is_actor_task=True,
-            parent_task_id=self.current_task_id(), return_ids=return_ids)
+            parent_task_id=self.current_task_id(), return_ids=return_ids,
+            trace_id=trace_id, parent_span_id=parent_span)
         self.task_manager.register_pending(spec)
         arg_ids = [a.object_id() for a in spec.args
                    if isinstance(a, ObjectRef)]

@@ -16,16 +16,22 @@ _local = threading.local()
 
 class TaskContext:
     __slots__ = ("task_id", "task_name", "actor_id", "attempt_number",
-                 "parent_task_id")
+                 "parent_task_id", "trace_id", "span_id")
 
     def __init__(self, task_id: TaskID, task_name: str = "",
                  actor_id: Optional[ActorID] = None, attempt_number: int = 0,
-                 parent_task_id: Optional[TaskID] = None):
+                 parent_task_id: Optional[TaskID] = None,
+                 trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None):
         self.task_id = task_id
         self.task_name = task_name
         self.actor_id = actor_id
         self.attempt_number = attempt_number
         self.parent_task_id = parent_task_id
+        # Distributed tracing (observability/tracing.py): the trace
+        # this execution belongs to and the span it records.
+        self.trace_id = trace_id
+        self.span_id = span_id
 
 
 def set_task_context(ctx: Optional[TaskContext]):
@@ -72,6 +78,17 @@ class RuntimeContext:
     def get_attempt_number(self) -> int:
         ctx = current_task_context()
         return ctx.attempt_number if ctx else 0
+
+    def get_trace_id(self) -> Optional[str]:
+        """The distributed trace id of the current task (or the active
+        driver-side tracing scope), for log correlation."""
+        ctx = current_task_context()
+        if ctx is not None and ctx.trace_id is not None:
+            return ctx.trace_id
+        from ..observability import tracing
+
+        cur = tracing.current()
+        return cur[0] if cur else None
 
     def current_actor(self):
         aid = self.get_actor_id()

@@ -146,6 +146,11 @@ class TaskSpec:
     parent_task_id: Optional[TaskID] = None
     attempt_number: int = 0
     return_ids: Tuple[ObjectID, ...] = ()
+    # Trace propagation (observability/tracing.py): the submitter's
+    # trace id + span, carried with the spec across process hops so the
+    # span this execution records attaches to the right trace.
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
     # Cluster: nodes that already failed this task (spillback exclusion,
     # reference: normal_task_submitter.cc:455 retry_at_raylet_address).
     _excluded_nodes: Tuple[str, ...] = ()
@@ -153,6 +158,12 @@ class TaskSpec:
     def exclude_node(self, node_id: str):
         if node_id not in self._excluded_nodes:
             self._excluded_nodes = self._excluded_nodes + (node_id,)
+
+    def trace_ctx(self) -> Optional[Tuple[str, Optional[str]]]:
+        """(trace_id, parent_span_id) for wire propagation, or None."""
+        if self.trace_id is None:
+            return None
+        return (self.trace_id, self.parent_span_id)
 
     def excluded_nodes(self) -> Tuple[str, ...]:
         return self._excluded_nodes

@@ -41,8 +41,30 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .dag_node import (ClassMethodNode, ClassNode, DAGNode, FunctionNode,
                        InputNode, MultiOutputNode)
+from ..observability import tracing as _tracing
 
 _NULL_CTX = contextlib.nullcontext()
+
+def _dag_metrics():
+    """Compiled-DAG pass/recovery series (rebuilt after registry
+    resets)."""
+    from ..observability import metrics as _metrics
+
+    return _metrics.metric_group("dag", lambda: {
+        "passes": _metrics.Counter(
+            "ray_tpu_dag_passes_total", "compiled-DAG passes submitted"),
+        "pass_failures": _metrics.Counter(
+            "ray_tpu_dag_pass_failures_total",
+            "passes completed with a fault-tolerance error "
+            "(ring fault, dead actor, lost object)"),
+        "replans": _metrics.Counter(
+            "ray_tpu_dag_replans_total",
+            "ring-plan rebuilds after restarts/data-plane faults"),
+        "pass_seconds": _metrics.Histogram(
+            "ray_tpu_dag_pass_seconds",
+            "submit→last-output-complete latency per pass",
+            boundaries=[0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0]),
+    })
 
 
 class _Step:
@@ -376,6 +398,7 @@ class CompiledDAG:
             return
         if not (self._rings_dirty or self._restarts_changed()):
             return
+        _dag_metrics()["replans"].inc()
         from ..experimental.channel import (destroy_channel,
                                             destroy_channel_at)
 
@@ -425,16 +448,23 @@ class CompiledDAG:
     def execute(self, *input_values) -> Any:
         """Run one pass over the static plan; returns the terminal
         ref(s) immediately.  Up to ``max_in_flight`` passes overlap."""
+        import time as _time
+
         input_value = input_values[0] if input_values else None
         self._in_flight.acquire()
         released = [False]
         rel_lock = threading.Lock()
+        t_pass0 = _time.perf_counter()
+        _dag_metrics()["passes"].inc()
 
         def release_all(refs):
             with rel_lock:
                 if released[0]:
                     return
                 released[0] = True
+            if refs:
+                _dag_metrics()["pass_seconds"].observe(
+                    _time.perf_counter() - t_pass0)
             for r in refs:
                 self._holding.discard(r)
             self._in_flight.release()
@@ -458,7 +488,11 @@ class CompiledDAG:
             # The lock also covers re-planning (a channel-recovery DAG
             # keeps taking it even while its edges ride the object
             # plane, so an ALIVE event can swing them back to rings).
-            with self._submit_order_lock if (
+            # One trace per pass: the driver-side span is the root, and
+            # every step submitted under it (local or cross-process)
+            # attaches to the same trace id.
+            with _tracing.span("dag.execute"), \
+                    self._submit_order_lock if (
                     self._channel_edges or self._chan_recovery) \
                     else _NULL_CTX:
                 self._maybe_replan()
@@ -488,13 +522,14 @@ class CompiledDAG:
                 # A pass dying to a data-plane fault marks the ring
                 # plan dirty: the next execute tears down and rebuilds
                 # (restart-aware recovery).
-                if self._chan_recovery:
-                    from ..exceptions import (ActorError, ChannelError,
-                                              ObjectLostError)
+                from ..exceptions import (ActorError, ChannelError,
+                                          ObjectLostError)
 
-                    err = getattr(_obj, "error", None)
-                    if isinstance(err, (ActorError, ChannelError,
-                                        ObjectLostError)):
+                err = getattr(_obj, "error", None)
+                if isinstance(err, (ActorError, ChannelError,
+                                    ObjectLostError)):
+                    _dag_metrics()["pass_failures"].inc()
+                    if self._chan_recovery:
                         self._rings_dirty = True
                 with rel_lock:
                     pending[0] -= 1

@@ -169,14 +169,19 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, _PAGE.encode(),
                                   "text/html; charset=utf-8")
             if self.path == "/metrics":
-                from ..observability.metrics import prometheus_text
+                # Cluster mode: the aggregated exposition — every
+                # node's shipped series, tagged node_id.  Local mode
+                # degrades to this process's registry.
+                from ..observability.events import cluster_metrics_text
 
-                return self._send(200, prometheus_text().encode(),
+                return self._send(200, cluster_metrics_text().encode(),
                                   "text/plain; version=0.0.4")
             if self.path == "/api/timeline":
-                from ..observability.timeline import export_timeline
+                # ONE Chrome trace for the whole cluster (per-node pid
+                # lanes, cross-process flow arrows).
+                from ..observability.events import export_cluster_timeline
 
-                body = json.dumps(export_timeline(None)).encode()
+                body = json.dumps(export_cluster_timeline(None)).encode()
                 return self._send(200, body, "application/json")
             if self.path.startswith("/api/"):
                 data = _collect(self.path[len("/api/"):])

@@ -54,6 +54,7 @@ from ray_tpu.native.channel import (Channel, ChannelClosed,
 
 from ..exceptions import (ActorDiedError, ActorError, ChannelError,
                           ObjectLostError, _picklable_cause)
+from ..observability import tracing as _tracing
 from . import chaos as _chaos
 
 __all__ = [
@@ -84,6 +85,44 @@ _TAG_ERROR = 0x45   # "E": pickled {"err": exc, "ctx": {...}} dict
 
 _available: Optional[bool] = None
 _avail_lock = threading.Lock()
+
+def _chan_metrics():
+    """Ring data-plane series (rebuilt after registry resets):
+    write/read wait histograms, frames/bytes counters, and the
+    oversize object-plane-fallback counter."""
+    from ..observability import metrics as _metrics
+
+    wait_bounds = [1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0]
+    return _metrics.metric_group("channel", lambda: {
+        "write_wait": _metrics.Histogram(
+            "ray_tpu_channel_write_wait_seconds",
+            "blocked time per ring frame write",
+            boundaries=wait_bounds, tag_keys=("ring",)),
+        "read_wait": _metrics.Histogram(
+            "ray_tpu_channel_read_wait_seconds",
+            "blocked time per ring frame read",
+            boundaries=wait_bounds, tag_keys=("ring",)),
+        "frames": _metrics.Counter(
+            "ray_tpu_channel_frames_total",
+            "ring frames moved", tag_keys=("ring", "dir")),
+        "bytes": _metrics.Counter(
+            "ray_tpu_channel_bytes_total",
+            "ring payload bytes moved", tag_keys=("ring", "dir")),
+        "fallback": _metrics.Counter(
+            "ray_tpu_channel_fallback_total",
+            "oversize payloads shipped as object-plane ref frames",
+            tag_keys=("ring",)),
+    })
+
+
+def _flow_id(ring: str, seq: int) -> int:
+    """Deterministic flow-event id for one ring frame: both endpoints
+    compute it independently (SPSC FIFO keeps their seq counters in
+    lockstep), so the merged timeline draws producer→consumer arrows
+    without any metadata crossing the ring."""
+    import zlib
+
+    return (zlib.crc32(ring.encode()) << 20) | (seq & 0xFFFFF)
 
 
 def channels_available() -> bool:
@@ -233,9 +272,34 @@ class ChannelWriter:
                  hdr, *bufs]
         total = 5 + len(hdr) + sum(len(b) for b in bufs)
         chan = self._ensure(total)
+        ring = os.path.basename(self.path)
         if total > chan.slot_bytes:
             parts = [self._ref_frame(value)]
+            total = len(parts[0])
+            _chan_metrics()["fallback"].inc(tags={"ring": ring})
+        t_wall = time.time()
+        t0 = time.perf_counter()
         chan.put_parts(parts, timeout=self.timeout)
+        m = _chan_metrics()
+        m["write_wait"].observe(time.perf_counter() - t0,
+                                tags={"ring": ring})
+        tags = {"ring": ring, "dir": "write"}
+        m["frames"].inc(tags=tags)
+        m["bytes"].inc(total, tags=tags)
+        if _tracing.enabled():
+            # Flow start: the consumer's read of this frame emits the
+            # matching finish.  Stamped with the wall time from BEFORE
+            # the frame was published — the consumer can read and
+            # record its finish before this thread gets scheduled
+            # again, and a start timestamped after its finish loses
+            # the producer→consumer arrow in the renderer.
+            from ..observability.timeline import (process_pid,
+                                                  record_flow)
+
+            record_flow(f"ring:{ring}", _flow_id(ring, self._seq), "s",
+                        pid=process_pid(),
+                        tid=threading.current_thread().name,
+                        ts=t_wall, args={"seq": self._seq})
 
     def _ref_frame(self, value: Any) -> bytes:
         from ..core.runtime import get_runtime
@@ -257,6 +321,9 @@ class ChannelWriter:
         driver names the originating edge."""
         frame_ctx = {"ring": os.path.basename(self.path),
                      "frame_seq": self._seq, **(ctx or {})}
+        cur = _tracing.current()
+        if cur is not None and "trace_id" not in frame_ctx:
+            frame_ctx["trace_id"] = cur[0]
         try:
             payload = pickle.dumps({"err": _picklable_cause(err),
                                     "ctx": frame_ctx},
@@ -296,6 +363,9 @@ class ChannelReader:
         self.timeout = timeout
         self._chan: Optional[Channel] = None
         self._lock = threading.Lock()
+        # Value/ref frames consumed so far — mirrors the writer's _seq
+        # (SPSC FIFO), keying the consumer half of flow events.
+        self._seq = 0
         # Lets close() break a reader still waiting for the ring FILE
         # to appear (the native close flag can only wake waits on an
         # existing ring).
@@ -380,12 +450,30 @@ class ChannelReader:
     def get_value(self, producer=None) -> Any:
         from ..cluster.serialization import deserialize, sealed_from_flat
 
+        t0 = time.perf_counter()
         data = self._read_frame(producer)
+        ring = os.path.basename(self.path)
+        m = _chan_metrics()
+        m["read_wait"].observe(time.perf_counter() - t0,
+                               tags={"ring": ring})
         if not data:
             raise ChannelError(
                 "empty frame",
                 context={"ring": os.path.basename(self.path)})
         tag = data[0]
+        if tag in (_TAG_VALUE, _TAG_REF):
+            self._seq += 1
+            tags = {"ring": ring, "dir": "read"}
+            m["frames"].inc(tags=tags)
+            m["bytes"].inc(len(data), tags=tags)
+            if _tracing.enabled():
+                from ..observability.timeline import (process_pid,
+                                                      record_flow)
+
+                record_flow(f"ring:{ring}", _flow_id(ring, self._seq),
+                            "f", pid=process_pid(),
+                            tid=threading.current_thread().name,
+                            args={"seq": self._seq})
         if tag == _TAG_VALUE:
             mv = memoryview(data)
             hl = int.from_bytes(mv[1:5], "big")

@@ -171,6 +171,20 @@ class ChaosSchedule:
         self._events.append({
             "kind": rule.kind, "target": rule.target,
             "t": time.monotonic(), **detail})
+        # Every injected fault is also a TAGGED timeline event, so the
+        # merged cluster trace shows exactly where chaos struck and
+        # tests can assert recovery THROUGH the observability plane.
+        try:
+            from ..observability.timeline import (process_pid,
+                                                  record_event)
+
+            record_event(f"chaos:{rule.kind}", "i",
+                         pid=process_pid(),
+                         tid=threading.current_thread().name,
+                         args={"chaos": True, "kind": rule.kind,
+                               "target": rule.target, **detail})
+        except Exception:
+            pass
 
     def _match(self, kinds: Tuple[str, ...], key: str,
                substring: bool = False):

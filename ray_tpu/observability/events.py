@@ -1,0 +1,151 @@
+"""Task-event shipping: the distributed half of the timeline/metrics
+plane (reference: the per-worker TaskEventBuffer flushing batched task
+events to the GCS, task_event_buffer.h:220 + gcs_task_manager).
+
+Every cluster participant (driver node included) runs one
+:class:`EventShipper`: a daemon thread that periodically drains the
+process-local timeline ring buffer (``timeline.drain_since`` — each
+event crosses the wire once) plus a metrics snapshot
+(``metrics.export_state``) and pushes the batch to the head's
+``push_events`` RPC.  Shipping is bounded end to end: the timeline
+buffer is drop-oldest with a dropped counter, batches are chunked, and
+a head that is unreachable simply costs that interval's batch nothing
+worse than staying local.
+
+The head aggregates per-node stores; :func:`export_cluster_timeline`
+and the dashboard's aggregated ``/metrics`` read them back to render
+ONE merged view — per-node ``pid`` lanes in a single Chrome trace, and
+one exposition page where every series carries a ``node_id`` label.
+
+Env knobs:
+  RAY_TPU_EVENT_FLUSH_S       flush period (default 1.0)
+  RAY_TPU_EVENT_BATCH_MAX     max events per push_events RPC (2000)
+  RAY_TPU_TIMELINE_MAX_EVENTS process-local ring capacity (100000)
+  RAY_TPU_HEAD_EVENTS_MAX     head-side per-node store capacity (100000)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from . import metrics as _metrics
+from . import timeline as _timeline
+
+DEFAULT_FLUSH_S = float(os.environ.get("RAY_TPU_EVENT_FLUSH_S", "1.0"))
+BATCH_MAX = int(os.environ.get("RAY_TPU_EVENT_BATCH_MAX", "2000"))
+
+
+class EventShipper:
+    """Per-process task-event buffer flusher (periodic + on-exit)."""
+
+    def __init__(self, client, flush_interval_s: Optional[float] = None):
+        self._client = client
+        self._interval = (DEFAULT_FLUSH_S if flush_interval_s is None
+                          else float(flush_interval_s))
+        self._cursor = 0
+        self._flush_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"event-ship-{client.node_id[:8]}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval):
+            try:
+                self.flush()
+            except Exception:
+                pass  # head briefly unreachable: next interval retries
+
+    def flush(self, timeout: float = 5.0) -> int:
+        """Drain-and-push everything new; returns events shipped.
+        Serialized so a manual flush (timeline export) cannot
+        interleave batches with the periodic one."""
+        with self._flush_lock:
+            events, self._cursor = _timeline.drain_since(self._cursor)
+            shipped = 0
+            # Chunked so one giant backlog can't build an unbounded
+            # RPC payload; the LAST chunk (possibly empty) refreshes
+            # the metrics snapshot.
+            while True:
+                chunk = events[shipped:shipped + BATCH_MAX]
+                last = shipped + len(chunk) >= len(events)
+                payload = {
+                    "node_id": self._client.node_id,
+                    "pid": os.getpid(),
+                    "events": chunk,
+                    "metrics": _metrics.export_state() if last else None,
+                    "dropped": _timeline.dropped_events(),
+                }
+                self._client.head.call("push_events", payload,
+                                       timeout=timeout)
+                shipped += len(chunk)
+                if last:
+                    return shipped
+
+    def stop(self) -> None:
+        """Stop the loop and do the on-exit flush (best-effort)."""
+        self._stopped.set()
+        try:
+            self.flush(timeout=2.0)
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------- merged views
+def export_cluster_timeline(filename: Optional[str] = None):
+    """ONE Chrome trace for the whole cluster: this process's events
+    merged with every node's shipped events from the head store (each
+    process is its own ``pid`` lane; flow events stitch cross-process
+    ring edges).  Outside cluster mode this is the local export."""
+    import json
+
+    from ..core.runtime import try_get_runtime
+
+    rt = try_get_runtime()
+    if rt is None or rt.cluster is None:
+        return _timeline.export_timeline(filename)
+    shipper = getattr(rt.cluster, "shipper", None)
+    if shipper is not None:
+        try:
+            shipper.flush()
+        except Exception:
+            pass
+    try:
+        resp = rt.cluster.head.call("cluster_timeline", {}, timeout=30.0)
+        events = list(resp.get("events", ()))
+    except Exception:
+        # Head unreachable: degrade to the local view.
+        events = _timeline.export_timeline(None)
+    if filename is None:
+        return events
+    with open(filename, "w") as f:
+        json.dump(events, f)
+    return filename
+
+
+def cluster_metrics_text() -> str:
+    """The head-side aggregated Prometheus exposition: the union of
+    every node's shipped metric state, each series tagged with its
+    ``node_id``.  Outside cluster mode: the local exposition."""
+    from ..core.runtime import try_get_runtime
+
+    rt = try_get_runtime()
+    if rt is None or rt.cluster is None:
+        return _metrics.prometheus_text()
+    shipper = getattr(rt.cluster, "shipper", None)
+    if shipper is not None:
+        try:
+            shipper.flush()
+        except Exception:
+            pass
+    try:
+        states: Dict = rt.cluster.head.call("cluster_metrics", {},
+                                            timeout=15.0)
+    except Exception:
+        return _metrics.prometheus_text()
+    if not states:
+        states = {rt.cluster.node_id: _metrics.export_state()}
+    return _metrics.render_exposition(states)

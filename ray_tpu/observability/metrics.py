@@ -4,6 +4,14 @@ Reference: python/ray/util/metrics.py (Counter/Gauge/Histogram over
 the C++ OpenCensus registry, stats/metric.h:103) — here a process-local
 registry; the runtime increments task/object counters and
 ``metrics_summary()`` snapshots everything.
+
+Cluster aggregation: ``export_state()`` is the picklable snapshot each
+worker ships to the head (observability/events.py push_events), and
+``render_exposition()`` renders any set of per-node snapshots as ONE
+Prometheus text page with a ``node_id`` label on every series — the
+head-side /metrics that unions head + worker series.  The local
+``prometheus_text()`` is the single-process special case of the same
+renderer.
 """
 
 from __future__ import annotations
@@ -27,7 +35,20 @@ class _Metric:
         with _lock:
             existing = _registry.get(name)
             if existing is not None:
-                # Re-declaring a metric returns the same series.
+                # Re-declaring a metric returns the same series — but a
+                # CONFLICTING re-declaration (different kind or tag
+                # keys) would silently corrupt the series, so it is an
+                # error, not a shrug.
+                if type(existing) is not type(self):
+                    raise ValueError(
+                        f"metric {name!r} re-declared as "
+                        f"{type(self).__name__}, but it was registered "
+                        f"as a {type(existing).__name__}")
+                if existing.tag_keys != self.tag_keys:
+                    raise ValueError(
+                        f"metric {name!r} re-declared with tag_keys="
+                        f"{self.tag_keys}, but it was registered with "
+                        f"tag_keys={existing.tag_keys}")
                 self.__dict__ = existing.__dict__
             else:
                 _registry[name] = self
@@ -63,6 +84,14 @@ class Histogram(_Metric):
             self.boundaries = sorted(boundaries) or [
                 0.001, 0.01, 0.1, 1.0, 10.0, 100.0]
             self._counts: Dict[Tuple, List[int]] = {}
+        elif boundaries and sorted(boundaries) != list(self.boundaries):
+            # Same name, different buckets: observations would land in
+            # the FIRST declaration's buckets while this caller reasons
+            # about its own — raise instead of silently ignoring.
+            raise ValueError(
+                f"histogram {name!r} re-declared with boundaries="
+                f"{sorted(boundaries)}, but it was registered with "
+                f"boundaries={list(self.boundaries)}")
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None):
@@ -90,51 +119,101 @@ def metrics_summary() -> Dict[str, Dict]:
     return out
 
 
+# ------------------------------------------------------------ exposition
+def _escape_label_value(v) -> str:
+    """Prometheus exposition format: label values escape backslash,
+    double-quote, and newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def export_state() -> Dict[str, Dict]:
+    """Picklable snapshot of every registered metric — name → {kind,
+    description, tag_keys, values, and for histograms boundaries +
+    bucket counts}.  This is what the event shipper sends to the head
+    and what ``render_exposition`` consumes."""
+    with _lock:
+        metrics = dict(_registry)
+    out: Dict[str, Dict] = {}
+    for name, m in metrics.items():
+        kind = ("counter" if isinstance(m, Counter)
+                else "histogram" if isinstance(m, Histogram)
+                else "gauge")
+        entry = {
+            "kind": kind,
+            "description": m.description,
+            "tag_keys": tuple(m.tag_keys),
+            "values": m.snapshot(),
+        }
+        if isinstance(m, Histogram):
+            with m._vlock:
+                entry["boundaries"] = list(m.boundaries)
+                entry["counts"] = {k: list(v)
+                                   for k, v in m._counts.items()}
+        out[name] = entry
+    return out
+
+
+def render_exposition(states: Dict[Optional[str], Dict[str, Dict]]) -> str:
+    """Render per-node ``export_state()`` snapshots as one Prometheus
+    text page.  ``states`` maps node_id → state; a None key means "no
+    node label" (the single-process exposition).  Every series from a
+    labeled node carries ``node_id="..."`` so the head's aggregated
+    /metrics distinguishes worker-recorded series."""
+    # metric name -> [(node_id, entry)] preserving node order.
+    by_name: Dict[str, List[Tuple[Optional[str], Dict]]] = {}
+    for node_id, state in states.items():
+        for name, entry in state.items():
+            by_name.setdefault(name, []).append((node_id, entry))
+
+    lines: List[str] = []
+    for name in sorted(by_name):
+        first = by_name[name][0][1]
+        if first["description"]:
+            lines.append(f"# HELP {name} {first['description']}")
+        lines.append(f"# TYPE {name} {first['kind']}")
+        for node_id, entry in by_name[name]:
+            base_pairs = ([f'node_id="{_escape_label_value(node_id)}"']
+                          if node_id is not None else [])
+            tag_keys = entry["tag_keys"]
+
+            def labelstr(key: Tuple, extra: Optional[str] = None) -> str:
+                pairs = list(base_pairs)
+                pairs += [f'{k}="{_escape_label_value(v)}"'
+                          for k, v in zip(tag_keys, key) if v]
+                if extra:
+                    pairs.append(extra)
+                return "{" + ",".join(pairs) + "}" if pairs else ""
+
+            if entry["kind"] == "histogram":
+                sums = entry["values"]
+                for key, buckets in entry.get("counts", {}).items():
+                    cum = 0
+                    for bound, c in zip(entry["boundaries"], buckets):
+                        cum += c
+                        le = 'le="%s"' % bound
+                        lines.append(
+                            f"{name}_bucket{labelstr(key, le)} {cum}")
+                    cum += buckets[-1]
+                    inf = 'le="+Inf"'
+                    lines.append(
+                        f"{name}_bucket{labelstr(key, inf)} {cum}")
+                    lines.append(f"{name}_count{labelstr(key)} {cum}")
+                    lines.append(
+                        f"{name}_sum{labelstr(key)} "
+                        f"{sums.get(key, 0.0)}")
+            else:
+                for key, v in entry["values"].items():
+                    lines.append(f"{name}{labelstr(key)} {v}")
+    return "\n".join(lines) + "\n"
+
+
 def prometheus_text() -> str:
     """Prometheus text exposition of every registered metric
     (reference: the node metrics agent's exposition endpoint,
     dashboard/modules/reporter/reporter_agent.py:336 +
     _private/metrics_agent.py)."""
-    with _lock:
-        metrics = dict(_registry)
-    lines: List[str] = []
-    for name, m in sorted(metrics.items()):
-        if m.description:
-            lines.append(f"# HELP {name} {m.description}")
-        kind = ("counter" if isinstance(m, Counter)
-                else "histogram" if isinstance(m, Histogram)
-                else "gauge")
-        lines.append(f"# TYPE {name} {kind}")
-
-        def labelstr(key: Tuple) -> str:
-            pairs = [f'{k}="{v}"' for k, v in zip(m.tag_keys, key) if v]
-            return "{" + ",".join(pairs) + "}" if pairs else ""
-
-        if isinstance(m, Histogram):
-            with m._vlock:
-                counts = {k: list(v) for k, v in m._counts.items()}
-                sums = dict(m._values)
-            for key, buckets in counts.items():
-                cum = 0
-                for bound, c in zip(m.boundaries, buckets):
-                    cum += c
-                    extra = f'le="{bound}"'
-                    base = labelstr(key)
-                    ls = (base[:-1] + "," + extra + "}") if base \
-                        else "{" + extra + "}"
-                    lines.append(f"{name}_bucket{ls} {cum}")
-                cum += buckets[-1]
-                base = labelstr(key)
-                ls = (base[:-1] + ',le="+Inf"}') if base \
-                    else '{le="+Inf"}'
-                lines.append(f"{name}_bucket{ls} {cum}")
-                lines.append(f"{name}_count{labelstr(key)} {cum}")
-                lines.append(
-                    f"{name}_sum{labelstr(key)} {sums.get(key, 0.0)}")
-        else:
-            for key, v in m.snapshot().items():
-                lines.append(f"{name}{labelstr(key)} {v}")
-    return "\n".join(lines) + "\n"
+    return render_exposition({None: export_state()})
 
 
 _exposition_server = None
@@ -142,7 +221,8 @@ _exposition_server = None
 
 def start_metrics_server(port: int = 0) -> str:
     """Serve ``prometheus_text`` at ``GET /metrics`` (stdlib http;
-    returns the bound address).  One per process."""
+    returns the bound address).  One per process — a second call
+    returns the address of the already-running server."""
     global _exposition_server
     if _exposition_server is not None:
         return _exposition_server
@@ -178,19 +258,30 @@ def reset_metrics():
         _registry.clear()
 
 
-# Runtime-internal series (incremented by ray_tpu.core.runtime).
-_runtime_counters = None
+# Hot-path metric groups are built once and reused until
+# reset_metrics() wipes the registry: callers sit on per-record paths
+# (task completions, ring frames, rpc retries), so the rebuild check
+# must be one dict lookup + identity compare, not a registry lock.
+_groups: Dict[str, Tuple[Dict[str, "_Metric"], "_Metric"]] = {}
+
+
+def metric_group(key: str, build) -> Dict[str, "_Metric"]:
+    """Build-once {name: metric} group keyed by ``key``; ``build`` runs
+    again only after reset_metrics() invalidated the group (detected by
+    the first member falling out of the registry)."""
+    entry = _groups.get(key)
+    if entry is not None:
+        group, anchor = entry
+        if _registry.get(anchor.name) is anchor:
+            return group
+    group = build()
+    _groups[key] = (group, next(iter(group.values())))
+    return group
 
 
 def runtime_counters():
-    """Singleton: called per task completion, so construct (and take
-    the registry lock) only once.  reset_metrics() invalidates it."""
-    global _runtime_counters
-    rc = _runtime_counters
-    if rc is not None and _registry.get("ray_tpu_tasks_finished") is \
-            rc["tasks_finished"]:
-        return rc
-    rc = {
+    """Per-task-completion series (incremented by ray_tpu.core.runtime)."""
+    return metric_group("runtime", lambda: {
         "tasks_finished": Counter(
             "ray_tpu_tasks_finished", "tasks completed OK",
             tag_keys=("kind",)),
@@ -200,6 +291,14 @@ def runtime_counters():
         "task_seconds": Histogram(
             "ray_tpu_task_seconds", "task execution wall time",
             tag_keys=("kind",)),
-    }
-    _runtime_counters = rc
-    return rc
+    })
+
+
+def dropped_events_counter() -> Counter:
+    """Timeline ring-buffer evictions (observability/timeline.py
+    increments this so drops show up in metrics_summary())."""
+    return metric_group("timeline", lambda: {
+        "dropped": Counter(
+            "ray_tpu_timeline_dropped_events",
+            "timeline events evicted by the drop-oldest ring buffer"),
+    })["dropped"]

@@ -127,9 +127,12 @@ def _gather_node_state(rt, what: str):
 
 def cmd_timeline(args) -> int:
     _connect(args.address)
-    from ray_tpu.observability.timeline import export_timeline
+    # The MERGED cluster export — the CLI process just connected, so
+    # its own local buffer is empty; the story lives in the head's
+    # per-node stores.
+    from ray_tpu.observability.events import export_cluster_timeline
 
-    path = export_timeline(args.output)
+    path = export_cluster_timeline(args.output)
     print(f"wrote {path}")
     return 0
 

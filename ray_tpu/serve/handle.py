@@ -253,9 +253,19 @@ class DeploymentHandle:
 
     # -- calls -------------------------------------------------------------
     def remote(self, *args, **kwargs):
+        from ..observability import tracing
+
         if self._stream:
-            return self._remote_streaming(args, kwargs)
-        ref, release, key = self._issue(args, kwargs)
+            with tracing.span(
+                    f"serve:{self.deployment_name}."
+                    f"{self._method or 'call'}"):
+                return self._remote_streaming(args, kwargs)
+        # Each serve request is a driver-side root operation: the span
+        # covers routing + submission, and the replica-side task span
+        # attaches to the same trace.
+        with tracing.span(f"serve:{self.deployment_name}."
+                          f"{self._method or 'call'}"):
+            ref, release, key = self._issue(args, kwargs)
         last_key = [key]
 
         def retry():

@@ -341,19 +341,24 @@ class CrossSlicePipeline:
         re-raises the typed error."""
         from ray_tpu.exceptions import (ActorError, ChannelError,
                                         ObjectLostError, TaskError)
+        from ray_tpu.observability import tracing
 
-        try:
-            self._run_wave(tokens)
-        except (ActorError, ChannelError, ObjectLostError,
-                TaskError) as e:
-            cause = e.cause if isinstance(e, TaskError) else e
-            if not isinstance(cause, (ActorError, ChannelError,
-                                      ObjectLostError)):
-                raise
-            if not self._recover_stages():
-                raise
-            self._run_wave(tokens)
-        return self._apply_updates()
+        # One trace per train step: every microbatch task on every
+        # stage (and the retried wave, if any) shares the trace id.
+        with tracing.span("train.step",
+                          args={"stages": self.n_stages}):
+            try:
+                self._run_wave(tokens)
+            except (ActorError, ChannelError, ObjectLostError,
+                    TaskError) as e:
+                cause = e.cause if isinstance(e, TaskError) else e
+                if not isinstance(cause, (ActorError, ChannelError,
+                                          ObjectLostError)):
+                    raise
+                if not self._recover_stages():
+                    raise
+                self._run_wave(tokens)
+            return self._apply_updates()
 
     def _recover_stages(self, timeout_s: float = 60.0) -> bool:
         """Wait for every stage to be ALIVE again (restarts included),
@@ -388,6 +393,12 @@ class CrossSlicePipeline:
         except Exception:
             return False
         self._plan_channels()
+        from ray_tpu.observability import metrics as _metrics
+
+        _metrics.Counter(
+            "ray_tpu_pipeline_recoveries_total",
+            "cross-pipeline wave recoveries (stage restart + ring "
+            "rebuild + retry)").inc()
         return True
 
     def _run_wave(self, tokens: np.ndarray) -> None:

@@ -47,6 +47,9 @@ def list_tasks(*, include_done: bool = False) -> List[Dict[str, Any]]:
                     "kind": args.get("kind", "task").upper(),
                     "attempt": args.get("attempt", 0),
                     "duration_s": ev.get("dur", 0) / 1e6,
+                    # Distributed-trace correlation (spans recorded on
+                    # any node of the same pass share this id).
+                    "trace_id": args.get("trace_id"),
                 })
     return out
 

@@ -109,6 +109,149 @@ def test_state_lists(ray_start_regular):
     del ref
 
 
+def test_timeline_drop_oldest_ring_buffer():
+    """A full buffer evicts the OLDEST event (new events always land)
+    and the loss is visible: dropped_events() and the
+    ray_tpu_timeline_dropped_events counter in metrics_summary()."""
+    from ray_tpu.observability import timeline as T
+
+    T.set_capacity(50)
+    try:
+        for i in range(60):
+            T.record_event(f"ev{i}", "i")
+        events = T.export_timeline()
+        assert len(events) == 50
+        names = [e["name"] for e in events]
+        assert names[0] == "ev10" and names[-1] == "ev59"  # oldest gone
+        assert T.dropped_events() == 10
+        summary = rt_metrics.metrics_summary()
+        assert sum(summary["ray_tpu_timeline_dropped_events"]
+                   .values()) == 10
+    finally:
+        T.set_capacity(100_000)
+
+
+def test_timeline_drain_cursor():
+    """drain_since hands each event out once and survives eviction of
+    undrained events (the cursor jumps past them)."""
+    from ray_tpu.observability import timeline as T
+
+    T.set_capacity(50)
+    try:
+        for i in range(10):
+            T.record_event(f"a{i}", "i")
+        batch, cur = T.drain_since(0)
+        assert [e["name"] for e in batch] == [f"a{i}" for i in range(10)]
+        batch2, cur2 = T.drain_since(cur)
+        assert batch2 == [] and cur2 == cur
+        for i in range(70):  # overflow: events 10..29 evicted undrained
+            T.record_event(f"b{i}", "i")
+        batch3, _cur3 = T.drain_since(cur)
+        assert len(batch3) == 50  # the ring's worth, oldest lost
+        assert batch3[0]["name"] == "b20"
+    finally:
+        T.set_capacity(100_000)
+
+
+def test_metric_redeclaration_conflicts_raise():
+    rt_metrics.Counter("redecl_c", tag_keys=("a",))
+    with pytest.raises(ValueError, match="tag_keys"):
+        rt_metrics.Counter("redecl_c", tag_keys=("b",))
+    rt_metrics.Histogram("redecl_h", boundaries=[1.0, 2.0])
+    with pytest.raises(ValueError, match="boundaries"):
+        rt_metrics.Histogram("redecl_h", boundaries=[5.0])
+    # Same declaration (or an unspecified one) still aliases fine.
+    rt_metrics.Counter("redecl_c", tag_keys=("a",))
+    rt_metrics.Histogram("redecl_h", boundaries=[1.0, 2.0])
+    rt_metrics.Histogram("redecl_h")
+
+
+def test_prometheus_label_escaping():
+    """Label values escape backslash, double-quote, and newline per
+    the exposition format."""
+    c = rt_metrics.Counter("esc_total", tag_keys=("path",))
+    c.inc(1, tags={"path": 'a"b\\c\nd'})
+    text = rt_metrics.prometheus_text()
+    assert 'esc_total{path="a\\"b\\\\c\\nd"} 1.0' in text
+
+
+def _parse_prometheus(text):
+    """Minimal Prometheus text parser: {series_name: {frozenset(label
+    pairs): float}} plus the TYPE map — enough to prove our exposition
+    is well-formed."""
+    series, types = {}, {}
+    for line in text.splitlines():
+        if not line or line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split()
+            types[name] = kind
+            continue
+        name_labels, value = line.rsplit(" ", 1)
+        if "{" in name_labels:
+            name, rest = name_labels.split("{", 1)
+            assert rest.endswith("}"), line
+            labels = frozenset(
+                tuple(pair.split("=", 1))
+                for pair in _split_label_pairs(rest[:-1]))
+        else:
+            name, labels = name_labels, frozenset()
+        series.setdefault(name, {})[labels] = float(value)
+    return series, types
+
+
+def _split_label_pairs(s):
+    """Split 'a="x",b="y"' respecting escaped quotes."""
+    out, cur, in_q, esc = [], "", False, False
+    for ch in s:
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\":
+            cur += ch
+            esc = True
+        elif ch == '"':
+            cur += ch
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        out.append(cur)
+    return out
+
+
+def test_exposition_parses_histogram_multi_tagset(ray_start_regular):
+    """Histogram _bucket/_count/_sum series with multiple tag sets
+    parse under a minimal Prometheus text parser, and double-starting
+    the exposition server returns the same address."""
+    import urllib.request
+
+    h = rt_metrics.Histogram("par_lat", "latency", boundaries=[0.1, 1.0],
+                             tag_keys=("route",))
+    for v, route in [(0.05, "a"), (0.5, "a"), (5.0, "a"), (0.5, "b")]:
+        h.observe(v, tags={"route": route})
+    addr = rt_metrics.start_metrics_server()
+    assert rt_metrics.start_metrics_server() == addr  # double-start
+    body = urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=10).read().decode()
+    series, types = _parse_prometheus(body)
+    assert types["par_lat"] == "histogram"
+    buckets = series["par_lat_bucket"]
+    assert buckets[frozenset({('route', '"a"'), ('le', '"0.1"')})] == 1
+    assert buckets[frozenset({('route', '"a"'), ('le', '"1.0"')})] == 2
+    assert buckets[frozenset({('route', '"a"'), ('le', '"+Inf"')})] == 3
+    assert buckets[frozenset({('route', '"b"'), ('le', '"+Inf"')})] == 1
+    assert series["par_lat_count"][frozenset({('route', '"a"')})] == 3
+    assert series["par_lat_sum"][frozenset({('route', '"b"')})] == 0.5
+    # Cumulative-bucket sanity across every tag set.
+    for labels, v in series["par_lat_count"].items():
+        inf_key = labels | {("le", '"+Inf"')}
+        assert buckets[inf_key] == v
+
+
 def test_prometheus_exposition(ray_start_regular):
     """Counters/gauges/histograms render in Prometheus text format and
     serve over HTTP (reference: node metrics agent exposition)."""

@@ -1,0 +1,318 @@
+"""Cluster-wide tracing and metrics plane (reference: the per-worker
+TaskEventBuffer → GCS aggregation pipeline, task_event_buffer.h:220 +
+ray.timeline): cross-process trace propagation, task-event shipping to
+the head, and the merged timeline / aggregated metrics views.
+
+The acceptance scenario lives here: a two-actor compiled-DAG pass over
+shm rings yields ONE exported cluster timeline with spans from three
+OS processes sharing a trace id, flow events linking the producer's
+ring write to the consumer's read, and an aggregated /metrics that
+serves worker-recorded series tagged with node_id.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.observability import metrics as rt_metrics
+from ray_tpu.observability import tracing
+from ray_tpu.observability.timeline import clear as clear_timeline
+
+pytestmark = pytest.mark.tracing
+
+
+@pytest.fixture(autouse=True)
+def fresh_buffers():
+    clear_timeline()
+    rt_metrics.reset_metrics()
+    yield
+    clear_timeline()
+
+
+def _channels_or_skip():
+    from ray_tpu.experimental.channel import channels_available
+
+    if not channels_available():
+        pytest.skip("native channel lib unavailable")
+
+
+# ---------------------------------------------------------------------------
+# The propagation primitives
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_for_submission_mints_root_then_inherits(self):
+        tid, parent = tracing.for_submission()
+        assert tid is not None and parent is None
+        tid2, _ = tracing.for_submission()
+        assert tid2 != tid  # each bare submission is its own root
+        prev = tracing.set_current(("trace-x", "span-y"))
+        try:
+            tid3, parent3 = tracing.for_submission()
+            assert (tid3, parent3) == ("trace-x", "span-y")
+        finally:
+            tracing.set_current(prev)
+
+    def test_span_scope_nests_and_records(self):
+        with tracing.span("outer") as outer:
+            assert tracing.current() == (outer.trace_id, outer.span_id)
+            with tracing.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_span_id == outer.span_id
+        assert tracing.current() is None
+        events = ray_tpu.timeline()
+        names = {e["name"] for e in events}
+        assert {"outer", "inner"} <= names
+
+    def test_disable_turns_plane_off(self):
+        tracing.disable()
+        try:
+            assert tracing.current() is None
+            assert tracing.new_trace_id() is None
+            assert tracing.for_submission() == (None, None)
+            with tracing.span("ghost") as s:
+                assert s.trace_id is None
+        finally:
+            tracing.enable()
+        assert not any(e["name"] == "ghost" for e in ray_tpu.timeline())
+
+    def test_rpc_envelope_propagates_trace(self):
+        """The (trace_id, parent_span_id) pair rides the RPC envelope:
+        a handler observes the CALLER's context, and the server thread
+        is clean again afterwards."""
+        from ray_tpu.cluster.rpc import RpcClient, RpcServer
+
+        seen = []
+        server = RpcServer(
+            {"probe": lambda p: seen.append(tracing.current()) or "ok"})
+        client = RpcClient(server.address)
+        try:
+            prev = tracing.set_current(("t-abc", "s-def"))
+            try:
+                client.call("probe", None, timeout=10.0)
+            finally:
+                tracing.set_current(prev)
+            client.call("probe", None, timeout=10.0)
+            assert seen == [("t-abc", "s-def"), None]
+        finally:
+            client.close()
+            server.shutdown()
+
+    def test_local_task_spans_share_root_trace(self, ray_start_regular):
+        """A task submitting a child task: both spans carry one trace
+        id, the child's parent_span_id is the parent's span_id."""
+
+        @ray_tpu.remote
+        def child():
+            return 1
+
+        @ray_tpu.remote
+        def parent():
+            return ray_tpu.get(child.remote()) + 1
+
+        assert ray_tpu.get(parent.remote()) == 2
+        spans = [e for e in ray_tpu.timeline()
+                 if e.get("args", {}).get("kind") == "task"]
+        by_name = {e["name"].rsplit(".", 1)[-1]: e["args"]
+                   for e in spans}
+        p, c = by_name["parent"], by_name["child"]
+        assert p["trace_id"] == c["trace_id"]
+        assert c["parent_span_id"] == p["span_id"]
+        assert "parent_span_id" not in p  # the root has no parent
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: merged views of one distributed pass
+# ---------------------------------------------------------------------------
+
+class TestClusterPlane:
+    def _cluster(self):
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        c = Cluster()
+        env = {"RAY_TPU_EVENT_FLUSH_S": "0.2"}
+        c.add_node(num_cpus=2, resources={"d0": 10}, env=env)
+        c.add_node(num_cpus=2, resources={"d1": 10}, env=env)
+        c.connect(num_cpus=2)
+        return c
+
+    def test_merged_timeline_and_aggregated_metrics(self, shutdown_only):
+        """Acceptance: a two-actor compiled-DAG pass over shm rings →
+        ONE cluster timeline with spans from ≥3 OS processes sharing a
+        trace id, a flow event pair linking the producer's ring write
+        to the consumer's read, and the aggregated /metrics serving a
+        worker-recorded ray_tpu_channel_write_wait_seconds tagged with
+        that worker's node_id."""
+        _channels_or_skip()
+        import urllib.request
+
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+        c = self._cluster()
+        try:
+            @ray_tpu.remote
+            class Stage:
+                def step(self, x):
+                    return x + 1
+
+            with InputNode() as inp:
+                a = Stage.options(resources={"d0": 1}).bind()
+                b = Stage.options(resources={"d1": 1}).bind()
+                dag = b.step.bind(a.step.bind(inp))
+            compiled = dag.experimental_compile()
+            assert compiled._channel_edges  # the edge rides a ring
+            for i in range(4):
+                assert ray_tpu.get(compiled.execute(i)) == i + 2
+
+            deadline = time.monotonic() + 30.0
+            while True:
+                events = ray_tpu.timeline()  # the MERGED view
+                # Spans of one trace across ≥3 distinct process lanes.
+                pids_of = {}
+                for e in events:
+                    t = e.get("args", {}).get("trace_id")
+                    if t:
+                        pids_of.setdefault(t, set()).add(e["pid"])
+                distributed = [t for t, pids in pids_of.items()
+                               if len(pids) >= 3]
+                # Producer-side flow start matched by a consumer-side
+                # finish with the same id, in different processes.
+                starts = {e["id"]: e["pid"] for e in events
+                          if e.get("cat") == "flow" and e["ph"] == "s"}
+                linked = [
+                    (e["pid"], starts[e["id"]]) for e in events
+                    if e.get("cat") == "flow" and e["ph"] == "f"
+                    and e["id"] in starts]
+                cross = [pair for pair in linked if pair[0] != pair[1]]
+                if distributed and cross:
+                    break
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"merged timeline incomplete: distributed="
+                        f"{distributed}, flow pairs={linked}")
+                time.sleep(0.3)
+
+            # Aggregated /metrics through the dashboard.
+            dash = start_dashboard(port=0)
+            try:
+                body = urllib.request.urlopen(
+                    dash.url + "/metrics", timeout=15).read().decode()
+            finally:
+                stop_dashboard()
+            wait_lines = [
+                line for line in body.splitlines()
+                if line.startswith(
+                    "ray_tpu_channel_write_wait_seconds_count")]
+            workers = {n["NodeID"] for n in ray_tpu.nodes()}
+            assert any('node_id="' in line and any(w in line
+                                                   for w in workers)
+                       for line in wait_lines), wait_lines
+            compiled.teardown()
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+    def test_event_shipping_bounded_and_on_exit_flush(self, shutdown_only):
+        """Worker task events land in the head store (periodic flush);
+        the head's per-node stores are bounded drop-oldest."""
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        c = Cluster()
+        c.add_node(num_cpus=2, resources={"w": 1},
+                   env={"RAY_TPU_EVENT_FLUSH_S": "0.2"})
+        rt = c.connect(num_cpus=2)
+        try:
+            @ray_tpu.remote(resources={"w": 1})
+            def on_worker():
+                return 42
+
+            assert ray_tpu.get(on_worker.remote()) == 42
+            driver_node = rt.cluster.node_id
+            deadline = time.monotonic() + 20.0
+            while True:
+                resp = rt.cluster.head.call("cluster_timeline", {},
+                                            timeout=10.0)
+                worker_nodes = [n for n in resp["nodes"]
+                                if n != driver_node]
+                worker_events = [
+                    e for n in worker_nodes
+                    for e in rt.cluster.head.call(
+                        "cluster_timeline", {"node_id": n},
+                        timeout=10.0)["events"]]
+                if any(e.get("args", {}).get("kind") == "task"
+                       for e in worker_events):
+                    break
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"no worker task events shipped: {resp['meta']}")
+                time.sleep(0.3)
+            # Worker metric snapshots arrived too.
+            states = rt.cluster.head.call("cluster_metrics", {},
+                                          timeout=10.0)
+            assert any(n != driver_node and
+                       "ray_tpu_tasks_finished" in s
+                       for n, s in states.items())
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Chaos visibility: recovery observable THROUGH the plane
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestChaosVisibility:
+    def test_kill_mid_pass_visible_in_plane(self, ray_start_regular):
+        """Acceptance: a chaos kill-mid-pass run is visible in the
+        plane — replan/recovery counters increment and the injected
+        fault appears as a tagged event in the merged timeline."""
+        _channels_or_skip()
+        from ray_tpu.dag import InputNode
+        from ray_tpu.exceptions import ActorDiedError, ChannelError
+        from ray_tpu.experimental import chaos
+
+        @ray_tpu.remote
+        class Stage:
+            def step(self, x):
+                return x + 1
+
+        with InputNode() as inp:
+            a = Stage.options(max_restarts=1).bind()
+            b = Stage.bind()
+            dag = b.step.bind(a.step.bind(inp))
+        compiled = dag.experimental_compile(channel_timeout=2.0)
+        for _ in range(3):
+            assert ray_tpu.get(compiled.execute(0)) == 2
+
+        sched = chaos.schedule().kill_at_ring_write(
+            "dag0-1", nth=4, no_restart=False)
+        with sched:
+            try:
+                ray_tpu.get(compiled.execute(0), timeout=20.0)
+            except (ActorDiedError, ChannelError):
+                pass
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    assert ray_tpu.get(compiled.execute(0),
+                                       timeout=10.0) == 2
+                    break
+                except (ActorDiedError, ChannelError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+        assert sched.fired("ring_kill") == 1
+
+        summary = rt_metrics.metrics_summary()
+        assert sum(summary["ray_tpu_dag_replans_total"].values()) >= 1
+        assert sum(summary["ray_tpu_dag_pass_failures_total"]
+                   .values()) >= 1
+        tagged = [e for e in ray_tpu.timeline()
+                  if e.get("args", {}).get("chaos")]
+        assert tagged, "injected fault not visible in the timeline"
+        assert tagged[0]["name"] == "chaos:ring_kill"
+        assert tagged[0]["args"]["target"] == "dag0-1"
+        compiled.teardown()
