@@ -200,3 +200,4 @@ class Autoscaler:
     def shutdown(self):
         self._stop.set()
         self._thread.join(timeout=5)
+        self._head.close()

@@ -25,7 +25,8 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
-from .rpc import ClientPool, Deferred, ReconnectingClient, RpcServer
+from .rpc import (TRANSPORT_ERRORS, ClientPool, Deferred,
+                  ReconnectingClient, RpcServer)
 from .serialization import dumps, from_wire, loads, to_wire
 
 _HEARTBEAT_S = 1.0
@@ -443,7 +444,7 @@ class ClusterClient:
             resp = self.head.call("place", {
                 "resources": demand,
                 "exclude": list(exclude), **params}, timeout=2.0)
-        except Exception:
+        except Exception:  # raylint: disable=ft-exception-swallow -- spill is an OPTIMIZATION on the inline .remote() path: ANY placement failure (transport, garbled reply, head-side error) must degrade to local queueing, never surface to the submitter
             self._spill_noroom = (now + _HEARTBEAT_S, demand)
             return False
         if not resp.get("ok"):
@@ -546,7 +547,7 @@ class ClusterClient:
         try:
             self.head.call("report_node_failure", {"node_id": node_id},
                            timeout=5.0)
-        except Exception:
+        except Exception:  # raylint: disable=ft-exception-swallow -- runs inside task-completion callbacks: ANY escape here would abort the callback before complete_error seals the task's refs (owner hangs); the heartbeat reaper covers a missed report
             pass
         with self._loc_lock:
             stale = [a for a, (n, addr) in
@@ -578,8 +579,8 @@ class ClusterClient:
             self.pool.get(loc[1]).call_async(
                 "free_primary", {"oid": oid},
                 callback=lambda _r, _e: None)
-        except Exception:
-            pass
+        except TRANSPORT_ERRORS:
+            pass  # dead holder: its primary copy is already gone
 
     def pull_sealed(self, oid, address: str, timeout: float = 300.0):
         """Chunked MULTI-STREAM pull of an object's flat wire layout
@@ -1144,8 +1145,8 @@ class ClusterClient:
                             "release_borrower",
                             {"oid": oid, "borrower": self.address},
                             callback=lambda _r, _e: None)
-                    except Exception:
-                        pass
+                    except TRANSPORT_ERRORS:
+                        pass  # dead owner: no hold left to release
             redirect = resp.get("redirect")
             if redirect is None:
                 store.put(oid, RayObject(sealed=from_wire(resp["data"])))
@@ -1186,8 +1187,8 @@ class ClusterClient:
                 "release_borrower",
                 {"oid": oid, "borrower": self.address},
                 callback=lambda _r, _e: None)
-        except Exception:
-            pass
+        except TRANSPORT_ERRORS:
+            pass  # dead owner: no hold left to release
 
     def ensure_local(self, ref) -> None:
         owner = ref.owner_address()
@@ -1483,16 +1484,24 @@ class ClusterClient:
         # tell the story of its last tasks in the merged timeline.
         try:
             self.shipper.stop()
-        except Exception:
+        except Exception:  # raylint: disable=ft-exception-swallow -- teardown is best-effort: losing the final event batch must not block detach
             pass
         try:
-            self.head.call("drain_node", {"node_id": self.node_id},
-                           timeout=2.0)
-        except Exception:
+            # Raw connection, no re-dial: a farewell to a head that is
+            # already gone must fail fast, not burn a connect budget.
+            self.head._client.call("drain_node",
+                                   {"node_id": self.node_id},
+                                   timeout=2.0)
+        except Exception:  # raylint: disable=ft-exception-swallow -- teardown is best-effort: an unreachable head reaps this node via heartbeats
             pass
         self.server.shutdown()
         self.pool.close_all()
         self.head.close()
+        # Background loops observe _stopped; reap them so interpreter
+        # teardown never races a half-dead poller.  Bounded joins: the
+        # pubsub loop can sit inside a long poll — it is daemon anyway.
+        self._hb_thread.join(timeout=2.0)
+        self._sub_thread.join(timeout=2.0)
 
 
 class ObjectStreamServer:
@@ -1600,12 +1609,16 @@ class NodeServer:
         self.client = client
         self._server = RpcServer({
             "push_task": self._push_task,
-            "create_actor": self._create_actor,
+            # create_actor is naturally idempotent: the payload is a
+            # wire bundle keyed by the CALLER-minted actor_id, and
+            # re-creating a live id replaces nothing (the actor
+            # manager keeps the first core).
+            "create_actor": self._create_actor,  # raylint: disable=handler-idempotency -- keyed by caller-minted actor_id; wire-bundle payload cannot carry an _idem key
             "actor_call": self._actor_call,
             "actor_ready": self._actor_ready,
             "actor_info": self._actor_info,
             "channel_destroy": self._channel_destroy,
-            "kill_actor": self._kill_actor,
+            "kill_actor": self._kill_actor,  # raylint: disable=handler-idempotency -- killing an already-dead actor is a no-op
             "get_object": self._get_object,
             "release_borrower": self._release_borrower,
             "object_meta": self._object_meta,
@@ -1618,7 +1631,7 @@ class NodeServer:
             "report_object_lost": self._report_object_lost,
             "stream_item": self._stream_item,
             "add_pg_capacity": self._add_pg_capacity,
-            "remove_pg_capacity": self._remove_pg_capacity,
+            "remove_pg_capacity": self._remove_pg_capacity,  # raylint: disable=handler-idempotency -- callers are single-shot (no retry wrapper), and PG teardown races resolve by pg_id
             "tail_log": self._tail_log,
             "node_state": self._node_state,
             "ping": lambda p: "pong",
@@ -1924,7 +1937,7 @@ class NodeServer:
             self.client.head.call("heartbeat", {
                 "node_id": self.client.node_id,
                 "add_resources": cap}, timeout=10.0)
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass  # the next periodic heartbeat carries availability
         return {"ok": True}
 
@@ -1944,8 +1957,8 @@ class NodeServer:
             self.client.head.call("heartbeat", {
                 "node_id": self.client.node_id,
                 "remove_resources": list(cap)}, timeout=10.0)
-        except Exception:
-            pass
+        except TRANSPORT_ERRORS:
+            pass  # the next periodic heartbeat carries availability
         return {"ok": True}
 
     def _node_state(self, p):

@@ -164,6 +164,7 @@ class HeadServer:
         # Actor restart machinery (reference: gcs_actor_manager.h:308
         # FSM — ALIVE → RESTARTING → ALIVE/DEAD with max_restarts).
         self._pool = ClientPool()
+        self._stop = threading.Event()
         self._restart_pending: List[bytes] = []
         self._restart_cond = threading.Condition(self._lock)
         self._restarter = threading.Thread(target=self._restart_loop,
@@ -413,10 +414,17 @@ class HeadServer:
         return gone
 
     def _restart_loop(self):
-        while True:
+        while not self._stop.is_set():
             with self._restart_cond:
                 while not self._restart_pending:
-                    self._restart_cond.wait()
+                    # Stop check BEFORE the wait: shutdown() can set
+                    # _stop and notify between our outer loop check and
+                    # acquiring the condition — an untimed wait here
+                    # would sleep through that lost notification
+                    # forever.  The timeout is belt-and-braces.
+                    if self._stop.is_set():
+                        return
+                    self._restart_cond.wait(timeout=1.0)
                 aid = self._restart_pending.pop(0)
                 info = self._actors.get(aid)
                 if info is None or info.get("state") != "RESTARTING":
@@ -439,23 +447,19 @@ class HeadServer:
                     resp = self._pool.get(placed["address"]).call(
                         "create_actor", spec, timeout=60.0)
                     ok = bool(resp.get("ok"))
-                except Exception:
+                except Exception:  # raylint: disable=ft-exception-swallow -- any failure (transport or remote create error) routes to the same retry-under-deadline path below
                     ok = False
+            kill_leaked = False
             with self._lock:
                 info = self._actors.get(aid)
                 if info is None:
                     # Killed/removed while we were restarting it: the
-                    # fresh replica (if any) must not leak.
-                    if ok:
-                        try:
-                            self._pool.get(placed["address"]).call(
-                                "kill_actor",
-                                {"actor_id": loads(spec)["actor_id"],
-                                 "no_restart": True}, timeout=10.0)
-                        except Exception:
-                            pass
-                    continue
-                if ok:
+                    # fresh replica (if any) must not leak.  The kill
+                    # RPC runs AFTER the lock drops — a blocking call
+                    # here would wedge every other head handler for up
+                    # to its timeout.
+                    kill_leaked = ok
+                elif ok:
                     info["node_id"] = placed["node_id"]
                     info["address"] = placed["address"]
                     info["restarts_used"] = \
@@ -478,8 +482,19 @@ class HeadServer:
                         self._named.pop(
                             (info.get("namespace", ""), info["name"]),
                             None)
+            if kill_leaked:
+                try:
+                    self._pool.get(placed["address"]).call(
+                        "kill_actor",
+                        {"actor_id": loads(spec)["actor_id"],
+                         "no_restart": True}, timeout=10.0)
+                except Exception:  # raylint: disable=ft-exception-swallow -- best-effort leak cleanup; an uncaught error here would kill the restart thread for every future actor
+                    pass
+                continue
+            if info is None:
+                continue
             if not ok:
-                time.sleep(1.0)
+                self._stop.wait(1.0)
 
     def _list_nodes(self, _p):
         with self._lock:
@@ -491,8 +506,7 @@ class HeadServer:
             } for e in self._nodes.values()]
 
     def _reap_loop(self):
-        while True:
-            time.sleep(_DEAD_AFTER_S / 4)
+        while not self._stop.wait(_DEAD_AFTER_S / 4):
             cutoff = time.monotonic() - _DEAD_AFTER_S
             with self._lock:
                 dead = []
@@ -874,7 +888,13 @@ class HeadServer:
             return {"ok": removed}
 
     def shutdown(self):
+        self._stop.set()
+        with self._restart_cond:
+            self._restart_cond.notify_all()
         self._server.shutdown()
+        self._pool.close_all()
+        self._restarter.join(timeout=2.0)
+        self._reaper.join(timeout=2.0)
 
 
 def main():  # pragma: no cover - exercised via subprocess in tests

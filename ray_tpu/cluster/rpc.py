@@ -179,6 +179,14 @@ class DeserializationError(RuntimeError):
     cascade false node failures."""
 
 
+# The catch-set for best-effort / fire-and-forget RPCs: everything the
+# TRANSPORT can do to a call, including a reply that fails to decode —
+# but NOT server-shipped application/FT exceptions, which such callers
+# must either handle or deliberately disable the lint for.
+TRANSPORT_ERRORS = (ConnectionError, TimeoutError, OSError,
+                    DeserializationError)
+
+
 def _send_msg(sock: socket.socket, kind: str, req_id: str, method: str,
               payload: Any, lock: threading.Lock,
               trace: Optional[Tuple] = None):
@@ -410,17 +418,28 @@ class RpcServer:
 
     def shutdown(self):
         self._stopped.set()
+        # Closing a listening socket does NOT wake a thread blocked in
+        # accept() on this kernel — a dummy self-connection pops it out
+        # deterministically (the loop re-checks _stopped and exits).
+        try:
+            socket.create_connection(self._sock.getsockname(),
+                                     timeout=0.5).close()
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        # Reap the acceptor so shutdown leaves no half-dead thread.
+        self._accept_thread.join(timeout=2.0)
 
 
 class RpcClient:
     """Persistent connection to one RpcServer; thread-safe concurrent
     calls correlated by request id (reference: retryable_grpc_client.h)."""
 
-    def __init__(self, address: str, connect_timeout: float = 10.0):
+    def __init__(self, address: str, connect_timeout: float = 10.0,
+                 abort: Optional[Callable[[], bool]] = None):
         self.address = address
         # Legacy env-var chaos budget (per client, so subprocess
         # workers inherit faults); the programmable schedule is
@@ -431,13 +450,19 @@ class RpcClient:
         self._pending: Dict[str, _PendingCall] = {}
         self._sock: Optional[socket.socket] = None
         self._closed = False
-        self._connect(connect_timeout)
+        self._connect(connect_timeout, abort)
 
-    def _connect(self, timeout: float):
+    def _connect(self, timeout: float,
+                 abort: Optional[Callable[[], bool]] = None):
         host, port = self.address.rsplit(":", 1)
         deadline = time.monotonic() + timeout
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
+            if abort is not None and abort():
+                # The owner (e.g. a ReconnectingClient being closed)
+                # withdrew the dial: stop burning the connect budget.
+                raise ConnectionError(
+                    f"dial to {self.address} aborted: client closed")
             try:
                 sock = socket.create_connection((host, int(port)),
                                                 timeout=timeout)
@@ -589,20 +614,56 @@ class ReconnectingClient:
     retryable_grpc_client.h) — the peer surviving a restart at the same
     address resumes service transparently."""
 
+    _REDIAL_COOLDOWN_S = 5.0
+
     def __init__(self, address: str, connect_timeout: float = 10.0):
         self.address = address
         self._connect_timeout = connect_timeout
         self._lock = threading.Lock()
+        self._closed = False
+        self._no_redial_until = 0.0
         self._client = RpcClient(address, connect_timeout)
 
     def _reconnect(self) -> RpcClient:
         with self._lock:
+            if self._closed:
+                # A closed client must NOT resurrect the connection:
+                # background pollers retrying through here after
+                # close() would re-dial a peer we already detached
+                # from (and hang teardown behind fresh long-polls).
+                raise ConnectionError(
+                    f"client to {self.address} is closed")
             client = self._client
             if client._sock is not None:
                 return client  # another caller already re-dialed
+            if time.monotonic() < self._no_redial_until:
+                # A re-dial just burned its full connect budget: fail
+                # fast instead of every caller serially paying it
+                # again against a peer that is plainly down (callers
+                # with patience use call_retry and span the cooldown).
+                raise ConnectionError(
+                    f"{self.address} unreachable (re-dial cooldown)")
             client.close()
-            self._client = RpcClient(self.address,
-                                     max(2.0, self._connect_timeout))
+            # Dialing under the lock is the POINT: concurrent callers
+            # racing a lost connection must serialize behind ONE
+            # re-dial (the early return above) instead of stampeding
+            # the recovering peer with N sockets.
+            try:
+                fresh = RpcClient(self.address,  # raylint: disable=blocking-under-lock -- the lock exists to serialize exactly this re-dial; no RPC ever runs under it
+                                  max(2.0, self._connect_timeout),
+                                  abort=lambda: self._closed)
+            except ConnectionError:
+                self._no_redial_until = (time.monotonic()
+                                         + self._REDIAL_COOLDOWN_S)
+                raise
+            if self._closed:
+                # close() raced the dial (it sets the flag without
+                # waiting for this lock): the fresh connection must
+                # not outlive the wrapper.
+                fresh.close()
+                raise ConnectionError(
+                    f"client to {self.address} is closed")
+            self._client = fresh
             return self._client
 
     def call(self, method: str, payload: Any = None,
@@ -645,7 +706,14 @@ class ReconnectingClient:
         return self._client._sock
 
     def close(self):
-        self._client.close()
+        # Flag first, WITHOUT the lock: a re-dial in progress holds
+        # the lock for its whole connect budget, and the flag is what
+        # aborts that dial (within one retry tick).  Only then take
+        # the lock to close whichever client is current.
+        self._closed = True
+        with self._lock:
+            client = self._client
+        client.close()
 
 
 class ClientPool:

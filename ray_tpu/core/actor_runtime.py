@@ -261,19 +261,25 @@ class _ActorCore:
                 inst._ray_tpu_isolated_close()
             except Exception:
                 pass
+        failed = []
         with self._submit_lock:
             self._stopped.set()
-            # Fail everything still queued.
+            # Drain under the lock; COMPLETE outside it.  complete_error
+            # fans out to owner callbacks and (for remote owners) RPCs —
+            # running those while holding the submit lock would block
+            # every concurrent submitter behind user-visible work.
             try:
                 while True:
                     spec = self._queue.get_nowait()
                     if spec is not None:
-                        self._runtime.task_manager.complete_error(
-                            spec, self._dead_error(), allow_retry=False)
+                        failed.append(spec)
             except queue.Empty:
                 pass
             for _ in self._threads:
                 self._queue.put(None)
+        for spec in failed:
+            self._runtime.task_manager.complete_error(
+                spec, self._dead_error(), allow_retry=False)
 
 
 class ActorInfo:

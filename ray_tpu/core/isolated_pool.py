@@ -232,6 +232,7 @@ class IsolatedPool:
             self._stopped = True
             self._cv.notify_all()
         self._monitor.stop()
+        self._reaper.join(timeout=2.0)
         with self._lock:
             everyone = self._idle + self._busy + self._dedicated
             self._idle, self._busy, self._dedicated = [], [], []
@@ -323,6 +324,7 @@ class _MemoryMonitor:
 
     def stop(self):
         self._stop.set()
+        self._thread.join(timeout=2.0)
 
 
 def _meminfo(key: str) -> int:

@@ -341,7 +341,7 @@ class Runtime:
                 ev.set()
         try:
             obj = store.wait_and_get(oid, timeout)
-        except Exception:
+        except Exception:  # raylint: disable=ft-exception-swallow -- recovery verdict is boolean; the object itself carries the typed error and re-raises at get()
             return False
         return not obj.is_error()
 
@@ -1086,12 +1086,14 @@ class Runtime:
         if core is not None and self.cluster is not None and no_restart:
             # Locally-hosted actors are registered cluster-wide; a kill
             # must retire the head entry too.
+            from ..cluster.rpc import TRANSPORT_ERRORS as _TRANSPORT_ERRORS
+
             try:
                 self.cluster.head.call_idempotent(
                     "remove_actor", {"actor_id": actor_id.binary()},
                     deadline_s=10.0)
-            except Exception:
-                pass
+            except _TRANSPORT_ERRORS:
+                pass  # head unreachable: its reaper retires the entry
         if core is not None and core.info.state == ActorState.DEAD:
             self._release_actor_resources(core.info)
             # If the kill landed between the creation thread's acquire

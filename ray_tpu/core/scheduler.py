@@ -229,3 +229,4 @@ class LocalScheduler:
         with self._lock:
             self._shutdown = True
             self._cond.notify_all()
+        self._dispatcher.join(timeout=2.0)

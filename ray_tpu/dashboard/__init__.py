@@ -211,6 +211,7 @@ class Dashboard:
     def shutdown(self):
         self._server.shutdown()
         self._server.server_close()
+        self._thread.join(timeout=2.0)
 
 
 _dashboard: Optional[Dashboard] = None

@@ -373,40 +373,56 @@ class ChannelReader:
 
     def _ensure(self, producer=None,
                 deadline: Optional[float] = None) -> Channel:
+        """The waiting loop runs WITHOUT holding ``_lock``: it sleeps
+        and probes producer liveness (which can cost a head RPC), and a
+        blocked wait under the lock would also wedge ``close()`` behind
+        a full read timeout.  The lock only guards the install of
+        ``self._chan`` against a concurrent ``close()``."""
         with self._lock:
-            if self._chan is None:
-                if deadline is None:
-                    deadline = time.monotonic() + self.timeout
-                probe_at = time.monotonic() + _PROBE_PERIOD_S
-                while True:
-                    if self._closed.is_set():
-                        raise ChannelError(
-                            "ring torn down while waiting for its "
-                            "writer to create it",
-                            context={"ring":
-                                     os.path.basename(self.path)})
-                    _check_not_destroyed(self.path)
-                    try:
-                        self._chan = Channel(self.path, writer=False)
-                        break
-                    except FileNotFoundError:
-                        now = time.monotonic()
-                        if now >= probe_at:
-                            # The writer creates the ring at its first
-                            # put: a dead producer means it never will.
-                            probe_at = now + _PROBE_PERIOD_S
-                            _raise_if_producer_gone(producer, self.path)
-                        if now > deadline:
-                            # Typed (not a bare TimeoutError): the
-                            # poison-pill fan-out and replan paths key
-                            # on FT error types.
-                            raise ChannelError(
-                                "ring was never created by its writer "
-                                f"(waited {self.timeout:.0f}s)",
-                                context={"ring":
-                                         os.path.basename(self.path)})
-                        time.sleep(0.001)
-            return self._chan
+            if self._chan is not None:
+                return self._chan
+        if deadline is None:
+            deadline = time.monotonic() + self.timeout
+        probe_at = time.monotonic() + _PROBE_PERIOD_S
+        while True:
+            if self._closed.is_set():
+                raise ChannelError(
+                    "ring torn down while waiting for its "
+                    "writer to create it",
+                    context={"ring": os.path.basename(self.path)})
+            _check_not_destroyed(self.path)
+            try:
+                chan = Channel(self.path, writer=False)
+            except FileNotFoundError:
+                now = time.monotonic()
+                if now >= probe_at:
+                    # The writer creates the ring at its first
+                    # put: a dead producer means it never will.
+                    probe_at = now + _PROBE_PERIOD_S
+                    _raise_if_producer_gone(producer, self.path)
+                if now > deadline:
+                    # Typed (not a bare TimeoutError): the
+                    # poison-pill fan-out and replan paths key
+                    # on FT error types.
+                    raise ChannelError(
+                        "ring was never created by its writer "
+                        f"(waited {self.timeout:.0f}s)",
+                        context={"ring":
+                                 os.path.basename(self.path)})
+                time.sleep(0.001)
+                continue
+            with self._lock:
+                if self._closed.is_set():
+                    chan.close()
+                    raise ChannelError(
+                        "ring torn down while waiting for its "
+                        "writer to create it",
+                        context={"ring": os.path.basename(self.path)})
+                if self._chan is None:
+                    self._chan = chan
+                elif chan is not self._chan:
+                    chan.close()  # lost a (theoretical) install race
+                return self._chan
 
     def _read_frame(self, producer) -> bytearray:
         """Deadline-bounded blocking read.  Polls in short slices and
@@ -507,7 +523,7 @@ class ChannelReader:
 
     def close(self) -> None:
         # Flag first: a waiter inside _ensure's creation loop (which
-        # holds the lock) exits within one iteration, releasing it.
+        # polls outside the lock) exits within one iteration.
         self._closed.set()
         with self._lock:
             chan, self._chan = self._chan, None
@@ -771,7 +787,7 @@ def channel_location(handle_or_id) -> Optional[Tuple[str, Optional[str]]]:
     try:
         resp = rt.cluster.pool.get(address).call(
             "actor_info", {"actor_id": actor_id}, timeout=30.0)
-    except Exception:
+    except Exception:  # raylint: disable=ft-exception-swallow -- planner probe: ANY failure means "cannot host a ring" and the edge falls back to the object plane
         return None
     if not resp.get("found") or resp.get("is_async") \
             or resp.get("max_concurrency") != 1 or resp.get("isolate"):
@@ -804,6 +820,6 @@ def destroy_channel_at(path: str,
             rt.cluster.pool.get(address).call_with_retry(
                 "channel_destroy", {"path": path}, timeout=10.0,
                 deadline_s=15.0)
-        except Exception:
+        except Exception:  # raylint: disable=ft-exception-swallow -- best-effort teardown: an unreachable host's ring dies with its node
             pass
     destroy_channel(path)

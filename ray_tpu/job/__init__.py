@@ -59,7 +59,7 @@ class JobSupervisor:
             env["RAY_TPU_HEAD_ADDRESS"] = head
         cwd = runtime_env.get("working_dir") or None
         self._update(status="RUNNING", start_time=time.time())
-        self._log = open(self.log_path, "wb")
+        self._log = open(self.log_path, "wb")  # raylint: disable=resource-teardown -- the waiter thread closes the log when the child exits (stop() terminates the child, which unblocks the waiter)
         self._proc = subprocess.Popen(
             entrypoint, shell=True, cwd=cwd, env=env,
             stdout=self._log, stderr=subprocess.STDOUT)
@@ -93,6 +93,9 @@ class JobSupervisor:
                 self._proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 self._proc.kill()
+        # The child is down (or killed): the waiter's wait() returns,
+        # closes the log, and records the final status — reap it.
+        self._waiter.join(timeout=5.0)
         return True
 
     def logs(self, tail_bytes: int = 1 << 20) -> str:

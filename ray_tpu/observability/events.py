@@ -45,7 +45,10 @@ class EventShipper:
         self._interval = (DEFAULT_FLUSH_S if flush_interval_s is None
                           else float(flush_interval_s))
         self._cursor = 0
-        self._flush_lock = threading.Lock()
+        # RLock: stop() pre-acquires with a BOUND so the farewell
+        # flush can't queue forever behind a periodic flush wedged in
+        # a re-dial against a dead head, then calls flush() re-entrant.
+        self._flush_lock = threading.RLock()
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -59,10 +62,16 @@ class EventShipper:
             except Exception:
                 pass  # head briefly unreachable: next interval retries
 
-    def flush(self, timeout: float = 5.0) -> int:
+    def flush(self, timeout: float = 5.0,
+              reconnect: bool = True) -> int:
         """Drain-and-push everything new; returns events shipped.
         Serialized so a manual flush (timeline export) cannot
-        interleave batches with the periodic one."""
+        interleave batches with the periodic one.  ``reconnect=False``
+        ships over the EXISTING head connection only — the on-exit
+        farewell must not spend a full re-dial budget on a head that
+        is already gone."""
+        head = (self._client.head if reconnect
+                else self._client.head._client)
         with self._flush_lock:
             events, self._cursor = _timeline.drain_since(self._cursor)
             shipped = 0
@@ -79,8 +88,13 @@ class EventShipper:
                     "metrics": _metrics.export_state() if last else None,
                     "dropped": _timeline.dropped_events(),
                 }
-                self._client.head.call("push_events", payload,
-                                       timeout=timeout)
+                # The push rides under _flush_lock BY DESIGN: batches
+                # must land at the head in cursor order (a manual flush
+                # interleaving with the periodic one would reorder the
+                # per-node store).  The lock guards only this shipper —
+                # no RPC handler or hot path ever contends on it.
+                head.call("push_events", payload,  # raylint: disable=blocking-under-lock -- dedicated per-shipper lock; in-order batch shipping is the invariant
+                          timeout=timeout)
                 shipped += len(chunk)
                 if last:
                     return shipped
@@ -88,10 +102,18 @@ class EventShipper:
     def stop(self) -> None:
         """Stop the loop and do the on-exit flush (best-effort)."""
         self._stopped.set()
+        self._thread.join(timeout=2.0)
+        if not self._flush_lock.acquire(timeout=2.0):
+            # A periodic flush is wedged mid-re-dial against a dead
+            # head: the farewell batch is lost either way — don't
+            # hold teardown hostage for it.
+            return
         try:
-            self.flush(timeout=2.0)
-        except Exception:
+            self.flush(timeout=2.0, reconnect=False)
+        except Exception:  # raylint: disable=ft-exception-swallow -- on-exit flush is best-effort: losing the last batch must not block teardown
             pass
+        finally:
+            self._flush_lock.release()
 
 
 # --------------------------------------------------------- merged views

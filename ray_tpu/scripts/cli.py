@@ -14,6 +14,7 @@ Commands:
   timeline --address H:P -o trace.json          Chrome-trace export
   memory --address H:P                          object-store stats
   job (submit|status|logs|stop|list) ...        job control
+  lint [PATH] [--json] [--update-baseline]      raylint static analysis
 """
 
 from __future__ import annotations
@@ -288,6 +289,10 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.add_argument("--bytes", type=int, default=64 * 1024)
     p.set_defaults(fn=cmd_logs)
+
+    from ray_tpu.tools.raylint.cli import add_lint_parser
+
+    add_lint_parser(sub)
 
     p = sub.add_parser("job", help="job control")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -51,7 +51,11 @@ class ServeController:
             name_lock = self._deploy_locks.setdefault(
                 name, threading.Lock())
         with name_lock:
-            return self._deploy_locked(name, callable_def, init_args,
+            # Holding the per-NAME lock across the (blocking) rollout
+            # is the invariant: two racing deploys of one deployment
+            # must serialize end to end.  No RPC handler or other
+            # deployment ever contends on this lock.
+            return self._deploy_locked(name, callable_def, init_args,  # raylint: disable=blocking-under-lock -- per-deployment rollout serialization is this lock's purpose
                                        init_kwargs, config)
 
     def _deploy_locked(self, name, callable_def, init_args,
@@ -176,14 +180,21 @@ class ServeController:
             rid = d["next_replica_id"]
             d["next_replica_id"] += 1
         replica = self._construct_replica(name, spec, version, rid)
+        stale = False
         with self._lock:
             d = self._deployments.get(name)
             if d is None or d["version"] != version:
                 # Deleted or redeployed while we were constructing.
-                self._stop_replicas([replica])
-                return None
-            d["replicas"].append(replica)
-            self._bump_membership(name)
+                # The kill RPCs run after the lock drops — stopping a
+                # replica under the controller lock would wedge every
+                # membership poll behind a remote kill.
+                stale = True
+            else:
+                d["replicas"].append(replica)
+                self._bump_membership(name)
+        if stale:
+            self._stop_replicas([replica])
+            return None
         return replica
 
     def _bump_membership(self, name: str):
@@ -351,6 +362,7 @@ class ServeController:
 
     def shutdown(self):
         self._stop.set()
+        self._autoscaler.join(timeout=2.0)
         for name in list(self._deployments):
             self.delete(name)
         return True

@@ -58,6 +58,7 @@ class _Proxy:
     def shutdown(self):
         self.server.shutdown()
         self.server.server_close()
+        self._thread.join(timeout=2.0)
 
 
 _proxy: Optional[_Proxy] = None

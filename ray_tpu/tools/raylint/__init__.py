@@ -1,0 +1,82 @@
+"""raylint — framework-aware static analysis for ray_tpu.
+
+A single AST parse of the whole package feeds rules that enforce the
+distributed-runtime invariants the test suite can only sample:
+
+- ``blocking-under-lock``   deadlock class: RPC/sleep/unbounded waits
+                            reachable while a threading lock is held
+- ``handler-idempotency``   mutating RpcServer handlers must ride
+                            ``_mut``/``idempotent_handler``
+- ``trace-propagation``     bundles carry 'trace', trace params are
+                            used, root ops mint spans
+- ``ft-exception-swallow``  broad excepts must not eat typed FT errors
+- ``resource-teardown``     channels/sockets/servers need a reachable
+                            close on some path
+- ``thread-hygiene``        daemon= required; self-stored threads need
+                            a teardown join
+- ``suppression-syntax``    disables must name real rules + a reason
+
+Suppress a finding in place::
+
+    something_flagged()  # raylint: disable=<rule> -- why it is safe
+
+or grandfather pre-existing debt in ``tools/raylint_baseline.json``
+(regenerate with ``ray_tpu lint --update-baseline``).
+
+Programmatic entry point: :func:`run_lint`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from . import baseline as baseline_mod
+from .model import ProjectModel
+from .rules import RULE_DOCS, RULES, Finding
+
+__all__ = ["run_lint", "default_package_root", "default_baseline_path",
+           "ProjectModel", "Finding", "RULES", "RULE_DOCS"]
+
+
+def default_package_root() -> str:
+    """The installed ray_tpu package directory (what 'ray_tpu lint'
+    analyzes when no path is given)."""
+    import ray_tpu
+
+    return os.path.dirname(os.path.abspath(ray_tpu.__file__))
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    """``tools/raylint_baseline.json`` next to the package dir (the
+    repo layout); callers pass --baseline for anything else."""
+    root = root or default_package_root()
+    return os.path.join(os.path.dirname(root), "tools",
+                        "raylint_baseline.json")
+
+
+def run_lint(root: Optional[str] = None, *,
+             select: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None,
+             use_baseline: bool = True) -> List[Finding]:
+    """Parse ``root`` once, run the selected rules, apply the
+    baseline.  Returns ALL findings — gate on
+    ``[f for f in findings if not f.baselined]``."""
+    root = root or default_package_root()
+    model = ProjectModel(root)
+    rule_names = list(select) if select else list(RULES)
+    unknown = [r for r in rule_names if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+    findings: List[Finding] = []
+    for name in rule_names:
+        findings.extend(RULES[name](model))
+    for relpath, err in model.parse_errors:
+        findings.append(Finding(
+            rule="parse-error", path=relpath, line=1,
+            symbol="<module>", message=f"file failed to parse: {err}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if use_baseline:
+        path = baseline_path or default_baseline_path(root)
+        baseline_mod.apply(findings, baseline_mod.load(path))
+    return findings

@@ -1,0 +1,54 @@
+"""Grandfathering baseline: pre-existing findings recorded by
+fingerprint (rule + path + symbol + message — no line numbers, so
+unrelated edits don't invalidate entries).  The lint gate fails only
+on NON-baselined findings; fixing a baselined one and regenerating
+shrinks the file monotonically."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Set
+
+from .rules import Finding
+
+
+def load(path: str) -> Set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        blob = json.load(f)
+    entries = blob.get("findings", []) if isinstance(blob, dict) else blob
+    out: Set[str] = set()
+    for e in entries:
+        if isinstance(e, str):
+            out.add(e)
+        elif isinstance(e, dict) and "fingerprint" in e:
+            out.add(e["fingerprint"])
+    return out
+
+
+def save(path: str, findings: Iterable[Finding]) -> int:
+    """Write the CURRENT findings as the new baseline (sorted, one
+    readable record per finding).  Returns the entry count."""
+    records: List[Dict] = []
+    seen: Set[str] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.line)):
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        records.append({"rule": f.rule, "path": f.path,
+                        "symbol": f.symbol, "message": f.message,
+                        "fingerprint": f.fingerprint})
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": records}, f, indent=1)
+        f.write("\n")
+    return len(records)
+
+
+def apply(findings: List[Finding], baselined: Set[str]) -> List[Finding]:
+    """Mark findings whose fingerprint is grandfathered."""
+    for f in findings:
+        f.baselined = f.fingerprint in baselined
+    return findings

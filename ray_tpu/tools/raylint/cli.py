@@ -1,0 +1,113 @@
+"""``ray_tpu lint`` — the raylint command-line front end.
+
+Exit codes: 0 = clean (no non-baselined findings), 1 = findings,
+2 = usage error.  ``--json`` emits a machine-readable report for CI
+gating; ``--update-baseline`` grandfathers the current findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from . import (RULE_DOCS, RULES, default_baseline_path,
+               default_package_root, run_lint)
+from . import baseline as baseline_mod
+
+
+def add_lint_parser(sub) -> None:
+    """Attach the ``lint`` subcommand to the ray_tpu CLI subparsers."""
+    p = sub.add_parser(
+        "lint", help="framework-aware static analysis (raylint)")
+    p.add_argument("path", nargs="?", default=None,
+                   help="package dir to analyze (default: the "
+                        "installed ray_tpu package)")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule subset")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: "
+                        "tools/raylint_baseline.json next to the "
+                        "package)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings as failures too")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write the current findings as the new "
+                        "baseline and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable JSON report on stdout")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print grandfathered findings")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.set_defaults(fn=cmd_lint)
+
+
+def cmd_lint(args) -> int:
+    if args.list_rules:
+        for name in RULES:
+            print(f"{name}\n    {RULE_DOCS.get(name, '')}")
+        return 0
+    if args.update_baseline and args.select:
+        # A partial-rule run must never rewrite the whole baseline:
+        # it would silently drop every unselected rule's grandfathered
+        # entries and fail the next full gate.
+        print("raylint: --update-baseline cannot be combined with "
+              "--select (a partial run would drop the other rules' "
+              "baseline entries)", file=sys.stderr)
+        return 2
+    root = args.path or default_package_root()
+    baseline_path = args.baseline or default_baseline_path(root)
+    select = [s.strip() for s in args.select.split(",") if s.strip()]
+    t0 = time.monotonic()
+    try:
+        findings = run_lint(root, select=select or None,
+                            baseline_path=baseline_path,
+                            use_baseline=not (args.no_baseline
+                                              or args.update_baseline))
+    except ValueError as e:
+        print(f"raylint: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - t0
+
+    if args.update_baseline:
+        n = baseline_mod.save(baseline_path, findings)
+        print(f"raylint: baselined {n} finding(s) -> {baseline_path}")
+        return 0
+
+    fresh = [f for f in findings if not f.baselined]
+    old = [f for f in findings if f.baselined]
+    if args.as_json:
+        json.dump({
+            "root": root,
+            "elapsed_s": round(elapsed, 3),
+            "counts": {"new": len(fresh), "baselined": len(old)},
+            "findings": [f.to_dict() for f in findings],
+        }, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 1 if fresh else 0
+
+    for f in fresh:
+        print(f.render())
+    if args.show_baselined:
+        for f in old:
+            print(f"{f.render()}  [baselined]")
+    status = (f"raylint: {len(fresh)} finding(s)"
+              f" ({len(old)} baselined) over {root}"
+              f" in {elapsed:.2f}s")
+    print(status, file=sys.stderr if fresh else sys.stdout)
+    return 1 if fresh else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="raylint")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    add_lint_parser(sub)
+    args = ap.parse_args(["lint"] + list(argv or sys.argv[1:]))
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,446 @@
+"""The raylint project model: ONE parse of the whole package.
+
+Every rule runs against this shared index instead of re-walking files:
+
+- module index: dotted module name -> parsed AST + source lines
+- function table: qualified name ("pkg.mod:Cls.meth") -> FuncInfo
+- class table: lock/condition attributes (assignments of
+  ``threading.Lock/RLock/Condition``), method sets, base names
+- call graph: conservative name-based resolution (self-methods,
+  module-local functions, imported symbols, project classes ->
+  ``__init__``, plus a unique-method-name fallback for cross-class
+  edges) — enough to chase ``blocking-under-lock`` transitively
+- suppressions: ``# raylint: disable=<rule>[,<rule>] -- reason``
+  parsed out of the raw source (AST drops comments)
+
+The model is deliberately approximate where Python is dynamic: rules
+prefer a small number of explainable false positives (silenced with a
+reasoned ``disable``) over silent false negatives in the invariants
+this framework actually depends on.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# disable comment syntax: "raylint: disable=<rules> -- <why>"
+_SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*disable=([a-zA-Z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(?P<reason>\S.*))?\s*$")
+
+_LOCK_FACTORIES = {"Lock", "RLock"}
+_COND_FACTORIES = {"Condition"}
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Set[str]
+    reason: Optional[str]
+    comment_only: bool  # whole line is the comment -> guards line+1
+
+
+@dataclass
+class ModuleInfo:
+    name: str                      # dotted ("ray_tpu.cluster.head")
+    path: str                      # absolute
+    relpath: str                   # project-root relative
+    tree: ast.Module
+    lines: List[str]
+    is_package: bool = False       # an __init__.py (relative imports
+    #                                anchor at the package ITSELF)
+    suppressions: List[Suppression] = field(default_factory=list)
+    imports: Dict[str, str] = field(default_factory=dict)
+    # module-level names bound to threading.Lock()/RLock()/Condition()
+    locks: Set[str] = field(default_factory=set)
+    conds: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ClassInfo:
+    qualname: str                  # "pkg.mod:Cls"
+    module: str
+    name: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, str] = field(default_factory=dict)  # name->func qn
+    lock_attrs: Set[str] = field(default_factory=set)
+    cond_attrs: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class FuncInfo:
+    qualname: str                  # "pkg.mod:Cls.meth" / "pkg.mod:fn"
+    module: str
+    cls: Optional[str]             # enclosing class simple name
+    name: str
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef
+    line: int
+
+
+class ProjectModel:
+    """Parse ``root`` (a package directory) once and index it."""
+
+    def __init__(self, root: str, package: Optional[str] = None):
+        self.root = os.path.abspath(root)
+        self.project_dir = os.path.dirname(self.root) or "."
+        self.package = package or os.path.basename(self.root.rstrip("/"))
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        # bare function/method name -> qualnames defining it
+        self.by_name: Dict[str, List[str]] = {}
+        # call graph: func qualname -> [(callee qualname, line, via)]
+        self.calls: Dict[str, List[Tuple[str, int, str]]] = {}
+        self.parse_errors: List[Tuple[str, str]] = []
+        self._own_cache: Dict[int, List[ast.AST]] = {}
+        self._load()
+        self._index()
+        self._build_call_graph()
+
+    # ------------------------------------------------------------ loading
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__",))
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.project_dir)
+                modname = self._modname(path)
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        src = f.read()
+                    tree = ast.parse(src, filename=path)
+                except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                    self.parse_errors.append((rel, str(e)))
+                    continue
+                info = ModuleInfo(name=modname, path=path, relpath=rel,
+                                  tree=tree, lines=src.splitlines(),
+                                  is_package=fn == "__init__.py")
+                self._scan_suppressions(info)
+                self._scan_imports(info)
+                self.modules[modname] = info
+
+    def _modname(self, path: str) -> str:
+        rel = os.path.relpath(path, os.path.dirname(self.root))
+        rel = rel[:-3] if rel.endswith(".py") else rel
+        parts = rel.split(os.sep)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def _scan_suppressions(self, info: ModuleInfo) -> None:
+        for i, line in enumerate(info.lines, start=1):
+            if "raylint" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            info.suppressions.append(Suppression(
+                line=i, rules=rules, reason=m.group("reason"),
+                comment_only=line.strip().startswith("#")))
+
+    def _scan_imports(self, info: ModuleInfo) -> None:
+        """name -> fully-qualified target ("pkg.mod" or "pkg.mod.sym")."""
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    info.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(info, node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    info.imports[alias.asname or alias.name] = \
+                        f"{base}.{alias.name}"
+
+    def _resolve_from(self, info: ModuleInfo,
+                      node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        parts = info.name.split(".")
+        # "from . import x" in a plain module drops the module's own
+        # leaf; in a package __init__ the single dot IS the package
+        # (its dotted name already lacks the "__init__" leaf), so a
+        # package strips one level fewer.  Each extra dot climbs one
+        # more package either way.
+        drop = node.level - (1 if info.is_package else 0)
+        if drop > len(parts):
+            return None
+        anchor = parts[:-drop] if drop else list(parts)
+        if node.module:
+            anchor = anchor + node.module.split(".")
+        return ".".join(anchor) if anchor else None
+
+    # ----------------------------------------------------------- indexing
+    def _index(self) -> None:
+        for info in self.modules.values():
+            self._index_module_locks(info)
+            for node in info.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._index_class(info, node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    self._index_func(info, node, cls=None)
+
+    def _is_factory(self, info: ModuleInfo, call: ast.AST,
+                    names: Set[str]) -> bool:
+        """``threading.Lock()`` / ``Lock()`` (imported) value?"""
+        if not isinstance(call, ast.Call):
+            return False
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in names and \
+                isinstance(f.value, ast.Name) and \
+                info.imports.get(f.value.id, f.value.id) == "threading":
+            return True
+        if isinstance(f, ast.Name) and f.id in names and \
+                info.imports.get(f.id, "").startswith("threading."):
+            return True
+        return False
+
+    def _index_module_locks(self, info: ModuleInfo) -> None:
+        for node in info.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if self._is_factory(info, node.value, _LOCK_FACTORIES):
+                    info.locks.add(name)
+                elif self._is_factory(info, node.value, _COND_FACTORIES):
+                    info.conds.add(name)
+
+    def _index_class(self, info: ModuleInfo, node: ast.ClassDef) -> None:
+        qn = f"{info.name}:{node.name}"
+        ci = ClassInfo(qualname=qn, module=info.name, name=node.name,
+                       node=node,
+                       bases=[b.id for b in node.bases
+                              if isinstance(b, ast.Name)])
+        self.classes[qn] = ci
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = self._index_func(info, item, cls=node.name)
+                ci.methods[item.name] = fi.qualname
+        # lock attributes: "self.X = threading.Lock()" anywhere in the
+        # class body (usually __init__, but not only)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t = sub.targets[0]
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    if self._is_factory(info, sub.value, _LOCK_FACTORIES):
+                        ci.lock_attrs.add(t.attr)
+                    elif self._is_factory(info, sub.value,
+                                          _COND_FACTORIES):
+                        ci.cond_attrs.add(t.attr)
+
+    def _index_func(self, info: ModuleInfo, node, cls: Optional[str],
+                    prefix: str = "") -> FuncInfo:
+        base = f"{cls}." if cls else ""
+        qn = f"{info.name}:{prefix}{base}{node.name}"
+        fi = FuncInfo(qualname=qn, module=info.name, cls=cls,
+                      name=node.name, node=node, line=node.lineno)
+        self.functions[qn] = fi
+        self.by_name.setdefault(node.name, []).append(qn)
+        # nested defs become their own nodes (resolved by local name)
+        self._index_nested(info, node, cls,
+                           prefix=f"{prefix}{base}{node.name}.")
+        return fi
+
+    def _index_nested(self, info: ModuleInfo, func_node, cls,
+                      prefix) -> None:
+        """Index the defs DIRECTLY nested in ``func_node``; each level
+        recurses with its own prefix, so ``outer.a.helper`` and
+        ``outer.b.helper`` never collide (a collision would silently
+        drop the second body from every rule's scan)."""
+        for sub in self._direct_child_defs(func_node):
+            qn = f"{info.name}:{prefix}{sub.name}"
+            if qn in self.functions:
+                # same name re-bound within one scope (rare):
+                # disambiguate by line rather than drop the body
+                qn = f"{qn}@{sub.lineno}"
+            fi = FuncInfo(qualname=qn, module=info.name, cls=cls,
+                          name=sub.name, node=sub, line=sub.lineno)
+            self.functions[qn] = fi
+            self.by_name.setdefault(sub.name, []).append(qn)
+            self._index_nested(info, sub, cls,
+                               prefix=f"{prefix}{sub.name}.")
+
+    @staticmethod
+    def _direct_child_defs(func_node):
+        """FunctionDefs nested in ``func_node`` without crossing
+        another function boundary (does descend into if/try/with/
+        loops and class bodies)."""
+        out = []
+        stack = list(ast.iter_child_nodes(func_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                out.append(node)
+                continue
+            if isinstance(node, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    # --------------------------------------------------------- call graph
+    def _build_call_graph(self) -> None:
+        for fi in list(self.functions.values()):
+            edges: List[Tuple[str, int, str]] = []
+            info = self.modules[fi.module]
+            for node in self.walk_own(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self._resolve_call(info, fi, node)
+                if target is not None:
+                    edges.append((target, node.lineno,
+                                  call_desc(node)))
+            self.calls[fi.qualname] = edges
+
+    def walk_own(self, func_node):
+        """All nodes of a function body WITHOUT descending into nested
+        function definitions (they execute elsewhere) or lambdas.
+        Cached per node: every rule re-walks every function, and the
+        traversal dominates the whole lint wall-clock otherwise."""
+        cached = self._own_cache.get(id(func_node))
+        if cached is not None:
+            return cached
+        out = []
+        stack = list(ast.iter_child_nodes(func_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        self._own_cache[id(func_node)] = out
+        return out
+
+    def _resolve_call(self, info: ModuleInfo, fi: FuncInfo,
+                      call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self._resolve_name(info, fi, f.id)
+        if isinstance(f, ast.Attribute):
+            # self.method(...)
+            if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and fi.cls is not None:
+                qn = self._method_on(info.name, fi.cls, f.attr)
+                if qn is not None:
+                    return qn
+            # module_alias.func(...)
+            if isinstance(f.value, ast.Name):
+                target = info.imports.get(f.value.id)
+                if target in self.modules:
+                    mod = self.modules[target]
+                    qn = f"{mod.name}:{f.attr}"
+                    if qn in self.functions:
+                        return qn
+            # unique-method fallback: exactly one project definition of
+            # this name -> conservative (class-blind) edge
+            cands = self.by_name.get(f.attr, ())
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _method_on(self, module: str, cls: str,
+                   name: str) -> Optional[str]:
+        """Method lookup on a class, following project-local bases."""
+        seen: Set[str] = set()
+        stack = [f"{module}:{cls}"]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            ci = self.classes.get(key)
+            if ci is None:
+                continue
+            if name in ci.methods:
+                return ci.methods[name]
+            for base in ci.bases:
+                # same module first, else any project class of the name
+                if f"{ci.module}:{base}" in self.classes:
+                    stack.append(f"{ci.module}:{base}")
+                else:
+                    stack.extend(k for k in self.classes
+                                 if k.endswith(f":{base}"))
+        return None
+
+    def _resolve_name(self, info: ModuleInfo, fi: FuncInfo,
+                      name: str) -> Optional[str]:
+        # sibling nested function first (shares the enclosing prefix)
+        prefix = fi.qualname.rsplit(".", 1)[0]
+        for cand in (f"{prefix}.{name}", f"{fi.qualname}.{name}",
+                     f"{info.name}:{name}"):
+            if cand in self.functions:
+                return cand
+        imported = info.imports.get(name)
+        if imported:
+            # imported function...
+            mod, _, sym = imported.rpartition(".")
+            qn = f"{mod}:{sym}"
+            if qn in self.functions:
+                return qn
+            # ...or imported project class -> its __init__
+            ci = self.classes.get(qn)
+            if ci and "__init__" in ci.methods:
+                return ci.methods["__init__"]
+        # class defined in this module -> __init__
+        ci = self.classes.get(f"{info.name}:{name}")
+        if ci and "__init__" in ci.methods:
+            return ci.methods["__init__"]
+        return None
+
+    # --------------------------------------------------------- utilities
+    def lock_context(self, info: ModuleInfo, fi: FuncInfo,
+                     expr: ast.AST) -> Optional[Tuple[str, bool]]:
+        """(lock name, is_condition) when ``expr`` (a with-item) is a
+        known lock/condition object, else None.  Falls back to a name
+        heuristic (``*_lock`` / ``*mutex*`` / ``*_cond``) for locks
+        passed in from elsewhere."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and fi.cls is not None:
+            ci = self.classes.get(f"{fi.module}:{fi.cls}")
+            if ci is not None:
+                if expr.attr in ci.lock_attrs:
+                    return expr.attr, False
+                if expr.attr in ci.cond_attrs:
+                    return expr.attr, True
+            return _lock_by_name(expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in info.locks:
+                return expr.id, False
+            if expr.id in info.conds:
+                return expr.id, True
+            return _lock_by_name(expr.id)
+        return None
+
+
+def _lock_by_name(name: str) -> Optional[Tuple[str, bool]]:
+    low = name.lower()
+    if low.endswith("_cond") or low.endswith("cond"):
+        return name, True
+    if low.endswith("lock") or "mutex" in low:
+        return name, False
+    return None
+
+
+def call_desc(call: ast.Call) -> str:
+    """Short printable form of a call target ("self.head.call")."""
+    try:
+        return ast.unparse(call.func)
+    except Exception:
+        return "<call>"

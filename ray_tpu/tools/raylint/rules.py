@@ -1,0 +1,773 @@
+"""raylint rules: the framework's distributed-runtime invariants.
+
+Each rule is a function ``rule(model) -> List[Finding]`` registered in
+``RULES``.  Findings anchor at a source line; a
+``# raylint: disable=<rule> -- reason`` comment on that line (or a
+comment-only line directly above) suppresses them.  Messages are kept
+line-number-free so baseline fingerprints survive unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .model import FuncInfo, ModuleInfo, ProjectModel, call_desc
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # project-root relative
+    line: int
+    symbol: str        # enclosing function/class qualname (or module)
+    message: str
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        # line numbers deliberately excluded: a baseline entry must
+        # survive unrelated edits shifting the file
+        return f"{self.rule}::{self.path}::{self.symbol}::{self.message}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.symbol}: {self.message}")
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "baselined": self.baselined,
+                "fingerprint": self.fingerprint}
+
+
+def _suppressed(info: ModuleInfo, rule: str, line: int) -> bool:
+    for s in info.suppressions:
+        if s.reason is None:
+            continue  # reasonless disables are invalid (see rule below)
+        if rule not in s.rules and "all" not in s.rules:
+            continue
+        if s.line == line or (s.comment_only and s.line == line - 1):
+            return True
+    return False
+
+
+class _Collector:
+    def __init__(self, model: ProjectModel, rule: str):
+        self.model = model
+        self.rule = rule
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, int, str]] = set()
+
+    def add(self, info: ModuleInfo, line: int, symbol: str,
+            message: str) -> None:
+        if _suppressed(info, self.rule, line):
+            return
+        key = (info.relpath, line, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(
+            rule=self.rule, path=info.relpath, line=line,
+            symbol=symbol, message=message))
+
+
+# --------------------------------------------------------------------------
+# shared helpers
+# --------------------------------------------------------------------------
+
+_RPC_BLOCKING_ATTRS = {"call", "call_with_retry", "call_retry",
+                       "call_idempotent"}
+_SOCKET_BLOCKING_ATTRS = {"recv", "recv_into", "accept"}
+# attr calls that block FOREVER unless given a timeout argument
+_NEEDS_TIMEOUT_ATTRS = {"result", "wait", "join", "acquire", "get"}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return True  # positional timeout (result(t), wait(t), get(block,t))
+    return any(kw.arg in ("timeout", "block", "blocking", "timeout_s")
+               for kw in call.keywords)
+
+
+def _blocking_desc(info: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """Classify a call site as a DIRECT blocking operation, or None.
+    RPC calls count even when bounded by a timeout (a bounded stall
+    under a lock still wedges every other holder for the duration);
+    generic waits count only when unbounded."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _RPC_BLOCKING_ATTRS:
+            return f"rpc {call_desc(call)}(...)"
+        if f.attr == "sleep" and isinstance(f.value, ast.Name) and \
+                info.imports.get(f.value.id, f.value.id) == "time":
+            return "time.sleep(...)"
+        if f.attr in _SOCKET_BLOCKING_ATTRS:
+            return f"socket {call_desc(call)}(...)"
+        if f.attr == "create_connection" and not _has_timeout(call):
+            return f"socket {call_desc(call)}(...) without timeout"
+        if f.attr in _NEEDS_TIMEOUT_ATTRS and not _has_timeout(call):
+            if f.attr == "get" and call.keywords:
+                return None  # dict-style .get(default=...) etc.
+            return f"un-timeouted {call_desc(call)}()"
+    elif isinstance(f, ast.Name):
+        if f.id == "retry_call":
+            return "rpc retry_call(...)"
+        if f.id == "sleep" and info.imports.get(f.id, "") == "time.sleep":
+            return "time.sleep(...)"
+    return None
+
+
+def _expr_eq(a: ast.AST, b: ast.AST) -> bool:
+    try:
+        return ast.dump(a) == ast.dump(b)
+    except Exception:
+        return False
+
+
+def _walk_region(stmts: Sequence[ast.stmt]):
+    """Walk statements without descending into nested defs/lambdas
+    (their bodies run elsewhere; calls TO them resolve via the graph)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# rule: blocking-under-lock
+# --------------------------------------------------------------------------
+
+_TRANSITIVE_DEPTH = 4
+
+
+def _blocking_summary(model: ProjectModel,
+                      memo: Dict[Tuple[str, int],
+                                 Optional[List[str]]],
+                      qn: str, depth: int) -> Optional[List[str]]:
+    """A call chain from ``qn`` to a direct blocking op (as printable
+    hops), or None.  Depth-limited and memoized BY (qn, depth): a
+    None computed with the budget nearly exhausted must not shadow a
+    full-depth query from another lock region (that would silently
+    drop real deadlock findings)."""
+    key = (qn, depth)
+    if key in memo:
+        return memo[key]
+    memo[key] = None
+    fi = model.functions.get(qn)
+    if fi is None:
+        return None
+    info = model.modules[fi.module]
+    for node in model.walk_own(fi.node):
+        if isinstance(node, ast.Call):
+            desc = _blocking_desc(info, node)
+            if desc is not None:
+                memo[key] = [f"{desc} at {info.relpath}"]
+                return memo[key]
+    if depth <= 0:
+        return None
+    for callee, _line, via in model.calls.get(qn, ()):
+        if callee == qn:
+            continue
+        chain = _blocking_summary(model, memo, callee, depth - 1)
+        if chain is not None:
+            memo[key] = [f"{via}()"] + chain
+            return memo[key]
+    return None
+
+
+def rule_blocking_under_lock(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "blocking-under-lock")
+    memo: Dict[Tuple[str, int], Optional[List[str]]] = {}
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                lock = model.lock_context(info, fi, item.context_expr)
+                if lock is None:
+                    continue
+                _scan_lock_region(model, out, memo, info, fi,
+                                  lock, item.context_expr, node.body)
+                break  # one finding set per with-statement
+    return out.findings
+
+
+def _scan_lock_region(model: ProjectModel, out: _Collector, memo,
+                      info: ModuleInfo, fi: FuncInfo,
+                      lock: Tuple[str, bool], lock_expr: ast.AST,
+                      body: Sequence[ast.stmt]) -> None:
+    lock_name, is_cond = lock
+    for node in _walk_region(body):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # a Condition's own wait() RELEASES the lock while waiting:
+        # that is the one legitimate blocking call inside its region
+        if is_cond and isinstance(f, ast.Attribute) and \
+                f.attr == "wait" and _expr_eq(f.value, lock_expr):
+            continue
+        desc = _blocking_desc(info, node)
+        if desc is not None:
+            out.add(info, node.lineno, fi.qualname,
+                    f"{desc} while holding {lock_name!r}")
+            continue
+        target = model._resolve_call(info, fi, node)
+        if target is None:
+            continue
+        chain = _blocking_summary(model, memo, target,
+                                  _TRANSITIVE_DEPTH)
+        if chain is not None:
+            path = " -> ".join([f"{call_desc(node)}()"] + chain)
+            out.add(info, node.lineno, fi.qualname,
+                    f"call reaches a blocking op while holding "
+                    f"{lock_name!r}: {path}")
+
+
+# --------------------------------------------------------------------------
+# rule: handler-idempotency
+# --------------------------------------------------------------------------
+
+_MUTATING_HANDLER_RE = re.compile(
+    r"^(register|remove|create|drain|kill)_|(_put|_del)$")
+_IDEM_WRAPPERS = {"_mut", "idempotent_handler"}
+
+
+def _is_wrapped(value: ast.AST) -> bool:
+    if isinstance(value, ast.Call):
+        f = value.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        return name in _IDEM_WRAPPERS
+    return False
+
+
+def rule_handler_idempotency(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "handler-idempotency")
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name == "RpcServer" and node.args and \
+                    isinstance(node.args[0], ast.Dict):
+                table = node.args[0]
+                for key, value in zip(table.keys, table.values):
+                    if not (isinstance(key, ast.Constant)
+                            and isinstance(key.value, str)):
+                        continue
+                    hname = key.value
+                    if _MUTATING_HANDLER_RE.search(hname) and \
+                            not _is_wrapped(value):
+                        out.add(info, key.lineno, fi.qualname,
+                                f"mutating handler {hname!r} "
+                                f"registered without _mut/"
+                                f"idempotent_handler")
+            elif name == "add_handler" and len(node.args) >= 2 and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                hname = node.args[0].value
+                if _MUTATING_HANDLER_RE.search(hname) and \
+                        not _is_wrapped(node.args[1]):
+                    out.add(info, node.lineno, fi.qualname,
+                            f"mutating handler {hname!r} added "
+                            f"without _mut/idempotent_handler")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: trace-propagation
+# --------------------------------------------------------------------------
+
+# driver-side ROOT operations that must mint a span (module suffix,
+# function name) — the entry points of PR-3's tracing plane
+_ROOT_OPS = (
+    ("dag.compiled", "execute"),
+    ("serve.handle", "remote"),
+    ("train.cross_pipeline", "train_step"),
+)
+_BUNDLE_MARKER_KEYS = {"owner"}
+_BUNDLE_PAYLOAD_KEYS = {"args", "function", "method", "actor_id"}
+
+
+def _uses_span(model: ProjectModel, fi: FuncInfo, depth: int = 1) -> bool:
+    info = model.modules[fi.module]
+    for node in model.walk_own(fi.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "span":
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                info.imports.get(node.func.id, "").endswith(
+                    "tracing.span"):
+            return True
+    if depth > 0:
+        for callee, _l, _v in model.calls.get(fi.qualname, ()):
+            sub = model.functions.get(callee)
+            if sub is not None and _uses_span(model, sub, depth - 1):
+                return True
+    return False
+
+
+def rule_trace_propagation(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "trace-propagation")
+    # (a) task/actor wire bundles must carry the trace context
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if isinstance(node, ast.Dict):
+                keys = {k.value for k in node.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+                if _BUNDLE_MARKER_KEYS <= keys and \
+                        keys & _BUNDLE_PAYLOAD_KEYS and \
+                        "trace" not in keys:
+                    out.add(info, node.lineno, fi.qualname,
+                            "task bundle ships without a 'trace' "
+                            "field (context lost across the hop)")
+        # (b) a 'trace' parameter that is never read is dropped context
+        fnode = fi.node
+        argnames = {a.arg for a in (
+            list(fnode.args.posonlyargs) + list(fnode.args.args) +
+            list(fnode.args.kwonlyargs))}
+        for tname in ("trace", "trace_ctx"):
+            if tname not in argnames:
+                continue
+            # Full walk (NOT walk_own): a closure/callback capturing
+            # the trace param IS propagation — the common call_async
+            # callback shape must not be flagged.
+            used = any(isinstance(n, ast.Name) and n.id == tname
+                       for n in ast.walk(fnode)
+                       if n is not fnode)
+            if not used:
+                out.add(info, fnode.lineno, fi.qualname,
+                        f"parameter {tname!r} accepted but never "
+                        f"propagated (scope_from / envelope)")
+    # (c) root ops must mint a driver-side span
+    for suffix, fname in _ROOT_OPS:
+        for qn in model.by_name.get(fname, ()):
+            fi = model.functions[qn]
+            if not fi.module.endswith(suffix):
+                continue
+            if not _uses_span(model, fi):
+                info = model.modules[fi.module]
+                out.add(info, fi.line, fi.qualname,
+                        f"driver-side root op {fname!r} does not mint "
+                        f"a tracing span")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: ft-exception-swallow
+# --------------------------------------------------------------------------
+
+_FT_TYPES = {"ActorError", "ActorDiedError", "ActorUnavailableError",
+             "ChannelError", "ObjectLostError", "OwnerDiedError",
+             "RayTpuError", "TaskError"}
+# calls in a try body that can surface FT errors (RPC results re-raise
+# server-shipped exceptions; channel reads raise typed FT errors)
+_FT_CAPABLE_ATTRS = {"call", "call_async", "call_with_retry",
+                     "call_retry", "call_idempotent", "result",
+                     "get_value", "put_value", "wait_and_get",
+                     "submit_task", "submit_actor_task", "get_buffer",
+                     "finish"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _catches_ft(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names: List[str] = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Attribute):
+        names = [t.attr]
+    return bool(set(names) & _FT_TYPES)
+
+
+def _silently_swallows(handler: ast.ExceptHandler) -> bool:
+    """No re-raise, no logging/cleanup call, exception object unused:
+    the failure vanishes."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call) and node is not handler.type:
+            return False  # logging / cleanup / error-storing call
+        if handler.name and isinstance(node, ast.Name) and \
+                node.id == handler.name:
+            return False  # the error object is USED somehow
+    return True
+
+
+def rule_ft_exception_swallow(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "ft-exception-swallow")
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Try):
+                continue
+            ft_capable = any(
+                isinstance(c, ast.Call)
+                and ((isinstance(c.func, ast.Attribute)
+                      and c.func.attr in _FT_CAPABLE_ATTRS)
+                     or (isinstance(c.func, ast.Name)
+                         and c.func.id == "retry_call"))
+                for c in _walk_region(node.body))
+            if not ft_capable:
+                continue
+            ft_handled_earlier = False
+            for handler in node.handlers:
+                if _catches_ft(handler):
+                    ft_handled_earlier = True
+                    continue
+                if not _is_broad(handler):
+                    continue
+                if ft_handled_earlier:
+                    continue  # FT types peeled off by a prior clause
+                if _silently_swallows(handler):
+                    out.add(info, handler.lineno, fi.qualname,
+                            "broad except silently swallows a call "
+                            "that can raise FT errors (ActorError/"
+                            "ChannelError/ObjectLostError)")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: resource-teardown
+# --------------------------------------------------------------------------
+
+_RESOURCE_NAMES = {"RpcServer", "RpcClient", "ReconnectingClient",
+                   "ObjectStreamServer", "Channel", "ClientPool",
+                   "EventShipper"}
+_RESOURCE_ATTR_CALLS = {("socket", "socket"),
+                        ("socket", "create_connection"),
+                        ("_socket", "socket"),
+                        ("_socket", "create_connection")}
+_TEARDOWN_VERBS = {"close", "close_all", "shutdown", "destroy",
+                   "detach", "disconnect", "stop", "terminate",
+                   "abort", "unlink", "release", "kill", "join"}
+
+
+def _resource_ctor(info: ModuleInfo, call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in _RESOURCE_NAMES:
+            return f.id
+        if f.id == "open":
+            return "open"
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if (f.value.id, f.attr) in _RESOURCE_ATTR_CALLS:
+            return f"{f.value.id}.{f.attr}"
+        if f.attr in _RESOURCE_NAMES and f.attr == "Channel":
+            return "Channel"
+    return None
+
+
+def _class_tears_down(model: ProjectModel, fi: FuncInfo,
+                      attr: str) -> bool:
+    """Does some teardown-verb method of the class reference self.attr?"""
+    if fi.cls is None:
+        return False
+    ci = model.classes.get(f"{fi.module}:{fi.cls}")
+    if ci is None:
+        return False
+    for mname, mqn in ci.methods.items():
+        if mname not in _TEARDOWN_VERBS and \
+                not mname.startswith(("close", "shutdown", "stop",
+                                      "disconnect", "tear", "__exit__",
+                                      "__del__")):
+            continue
+        mnode = model.functions[mqn].node
+        for node in ast.walk(mnode):
+            if isinstance(node, ast.Attribute) and node.attr == attr \
+                    and isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return True
+    return False
+
+
+def _local_released(model: ProjectModel, fi: FuncInfo,
+                    name: str, after_line: int) -> bool:
+    """Within the function: is local ``name`` closed on some path, or
+    does it escape (returned / yielded / stored / passed along)?"""
+    for node in model.walk_own(fi.node):
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _TEARDOWN_VERBS and \
+                    isinstance(f.value, ast.Name) and f.value.id == name:
+                return True
+            # passed as a (possibly nested) argument -> escapes
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(arg)):
+                    if line >= after_line:
+                        return True
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = node.value
+            if v is not None and any(
+                    isinstance(n, ast.Name) and n.id == name
+                    for n in ast.walk(v)):
+                return True
+        elif isinstance(node, ast.Assign) and line > after_line:
+            # stored into an attribute / container -> escapes
+            if any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(node.value)) and any(
+                    not isinstance(t, ast.Name) for t in node.targets):
+                return True
+    return False
+
+
+def rule_resource_teardown(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "resource-teardown")
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        with_ctx_calls: Set[int] = set()
+        for node in model.walk_own(fi.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        with_ctx_calls.add(id(item.context_expr))
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            res = _resource_ctor(info, node.value)
+            if res is None or id(node.value) in with_ctx_calls:
+                continue
+            if len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                if not _class_tears_down(model, fi, target.attr):
+                    out.add(info, node.lineno, fi.qualname,
+                            f"{res} stored on self.{target.attr} but "
+                            f"no teardown method of the class "
+                            f"closes it")
+            elif isinstance(target, ast.Name):
+                if not _local_released(model, fi, target.id,
+                                       node.lineno):
+                    out.add(info, node.lineno, fi.qualname,
+                            f"{res} bound to local {target.id!r} is "
+                            f"neither closed nor escapes this "
+                            f"function")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: thread-hygiene
+# --------------------------------------------------------------------------
+
+def _is_thread_ctor(info: ModuleInfo, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" and \
+            isinstance(f.value, ast.Name) and \
+            info.imports.get(f.value.id, f.value.id) == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Thread" and \
+        info.imports.get("Thread", "") == "threading.Thread"
+
+
+def _attr_joined(model: ProjectModel, fi: FuncInfo, attr: str) -> bool:
+    if fi.cls is None:
+        return False
+    ci = model.classes.get(f"{fi.module}:{fi.cls}")
+    if ci is None:
+        return False
+    for mqn in ci.methods.values():
+        mnode = model.functions[mqn].node
+        has_join = False
+        aliases_attr = False
+        for node in ast.walk(mnode):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join":
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute) and \
+                        recv.attr == attr and \
+                        isinstance(recv.value, ast.Name) and \
+                        recv.value.id == "self":
+                    return True
+                has_join = True
+            # defensive alias: t = getattr(self, "<attr>", None)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "getattr" and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) and \
+                    node.args[1].value == attr:
+                aliases_attr = True
+        if has_join and aliases_attr:
+            return True
+    return False
+
+
+def _local_name_joined(model: ProjectModel, fi: FuncInfo,
+                       name: str) -> bool:
+    """``name.join(...)`` anywhere in the same function."""
+    for node in model.walk_own(fi.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == name:
+            return True
+    return False
+
+
+def rule_thread_hygiene(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "thread-hygiene")
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        # bind each ctor call to its assignment target (if any) first,
+        # so the bare-Call walk below doesn't re-report assigned ones
+        assigned: Dict[int, Optional[ast.AST]] = {}
+        for node in model.walk_own(fi.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_thread_ctor(info, node.value):
+                assigned[id(node.value)] = node.targets[0] \
+                    if len(node.targets) == 1 else None
+        for node in model.walk_own(fi.node):
+            if not (isinstance(node, ast.Call)
+                    and _is_thread_ctor(info, node)):
+                continue
+            ctor = node
+            target = assigned.get(id(node))
+            # daemon must be TRUTHY: an explicit daemon=False is the
+            # same interpreter-exit blocker as no daemon at all.  A
+            # non-constant expression is assumed intentional.
+            daemon_true = any(
+                kw.arg == "daemon"
+                and (not isinstance(kw.value, ast.Constant)
+                     or bool(kw.value.value))
+                for kw in ctor.keywords)
+            if not daemon_true:
+                # A non-daemon thread is fine IF some path joins it.
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self" and \
+                        _attr_joined(model, fi, target.attr):
+                    continue
+                if isinstance(target, ast.Name) and \
+                        _local_name_joined(model, fi, target.id):
+                    continue
+                out.add(info, ctor.lineno, fi.qualname,
+                        "threading.Thread without daemon=True or a "
+                        "join (a non-daemon leak blocks interpreter "
+                        "exit)")
+                continue
+            # stored on self => long-lived: teardown must join it
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                if not _attr_joined(model, fi, target.attr):
+                    out.add(info, ctor.lineno, fi.qualname,
+                            f"long-lived thread self.{target.attr} "
+                            f"has no join on any teardown path")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: suppression-syntax (meta): disables must carry a reason and
+# name real rules — a typo'd disable that silently fails to suppress
+# (or a reasonless one) is itself a finding
+# --------------------------------------------------------------------------
+
+def rule_suppression_syntax(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "suppression-syntax")
+    known = set(RULES) | {"all"}
+    for info in model.modules.values():
+        for s in info.suppressions:
+            if s.reason is None:
+                out.add(info, s.line, info.name,
+                        "raylint disable without a '-- reason' "
+                        "(suppression ignored)")
+            for r in s.rules - known:
+                out.add(info, s.line, info.name,
+                        f"raylint disable names unknown rule {r!r}")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+RULES = {
+    "blocking-under-lock": rule_blocking_under_lock,
+    "handler-idempotency": rule_handler_idempotency,
+    "trace-propagation": rule_trace_propagation,
+    "ft-exception-swallow": rule_ft_exception_swallow,
+    "resource-teardown": rule_resource_teardown,
+    "thread-hygiene": rule_thread_hygiene,
+    "suppression-syntax": rule_suppression_syntax,
+}
+
+RULE_DOCS = {
+    "blocking-under-lock": (
+        "Blocking operations (RPC call/retry, socket recv/accept, "
+        "time.sleep, un-timeouted wait/get/acquire/join/result) "
+        "executed — directly or through the call graph — while a "
+        "threading.Lock/RLock is held.  The framework's deadlock "
+        "class: one stalled RPC under a hot lock wedges every other "
+        "holder."),
+    "handler-idempotency": (
+        "Mutating handlers (register_*/remove_*/create_*/drain_*/"
+        "kill_*/*_put/*_del) in an RpcServer table must be wrapped in "
+        "_mut/idempotent_handler so client retries after a lost "
+        "response replay the first reply instead of re-applying."),
+    "trace-propagation": (
+        "Task bundles must carry the 'trace' field, accepted trace "
+        "parameters must be propagated (tracing.scope_from), and "
+        "driver-side root ops (dag execute, serve handle.remote, "
+        "train_step) must mint a span — otherwise the merged cluster "
+        "timeline loses the hop."),
+    "ft-exception-swallow": (
+        "A broad except around FT-capable calls (RPC results re-raise "
+        "server-shipped errors; channel reads raise typed FT errors) "
+        "that neither re-raises, uses, nor logs the error silently "
+        "eats ActorError/ChannelError/ObjectLostError — the recovery "
+        "paths keyed on those types never fire."),
+    "resource-teardown": (
+        "Channels, sockets, RPC servers/clients and open files must "
+        "be closed on some path: self-stored resources need a "
+        "teardown method that closes them; locals must be closed, "
+        "returned, stored, or passed onward."),
+    "thread-hygiene": (
+        "threading.Thread needs daemon= (non-daemon leaks block "
+        "interpreter exit), and a thread stored on self is long-lived "
+        "infrastructure: some teardown path must join it."),
+    "suppression-syntax": (
+        "raylint disables must name real rules and carry a "
+        "'-- reason'; a reasonless or typo'd disable does not "
+        "suppress anything."),
+}

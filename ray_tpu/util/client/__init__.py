@@ -148,7 +148,7 @@ class ClientContext:
                 self._rpc.call("client_ping",
                                dumps({"session": self._session}),
                                timeout=30.0)
-            except Exception:
+            except Exception:  # raylint: disable=ft-exception-swallow -- the keepalive loop must survive ANY ping failure (incl. server-shipped errors): if this thread dies, the proxy TTL-reaps the session out from under a live client
                 pass
 
     def _call(self, method: str, payload: dict,

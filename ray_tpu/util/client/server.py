@@ -54,7 +54,7 @@ class ClientProxyServer:
             "client_connect": _sealed(self._connect),
             "client_disconnect": _sealed(self._disconnect),
             "client_ping": _sealed(self._ping),
-            "client_put": _sealed(self._put),
+            "client_put": _sealed(self._put),  # raylint: disable=handler-idempotency -- thin clients call single-shot (no retry wrapper); a duplicate put would only mint an extra token
             "client_get": _sealed(self._get),
             "client_wait": _sealed(self._wait),
             "client_task": _sealed(self._task),
@@ -198,13 +198,16 @@ class ClientProxyServer:
         token = uuid.uuid4().hex
         with self._lock:
             actors = self._actors.get(p["session"])
-            if actors is None:
-                # Raced a disconnect: don't leak a running actor.
-                ray_tpu.kill(handle)
-                raise ValueError(
-                    f"client session {p['session']!r} is gone")
-            actors[token] = handle
-            self._touch_locked(p)
+            if actors is not None:
+                actors[token] = handle
+                self._touch_locked(p)
+        if actors is None:
+            # Raced a disconnect: don't leak a running actor.  The
+            # kill (a head RPC) runs after the proxy lock drops so
+            # every other session isn't wedged behind it.
+            ray_tpu.kill(handle)
+            raise ValueError(
+                f"client session {p['session']!r} is gone")
         return {"actor": token}
 
     def _actor_call(self, p):

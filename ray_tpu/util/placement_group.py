@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..cluster.rpc import TRANSPORT_ERRORS
 from ..core.ids import PlacementGroupID
 from ..core.runtime import get_runtime
 from ..core.task_spec import PlacementGroupSchedulingStrategy  # re-export
@@ -211,7 +212,7 @@ def _reserve_cluster(rt, pg: PlacementGroup) -> None:
             r = rt.cluster.pool.get(addr).call(
                 "add_pg_capacity",
                 {"pg_id": pg.id.hex(), "bundles": bundles}, timeout=60.0)
-        except Exception:
+        except Exception:  # raylint: disable=ft-exception-swallow -- any mint failure (transport or node-side) routes to the same rollback below
             r = {"ok": False}
         if not r.get("ok"):
             for done in minted:  # roll back nodes already minted
@@ -220,8 +221,8 @@ def _reserve_cluster(rt, pg: PlacementGroup) -> None:
                         "remove_pg_capacity",
                         {"pg_id": pg.id.hex(),
                          "bundles": by_addr[done]}, timeout=30.0)
-                except Exception:
-                    pass
+                except TRANSPORT_ERRORS:
+                    pass  # rollback target died: its capacity died too
             rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
             return
         minted.append(addr)
@@ -238,12 +239,12 @@ def _reserve_cluster(rt, pg: PlacementGroup) -> None:
                 "remove_pg_capacity",
                 {"pg_id": pg.id.hex(), "bundles": bundles},
                 timeout=30.0)
-        except Exception:
-            pass
+        except TRANSPORT_ERRORS:
+            pass  # node gone: nothing left to unmint
     try:
         rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
-    except Exception:
-        pass
+    except TRANSPORT_ERRORS:
+        pass  # head unreachable: the PG table entry dies with it
 
 
 def get_placement_group_by_id(pg_id: PlacementGroupID) -> PlacementGroup:
@@ -272,12 +273,12 @@ def remove_placement_group(pg: PlacementGroup):
                         "remove_pg_capacity",
                         {"pg_id": pg.id.hex(), "bundles": bundles},
                         timeout=30.0)
-                except Exception:
-                    pass
+                except TRANSPORT_ERRORS:
+                    pass  # node gone: nothing left to unmint
             try:
                 rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
-            except Exception:
-                pass
+            except TRANSPORT_ERRORS:
+                pass  # head unreachable: the PG table entry dies with it
         else:
             rt.node_resources.remove_capacity(pg.synthetic_capacity())
             total: Dict[str, float] = {}

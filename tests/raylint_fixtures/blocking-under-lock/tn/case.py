@@ -1,0 +1,34 @@
+"""True negatives: the lock is dropped before blocking, waits are
+bounded, and a Condition's own wait releases its lock."""
+
+import threading
+import time
+
+
+class Worker:
+    def __init__(self, head):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.head = head
+
+    def blocking_outside_lock(self):
+        with self._lock:
+            snapshot = dict(vars(self))
+        time.sleep(0.01)
+        return self.head.call("place", snapshot)
+
+    def condition_wait(self):
+        with self._cond:
+            while not getattr(self, "ready", False):
+                self._cond.wait()  # releases the lock while waiting
+
+    def bounded_wait(self, ev):
+        with self._lock:
+            ev.wait(1.0)  # bounded: acceptable under a lock
+
+    def _pure_helper(self, items):
+        return sorted(items)
+
+    def nonblocking_call_under_lock(self):
+        with self._lock:
+            return self._pure_helper([3, 1, 2])

@@ -1,0 +1,51 @@
+"""True positives: blocking ops under a held lock — direct and
+transitive."""
+
+import threading
+import time
+
+
+class Worker:
+    def __init__(self, head):
+        self._lock = threading.Lock()
+        self.head = head
+
+    def sleeps_under_lock(self):
+        with self._lock:
+            time.sleep(1.0)  # direct: time.sleep
+
+    def rpc_under_lock(self):
+        with self._lock:
+            return self.head.call("place", {})  # direct: bounded RPC
+
+    def unbounded_wait_under_lock(self, ev):
+        with self._lock:
+            ev.wait()  # direct: no timeout
+
+    def _helper(self):
+        time.sleep(0.5)
+
+    def transitive_under_lock(self):
+        with self._lock:
+            self._helper()  # transitive: helper sleeps
+
+
+_mod_lock = threading.Lock()
+
+
+def outer():
+    # Two same-named nested helpers: the SECOND one's body must still
+    # be indexed and scanned (a qualname collision dropping it would
+    # hide the sleep-under-lock below).
+    def a():
+        def helper():
+            return 1
+        return helper()
+
+    def b():
+        def helper():
+            with _mod_lock:
+                time.sleep(5.0)
+        return helper()
+
+    return a() + b()

@@ -1,0 +1,49 @@
+"""True negatives: typed transport catches, FT types peeled off first,
+broad handlers that log or re-raise, and non-FT try bodies."""
+
+import traceback
+
+
+class ActorError(Exception):
+    pass
+
+
+class ChannelError(Exception):
+    pass
+
+
+class Caller:
+    def __init__(self, head):
+        self.head = head
+
+    def typed_catch(self):
+        try:
+            self.head.call("remove_actor", {"actor_id": b"x"})
+        except (ConnectionError, TimeoutError, OSError):
+            pass
+
+    def ft_peeled_first(self, reader):
+        try:
+            return reader.get_value()
+        except (ActorError, ChannelError):
+            raise
+        except Exception:
+            return None
+
+    def broad_but_logs(self):
+        try:
+            self.head.call("ping", {})
+        except Exception:
+            traceback.print_exc()
+
+    def broad_but_uses(self, sink):
+        try:
+            self.head.call("ping", {})
+        except Exception as e:
+            sink.record(e)
+
+    def broad_over_pure_code(self, blob):
+        try:
+            return blob.decode("utf-8")
+        except Exception:
+            return ""

@@ -1,0 +1,19 @@
+"""True positive: a broad except silently eats an RPC result that can
+carry typed FT errors."""
+
+
+class Caller:
+    def __init__(self, head):
+        self.head = head
+
+    def fire(self):
+        try:
+            self.head.call("remove_actor", {"actor_id": b"x"})
+        except Exception:
+            pass
+
+    def fire_and_default(self, reader):
+        try:
+            return reader.get_value()
+        except Exception:
+            return None

@@ -1,0 +1,41 @@
+"""True negative: every mutating handler rides _mut/idempotent_handler;
+read-only handlers stay raw."""
+
+
+def idempotent_handler(fn, cache):
+    return fn
+
+
+class RpcServer:
+    def __init__(self, handlers, host="127.0.0.1", port=0):
+        self.handlers = handlers
+
+    def add_handler(self, method, fn):
+        self.handlers[method] = fn
+
+
+class Head:
+    def __init__(self):
+        self._idem = object()
+
+    def _register_node(self, p):
+        return {"ok": True}
+
+    def _kv_put(self, p):
+        return {"ok": True}
+
+    def _list_nodes(self, p):
+        return []
+
+    def build(self):
+        def _mut(fn):
+            return idempotent_handler(fn, self._idem)
+
+        server = RpcServer({
+            "register_node": _mut(self._register_node),
+            "kv_put": _mut(self._kv_put),
+            "list_nodes": self._list_nodes,
+            "heartbeat": self._list_nodes,
+        })
+        server.add_handler("remove_actor", _mut(self._register_node))
+        return server

@@ -1,0 +1,30 @@
+"""True positive: mutating handlers registered raw in an RpcServer
+table (a retried register after a lost response double-applies)."""
+
+
+class RpcServer:
+    def __init__(self, handlers, host="127.0.0.1", port=0):
+        self.handlers = handlers
+
+    def add_handler(self, method, fn):
+        self.handlers[method] = fn
+
+
+class Head:
+    def _register_node(self, p):
+        return {"ok": True}
+
+    def _kv_put(self, p):
+        return {"ok": True}
+
+    def _list_nodes(self, p):
+        return []
+
+    def build(self):
+        server = RpcServer({
+            "register_node": self._register_node,
+            "kv_put": self._kv_put,
+            "list_nodes": self._list_nodes,
+        })
+        server.add_handler("remove_actor", self._register_node)
+        return server

@@ -1,0 +1,44 @@
+"""True negatives: teardown method closes the stored server; locals
+are closed, context-managed, returned, or passed onward."""
+
+import socket
+
+
+class RpcServer:
+    def __init__(self, handlers):
+        self.handlers = handlers
+
+    def shutdown(self):
+        pass
+
+
+class Node:
+    def __init__(self):
+        self._server = RpcServer({})
+
+    def shutdown(self):
+        self._server.shutdown()
+
+
+def probe(host, port):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.connect((host, port))
+        return True
+    finally:
+        sock.close()
+
+
+def read_config(path):
+    with open(path) as f:
+        return f.read()
+
+
+def make_server():
+    server = RpcServer({})
+    return server  # escapes to the caller, which owns teardown
+
+
+def register(pool, host, port):
+    conn = socket.create_connection((host, port), timeout=5.0)
+    pool.adopt(conn)  # escapes into the pool

@@ -1,0 +1,26 @@
+"""True positives: a self-stored server with no teardown path, and a
+local socket that never closes or escapes."""
+
+import socket
+
+
+class RpcServer:
+    def __init__(self, handlers):
+        self.handlers = handlers
+
+    def shutdown(self):
+        pass
+
+
+class Node:
+    def __init__(self):
+        self._server = RpcServer({})
+
+    def describe(self):
+        return "node"  # no method of this class ever closes _server
+
+
+def probe(host, port):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.connect((host, port))
+    return True  # leaked: never closed, never escapes

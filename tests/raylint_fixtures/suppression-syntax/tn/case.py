@@ -1,0 +1,21 @@
+"""True negative: a well-formed reasoned disable — it suppresses the
+finding on its line and raises no syntax finding.  Also the
+comment-above form guarding the next line."""
+
+
+class Caller:
+    def __init__(self, head):
+        self.head = head
+
+    def fire(self):
+        try:
+            self.head.call("remove_actor", {})
+        except Exception:  # raylint: disable=ft-exception-swallow -- fire-and-forget cleanup; a dead target needs no removal
+            pass
+
+    def fire2(self):
+        try:
+            self.head.call("remove_actor", {})
+        # raylint: disable=ft-exception-swallow -- comment-above form guards the handler below
+        except Exception:
+            pass

@@ -1,0 +1,19 @@
+"""True positives: a reasonless disable (which therefore does NOT
+suppress) and a disable naming an unknown rule."""
+
+
+class Caller:
+    def __init__(self, head):
+        self.head = head
+
+    def fire(self):
+        try:
+            self.head.call("remove_actor", {})
+        except Exception:  # raylint: disable=ft-exception-swallow
+            pass
+
+    def fire2(self):
+        try:
+            self.head.call("remove_actor", {})
+        except Exception:  # raylint: disable=no-such-rule -- because
+            pass

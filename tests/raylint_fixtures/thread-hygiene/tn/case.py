@@ -1,0 +1,31 @@
+"""True negatives: fire-and-forget daemon threads, and a stored
+thread joined on teardown."""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def fire():
+    threading.Thread(target=print, daemon=True).start()
+
+
+def scatter_gather(items):
+    # Non-daemon WORKER threads are fine when the function joins them.
+    t = threading.Thread(target=sorted, args=(items,), daemon=False)
+    t.start()
+    t.join()
+    return items

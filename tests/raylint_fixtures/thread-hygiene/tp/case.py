@@ -1,0 +1,26 @@
+"""True positives: a thread without daemon=, and a long-lived
+self-stored daemon thread no teardown path ever joins."""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+    def stop(self):
+        pass  # stops nothing, joins nothing
+
+
+def fire():
+    t = threading.Thread(target=print)  # no daemon=
+    t.start()
+
+
+def fire_false():
+    t = threading.Thread(target=print, daemon=False)  # explicit False
+    t.start()  # ...and never joined: same interpreter-exit blocker

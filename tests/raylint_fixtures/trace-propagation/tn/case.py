@@ -1,0 +1,41 @@
+"""True negatives: the bundle carries trace, and the trace parameter
+is re-installed around the handler."""
+
+
+def dumps(x):
+    return x
+
+
+class scope_from:
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+class Submitter:
+    def push(self, spec, address):
+        bundle = dumps({
+            "function": spec.function,
+            "args": spec.args,
+            "owner": address,
+            "trace": spec.trace_ctx(),
+        })
+        return bundle
+
+    def handle_one(self, payload, trace=None):
+        with scope_from(trace):
+            return payload["method"](payload)
+
+    def handle_async(self, payload, trace=None):
+        # Propagation through a CLOSURE (the call_async-callback
+        # shape): the only read of 'trace' is inside the nested def.
+        def run():
+            with scope_from(trace):
+                return payload["method"](payload)
+
+        return run

@@ -1,0 +1,20 @@
+"""True positives: a task bundle shipped without trace context, and a
+received trace parameter that is dropped on the floor."""
+
+
+def dumps(x):
+    return x
+
+
+class Submitter:
+    def push(self, spec, address):
+        bundle = dumps({
+            "function": spec.function,
+            "args": spec.args,
+            "owner": address,
+        })
+        return bundle
+
+    def handle_one(self, payload, trace=None):
+        # 'trace' accepted but never installed/forwarded
+        return payload["method"](payload)

@@ -1,0 +1,175 @@
+"""raylint unit tests: per-rule fixture corpus (one true-positive and
+one true-negative mini-project per rule), suppression + baseline
+workflow, CLI JSON output — and the tier-1 SELF-LINT gate that runs
+the whole suite over the installed package and fails on any
+non-baselined finding."""
+
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu.tools import raylint
+from ray_tpu.tools.raylint import baseline as baseline_mod
+from ray_tpu.tools.raylint import cli as raylint_cli
+from ray_tpu.tools.raylint.model import ProjectModel
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "raylint_fixtures")
+ALL_RULES = sorted(raylint.RULES)
+
+
+def lint_fixture(rule: str, kind: str, select=None):
+    root = os.path.join(FIXTURES, rule, kind)
+    assert os.path.isdir(root), f"missing fixture {root}"
+    return raylint.run_lint(root, select=select, use_baseline=False)
+
+
+def of_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------------ per-rule
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_rule_true_positive_detected(rule):
+    hits = of_rule(lint_fixture(rule, "tp"), rule)
+    assert hits, f"{rule}: true-positive fixture produced no finding"
+    for f in hits:
+        assert f.path.endswith(".py") and f.line >= 1 and f.symbol
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_rule_true_negative_clean(rule):
+    hits = of_rule(lint_fixture(rule, "tn"), rule)
+    assert not hits, (
+        f"{rule}: true-negative fixture flagged: "
+        + "; ".join(f.render() for f in hits))
+
+
+# ----------------------------------------------------- specific shapes
+def test_blocking_under_lock_finds_direct_and_transitive():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("blocking-under-lock", "tp"), "blocking-under-lock")]
+    assert any("time.sleep" in m and "holding" in m for m in msgs)
+    assert any("rpc " in m for m in msgs)
+    assert any("un-timeouted" in m for m in msgs)
+    assert any("reaches a blocking op" in m for m in msgs)
+
+
+def test_handler_idempotency_names_the_handler():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("handler-idempotency", "tp"), "handler-idempotency")]
+    assert any("'register_node'" in m for m in msgs)
+    assert any("'kv_put'" in m for m in msgs)
+    assert any("'remove_actor'" in m for m in msgs)  # add_handler form
+    assert not any("'list_nodes'" in m for m in msgs)  # read-only
+
+
+def test_trace_propagation_subchecks():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("trace-propagation", "tp"), "trace-propagation")]
+    assert any("task bundle" in m for m in msgs)
+    assert any("never propagated" in m for m in msgs)
+    assert any("root op" in m for m in msgs)
+
+
+def test_suppression_comment_suppresses_and_validates():
+    # tn: a reasoned disable silences ft-exception-swallow entirely
+    # (same-line and comment-above forms) with no syntax finding.
+    findings = lint_fixture("suppression-syntax", "tn")
+    assert not of_rule(findings, "ft-exception-swallow")
+    assert not of_rule(findings, "suppression-syntax")
+    # tp: a reasonless disable does NOT suppress (the swallow still
+    # fires) and is itself flagged, as is an unknown rule name.
+    findings = lint_fixture("suppression-syntax", "tp")
+    syntax = [f.message for f in of_rule(findings, "suppression-syntax")]
+    assert any("without a '-- reason'" in m for m in syntax)
+    assert any("unknown rule 'no-such-rule'" in m for m in syntax)
+    assert of_rule(findings, "ft-exception-swallow")
+
+
+# ------------------------------------------------------------ baseline
+def test_baseline_grandfathers_and_shrinks(tmp_path):
+    root = os.path.join(FIXTURES, "ft-exception-swallow", "tp")
+    bl = str(tmp_path / "baseline.json")
+    fresh = raylint.run_lint(root, baseline_path=bl)
+    assert [f for f in fresh if not f.baselined]  # gate would fail
+    n = baseline_mod.save(bl, fresh)
+    assert n == len({f.fingerprint for f in fresh})
+    again = raylint.run_lint(root, baseline_path=bl)
+    assert again and all(f.baselined for f in again)  # gate passes
+    # fingerprints ignore line numbers: a record with a shifted line
+    # but identical (rule, path, symbol, message) still matches
+    blob = json.loads(open(bl).read())
+    assert all("fingerprint" in e for e in blob["findings"])
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    assert baseline_mod.load(str(tmp_path / "nope.json")) == set()
+
+
+# ----------------------------------------------------------------- CLI
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    tp = os.path.join(FIXTURES, "ft-exception-swallow", "tp")
+    bl = str(tmp_path / "bl.json")
+    rc = raylint_cli.main([tp, "--json", "--baseline", bl])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["counts"]["new"] >= 1
+    f0 = out["findings"][0]
+    assert {"rule", "path", "line", "symbol", "message",
+            "fingerprint"} <= set(f0)
+    # grandfather, then the same invocation gates clean
+    rc = raylint_cli.main([tp, "--update-baseline", "--baseline", bl])
+    capsys.readouterr()
+    assert rc == 0
+    rc = raylint_cli.main([tp, "--json", "--baseline", bl])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["counts"]["new"] == 0
+    assert out["counts"]["baselined"] >= 1
+
+
+def test_cli_list_rules(capsys):
+    assert raylint_cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert raylint_cli.main([FIXTURES, "--select", "bogus"]) == 2
+
+
+def test_cli_update_baseline_rejects_select(tmp_path, capsys):
+    # A partial-rule run must not rewrite (and thereby truncate) the
+    # full baseline.
+    bl = str(tmp_path / "bl.json")
+    rc = raylint_cli.main([FIXTURES, "--select", "thread-hygiene",
+                           "--update-baseline", "--baseline", bl])
+    assert rc == 2 and not os.path.exists(bl)
+
+
+# ------------------------------------------------------- project model
+def test_model_indexes_the_package():
+    model = ProjectModel(raylint.default_package_root())
+    assert len(model.modules) > 80
+    assert not model.parse_errors
+    # the call graph resolves self-methods and module functions
+    head = "ray_tpu.cluster.head:HeadServer._restart_loop"
+    assert head in model.functions
+    callees = {c for c, _l, _v in model.calls[head]}
+    assert "ray_tpu.cluster.head:HeadServer._place" in callees
+
+
+# ------------------------------------------------------ tier-1 self-lint
+def test_package_self_lint_clean_and_fast():
+    """The acceptance gate: the whole package lints clean (zero
+    non-baselined findings) in under 10 seconds."""
+    t0 = time.monotonic()
+    findings = raylint.run_lint()
+    elapsed = time.monotonic() - t0
+    fresh = [f for f in findings if not f.baselined]
+    assert not fresh, "raylint regressions:\n" + "\n".join(
+        f.render() for f in fresh)
+    assert elapsed < 10.0, f"self-lint took {elapsed:.1f}s (budget 10s)"
