@@ -373,6 +373,96 @@ def _broadcast_bench(size_bytes: int, n_nodes: int = 3) -> dict:
         c.shutdown()
 
 
+def _overload_goodput_bench() -> dict:
+    """Offered-load sweep (0.5× / 1× / 2× nominal capacity) against a
+    2-replica deployment with bounded mailboxes and per-request
+    deadlines: goodput, shed rate, and admitted-request p99 vs the
+    deadline at each point.  The 2× point is the overload plane's
+    headline — with admission control the system keeps serving at
+    capacity and rejects the excess typed + fast, instead of melting
+    into timeout soup."""
+    import asyncio
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.exceptions import (BackPressureError,
+                                    DeadlineExceededError)
+
+    SERVICE_S = 0.05
+    MAX_ONGOING = 4
+    DEADLINE_S = 1.0
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+
+    @serve.deployment(name="ovl_bench", num_replicas=2,
+                      max_ongoing_requests=MAX_ONGOING,
+                      max_queued_requests=MAX_ONGOING)
+    class Work:
+        async def __call__(self, x):
+            await asyncio.sleep(SERVICE_S)
+            return x
+
+    h = serve.run(Work.bind())
+    try:
+        for i in range(4):
+            h.remote(i).result(timeout=30)
+        t0 = time.perf_counter()
+        for i in range(8):
+            h.remote(i).result(timeout=30)
+        svc = (time.perf_counter() - t0) / 8
+        capacity = 2 * MAX_ONGOING / svc  # 2 replicas, req/s
+        hd = h.options(deadline_s=DEADLINE_S)
+        out = {"overload_capacity_rps": round(capacity, 1),
+               "overload_deadline_s": DEADLINE_S}
+
+        for factor in (0.5, 1.0, 2.0):
+            offered = factor * capacity
+            duration = 2.0
+            lock = threading.Lock()
+            oks, shed, lats = [], [], []
+
+            def fire(tag):
+                t_s = time.perf_counter()
+                try:
+                    hd.remote(tag).result()
+                    with lock:
+                        oks.append(tag)
+                        lats.append(time.perf_counter() - t_s)
+                except (BackPressureError, DeadlineExceededError):
+                    with lock:
+                        shed.append(tag)
+
+            threads = []
+            n = int(offered * duration)
+            period = duration / max(1, n)
+            t_start = time.perf_counter()
+            for i in range(n):
+                t = threading.Thread(target=fire, args=(i,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+                time.sleep(period)
+            for t in threads:
+                t.join(timeout=DEADLINE_S + 5)
+            wall = time.perf_counter() - t_start
+            lats.sort()
+            key = str(factor).replace(".", "_")
+            out[f"overload_{key}x_goodput_rps"] = round(
+                len(oks) / wall, 1)
+            out[f"overload_{key}x_shed_rate"] = round(
+                len(shed) / max(1, n), 3)
+            out[f"overload_{key}x_admitted_p99_ms"] = round(
+                lats[min(len(lats) - 1,
+                         int(0.99 * len(lats)))] * 1000, 1) \
+                if lats else None
+        return out
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -493,6 +583,13 @@ def main():
         extra.update(_obs_overhead_bench())
     except Exception as e:  # noqa: BLE001
         extra["obs_overhead_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: overload goodput phase start", file=sys.stderr,
+          flush=True)
+    try:
+        extra.update(_overload_goodput_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["overload_goodput_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

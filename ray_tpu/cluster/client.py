@@ -527,7 +527,8 @@ class ClusterClient:
 
         try:
             self.pool.get(address).call_async(
-                "push_task", bundle, callback=on_done)
+                "push_task", bundle, callback=on_done,
+                deadline=spec.deadline)
         except ConnectionError as e:
             self._report_node_failure(node_id, address)
             spec.exclude_node(node_id)
@@ -1023,7 +1024,22 @@ class ClusterClient:
         with self._push_streams_lock:
             self._push_streams[p["sid"]] = session
         claim.set()
+        self._gauge_push_streams()
         return {"ok": True}
+
+    def _gauge_push_streams(self):
+        """Object-plane push path queue depth: live inbound stream
+        sessions, exported on the overload plane's queue-depth gauge."""
+        try:
+            from ..observability.metrics import overload_counters
+
+            with self._push_streams_lock:
+                depth = sum(1 for s in self._push_streams.values()
+                            if isinstance(s, _PushStreamSession))
+            overload_counters()["queue_depth"].set(
+                depth, tags={"queue": "push_streams"})
+        except Exception:
+            pass
 
     def _push_stream_session(self, sid: str):
         """The sid's live session, waiting out an in-construction
@@ -1086,6 +1102,7 @@ class ClusterClient:
                     next(iter(self._finished_streams)))
             self._ending_streams.pop(sid, None)
         ending.set()
+        self._gauge_push_streams()
         return {"ok": True}
 
     def fetch_object(self, ref) -> None:
@@ -1421,8 +1438,12 @@ class ClusterClient:
                     spec, payload, allow_retry=False)
 
         try:
+            # The spec's end-to-end deadline rides the RPC envelope's
+            # 5th field; the receiving node re-installs it around
+            # actor_call, so the remote mailbox sheds expired work.
             self.pool.get(address).call_async(
-                "actor_call", bundle, callback=on_done)
+                "actor_call", bundle, callback=on_done,
+                deadline=spec.deadline)
         except ConnectionError as e:
             self._report_node_failure(node_id, address)
             self.runtime.task_manager.complete_error(

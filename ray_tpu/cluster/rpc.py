@@ -35,6 +35,7 @@ import time
 import uuid
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..core import deadlines as _deadlines
 from ..experimental import chaos as _chaos
 from ..observability import tracing as _tracing
 
@@ -189,23 +190,32 @@ TRANSPORT_ERRORS = (ConnectionError, TimeoutError, OSError,
 
 def _send_msg(sock: socket.socket, kind: str, req_id: str, method: str,
               payload: Any, lock: threading.Lock,
-              trace: Optional[Tuple] = None):
+              trace: Optional[Tuple] = None,
+              deadline: Optional[float] = None):
     """Bytes-like payloads are framed RAW (kind gets a "+raw" suffix) —
     no pickle copy on either side; the data plane's chunk transfers and
     pre-serialized task bundles ride this path at memcpy speed.
 
-    ``trace`` is the submitter's (trace_id, parent_span_id): it rides
-    the ENVELOPE (not the payload) so every RPC — including raw-framed
-    ones — propagates trace context without touching its body."""
-    if isinstance(payload, (bytes, bytearray, memoryview)):
-        head = ((kind + "+raw", req_id, method) if trace is None
-                else (kind + "+raw", req_id, method, trace))
-        env = pickle.dumps(head, protocol=pickle.HIGHEST_PROTOCOL)
+    ``trace`` is the submitter's (trace_id, parent_span_id) and
+    ``deadline`` the request's absolute end-to-end deadline (epoch s,
+    core/deadlines.py): both ride the ENVELOPE (4th and 5th fields, not
+    the payload) so every RPC — including raw-framed ones — propagates
+    request context without touching its body.  Fields are appended
+    only when set, so old-shape 3/4-tuples stay on the wire for
+    context-free calls."""
+    wire_kind = (kind + "+raw"
+                 if isinstance(payload, (bytes, bytearray, memoryview))
+                 else kind)
+    if deadline is not None:
+        head: Tuple = (wire_kind, req_id, method, trace, deadline)
+    elif trace is not None:
+        head = (wire_kind, req_id, method, trace)
+    else:
+        head = (wire_kind, req_id, method)
+    env = pickle.dumps(head, protocol=pickle.HIGHEST_PROTOCOL)
+    if wire_kind.endswith("+raw"):
         body = payload
     else:
-        head = ((kind, req_id, method) if trace is None
-                else (kind, req_id, method, trace))
-        env = pickle.dumps(head, protocol=pickle.HIGHEST_PROTOCOL)
         body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
         # Scatter-gather write: no concatenation copy of the body.
@@ -243,19 +253,22 @@ def _recv_segment(sock: socket.socket) -> bytearray:
 
 
 def _recv_msg(sock: socket.socket
-              ) -> Tuple[str, str, str, bytes, bool, Optional[Tuple]]:
-    """Returns (kind, req_id, method, raw_payload, is_raw, trace).  A
-    pickled payload is NOT deserialized here: the caller decodes it
-    after correlation so a bad payload fails one call, not the
-    connection.  Raw payloads skip pickle entirely.  ``trace`` is the
-    optional 4th envelope field (trace_id, parent_span_id)."""
+              ) -> Tuple[str, str, str, bytes, bool, Optional[Tuple],
+                         Optional[float]]:
+    """Returns (kind, req_id, method, raw_payload, is_raw, trace,
+    deadline).  A pickled payload is NOT deserialized here: the caller
+    decodes it after correlation so a bad payload fails one call, not
+    the connection.  Raw payloads skip pickle entirely.  ``trace`` is
+    the optional 4th envelope field (trace_id, parent_span_id);
+    ``deadline`` the optional 5th (absolute end-to-end deadline)."""
     env = pickle.loads(_recv_segment(sock))
     body = _recv_segment(sock)
     kind, req_id, method = env[0], env[1], env[2]
     trace = env[3] if len(env) > 3 else None
+    deadline = env[4] if len(env) > 4 else None
     if kind.endswith("+raw"):
-        return kind[:-4], req_id, method, body, True, trace
-    return kind, req_id, method, body, False, trace
+        return kind[:-4], req_id, method, body, True, trace, deadline
+    return kind, req_id, method, body, False, trace, deadline
 
 
 def _tune_socket(sock: socket.socket) -> None:
@@ -326,7 +339,7 @@ class RpcServer:
         wlock = threading.Lock()
         try:
             while not self._stopped.is_set():
-                kind, req_id, method, raw, is_raw, trace = \
+                kind, req_id, method, raw, is_raw, trace, deadline = \
                     _recv_msg(conn)
                 try:
                     payload = raw if is_raw else pickle.loads(raw)
@@ -341,12 +354,13 @@ class RpcServer:
                     # Inline submission phase; Deferred completion runs
                     # on its own thread.
                     self._handle_one(conn, wlock, req_id, method, payload,
-                                     inline=True, trace=trace)
+                                     inline=True, trace=trace,
+                                     deadline=deadline)
                 else:
                     threading.Thread(
                         target=self._handle_one,
                         args=(conn, wlock, req_id, method, payload),
-                        kwargs={"trace": trace},
+                        kwargs={"trace": trace, "deadline": deadline},
                         daemon=True).start()
         except (ConnectionError, EOFError, OSError):
             pass
@@ -376,16 +390,20 @@ class RpcServer:
             pass
 
     def _handle_one(self, conn, wlock, req_id, method, payload,
-                    inline: bool = False, trace=None):
+                    inline: bool = False, trace=None, deadline=None):
         try:
             fn = self.handlers.get(method)
             if fn is None:
                 raise AttributeError(f"no rpc method {method!r}")
-            # Re-install the caller's trace context around the handler
-            # so anything it submits (task specs, nested RPCs) inherits
-            # the trace — and restore after: handler threads (and the
-            # inline reader thread) are reused across requests.
-            with _tracing.scope_from(trace):
+            # Re-install the caller's trace AND deadline context around
+            # the handler so anything it submits (task specs, nested
+            # RPCs) inherits them — and restore after: handler threads
+            # (and the inline reader thread) are reused across
+            # requests.  Expired deadlines are NOT shed here — the
+            # control plane must stay reachable past a request budget
+            # (teardown/cleanup RPCs); task-level dequeue points do the
+            # shedding.
+            with _tracing.scope_from(trace), _deadlines.scope(deadline):
                 result = fn(payload)
             if isinstance(result, Deferred):
                 threading.Thread(
@@ -482,7 +500,7 @@ class RpcClient:
     def _read_loop(self, sock: socket.socket):
         try:
             while True:
-                kind, req_id, method, raw, is_raw, _trace = \
+                kind, req_id, method, raw, is_raw, _trace, _deadline = \
                     _recv_msg(sock)
                 with self._lock:
                     call = self._pending.pop(req_id, None)
@@ -532,13 +550,19 @@ class RpcClient:
                           timeout=timeout, deadline_s=deadline_s)
 
     def call_async(self, method: str, payload: Any = None,
-                   callback: Optional[Callable[[Any, bool], None]] = None
-                   ) -> "_PendingCall":
+                   callback: Optional[Callable[[Any, bool], None]] = None,
+                   deadline: Optional[float] = None) -> "_PendingCall":
         _chaos.on_rpc(method)
         self._chaos.maybe_fail(method)
         req_id = uuid.uuid4().hex
         call = _PendingCall(method, callback)
         trace = _tracing.current()
+        # The request's end-to-end deadline rides the envelope's 5th
+        # field: explicit (owner-side task pushes pass the spec's), else
+        # the thread's ambient deadline (a handler re-submitting under
+        # the caller's budget).
+        if deadline is None:
+            deadline = _deadlines.current()
         with self._lock:
             sock = self._sock
             if sock is None or self._closed:
@@ -546,7 +570,7 @@ class RpcClient:
             self._pending[req_id] = call
         try:
             _send_msg(sock, "req", req_id, method, payload, self._wlock,
-                      trace=trace)
+                      trace=trace, deadline=deadline)
         except (ConnectionError, OSError) as e:
             with self._lock:
                 self._pending.pop(req_id, None)
@@ -695,11 +719,14 @@ class ReconnectingClient:
             timeout=timeout, deadline_s=deadline_s)
 
     def call_async(self, method: str, payload: Any = None,
-                   callback: Optional[Callable[[Any, bool], None]] = None):
+                   callback: Optional[Callable[[Any, bool], None]] = None,
+                   deadline: Optional[float] = None):
         try:
-            return self._client.call_async(method, payload, callback)
+            return self._client.call_async(method, payload, callback,
+                                           deadline=deadline)
         except ConnectionError:
-            return self._reconnect().call_async(method, payload, callback)
+            return self._reconnect().call_async(method, payload, callback,
+                                                deadline=deadline)
 
     @property
     def _sock(self):

@@ -128,18 +128,47 @@ class _ActorCore:
                 raise self._dead_error()
             if not bypass_limit and self.info.max_pending_calls > 0 and (
                     self._pending_calls >= self.info.max_pending_calls):
+                self._count_rejection()
                 raise PendingCallsLimitExceededError(
                     f"actor {self.info.display_name()} has "
                     f"{self._pending_calls} pending calls "
                     f"(max_pending_calls={self.info.max_pending_calls})")
             if not spec.is_actor_creation:
                 self._pending_calls += 1
+                depth = self._pending_calls
+            else:
+                depth = None
             self._queue.put(spec)
+        if depth is not None:
+            self._gauge_depth(depth)
+
+    def _count_rejection(self):
+        """Bounded-mailbox admission rejection: typed AND counted, so
+        the overload plane's /metrics shows where pressure lands."""
+        try:
+            from ..observability.metrics import overload_counters
+
+            overload_counters()["backpressure"].inc(
+                tags={"where": "max_pending_calls"})
+        except Exception:
+            pass
+
+    def _gauge_depth(self, depth: int):
+        try:
+            from ..observability.metrics import overload_counters
+
+            overload_counters()["queue_depth"].set(
+                depth,
+                tags={"queue": f"actor:{self.info.display_name()}"})
+        except Exception:
+            pass
 
     def _call_started(self, spec: TaskSpec):
         if not spec.is_actor_creation:
             with self._submit_lock:
                 self._pending_calls -= 1
+                depth = self._pending_calls
+            self._gauge_depth(depth)
 
     # -- execution loops -----------------------------------------------------
     def _sync_main(self):
@@ -189,6 +218,11 @@ class _ActorCore:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)
             return
+        # Mailbox-dequeue load shedding: work whose end-to-end deadline
+        # passed while it queued completes with DeadlineExceededError —
+        # user code never runs (the overload plane's core invariant).
+        if self._runtime.shed_expired_spec(spec, "actor_mailbox"):
+            return
         if self._chaos_gate(spec):
             return
         self._runtime.execute_task_inline(
@@ -197,12 +231,22 @@ class _ActorCore:
     def _chaos_gate(self, spec: TaskSpec) -> bool:
         """Fault-injection hook before method dispatch: an active
         chaos schedule may kill this actor (with or without restart
-        budget) or fail just this call.  Returns True when the spec was
+        budget), fail just this call, or STALL it (load shaping:
+        ``slow_method`` / ``stall_replica`` make this actor a hot/slow
+        replica deterministically).  Returns True when the spec was
         consumed by an injected fault."""
-        action = _chaos.actor_task_action(spec.descriptor.function_name)
+        action = _chaos.actor_task_action(spec.descriptor.function_name,
+                                          self.info.display_name())
         if action is None:
             return False
         method = spec.descriptor.function_name
+        if action[0] == "slow":
+            # Injected latency: the call still runs, late.  Sleeping
+            # here (the dispatch path) stalls the whole actor — for an
+            # async actor it blocks the event loop — which is exactly
+            # the slow-replica failure mode under test.
+            time.sleep(action[1])
+            return False
         if action[0] == "kill":
             self._runtime.task_manager.complete_error(
                 spec, ActorDiedError(
@@ -237,6 +281,9 @@ class _ActorCore:
         if self.info.state == ActorState.DEAD:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)
+            return
+        # Same mailbox-dequeue shed as the sync path.
+        if self._runtime.shed_expired_spec(spec, "actor_mailbox"):
             return
         if self._chaos_gate(spec):
             return
@@ -280,6 +327,16 @@ class _ActorCore:
         for spec in failed:
             self._runtime.task_manager.complete_error(
                 spec, self._dead_error(), allow_retry=False)
+        # Drop this mailbox's depth series: gauges keyed by actor name
+        # would otherwise accumulate one stale entry per dead actor
+        # (serve replicas churn names every rolling update).
+        try:
+            from ..observability.metrics import overload_counters
+
+            overload_counters()["queue_depth"].remove(
+                tags={"queue": f"actor:{self.info.display_name()}"})
+        except Exception:
+            pass
 
 
 class ActorInfo:

@@ -157,11 +157,26 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> ObjectRef:
+        return self.next_ref()
+
+    def next_ref(self, timeout: Optional[float] = None) -> ObjectRef:
+        """``__next__`` with an optional bound on the item wait.  On
+        timeout raises ``GetTimeoutError`` and puts the index back, so
+        a later call retries the same item (single-consumer iteration
+        assumed, as with any generator)."""
         with self._lock:
             idx = self._index
             self._index += 1
-        item_id = self._runtime.streaming_manager.wait_item(
-            self._generator_id, idx)
+        try:
+            item_id = self._runtime.streaming_manager.wait_item(
+                self._generator_id, idx, timeout)
+        except TimeoutError:
+            with self._lock:
+                self._index -= 1
+            from ..exceptions import GetTimeoutError
+
+            raise GetTimeoutError(
+                f"streaming item {idx} not reported within {timeout}s")
         if item_id is None:
             raise StopIteration
         return ObjectRef(item_id, self._runtime)

@@ -19,7 +19,7 @@ _OPTION_KEYS = {
     "num_returns", "num_cpus", "num_tpus", "num_gpus", "resources",
     "max_retries", "retry_exceptions", "scheduling_strategy", "name",
     "runtime_env", "memory", "_metadata", "concurrency_group",
-    "isolate",
+    "isolate", "deadline_s",
 }
 
 
@@ -49,6 +49,7 @@ def _build_options(defaults: Dict[str, Any],
         name=merged.get("name", ""),
         runtime_env=merged.get("runtime_env"),
         isolate=bool(merged.get("isolate", False)),
+        deadline_s=merged.get("deadline_s"),
         _metadata=merged.get("_metadata") or {},
     )
 

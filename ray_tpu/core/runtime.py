@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
+from . import deadlines as _deadlines
 from . import runtime_context as rc_mod
 from .actor_runtime import (ActorExitSignal, ActorInfo, ActorManager,
                             ActorState)
@@ -37,15 +38,20 @@ from .streaming import StreamingGeneratorManager
 from .task_manager import TaskManager
 from .task_spec import (STREAMING, FunctionDescriptor, TaskOptions,
                         TaskSpec, normalize_strategy)
-from ..exceptions import (ActorError, ChannelError, ObjectLostError,
+from ..exceptions import (ActorError, BackPressureError, ChannelError,
+                          DeadlineExceededError, ObjectLostError,
                           TaskCancelledError, TaskError)
 from ..observability import tracing as _tracing
 
 # System fault-tolerance errors surface TYPED at the driver (reference:
 # RayActorError/ObjectLostError are not buried inside RayTaskError) —
 # a compiled-DAG pass that dies to a peer failure must be catchable as
-# ActorDiedError, not as a generic task wrapper.
-_FT_ERRORS = (TaskError, ActorError, ObjectLostError, ChannelError)
+# ActorDiedError, not as a generic task wrapper.  The overload plane's
+# errors belong here too: a @serve.batch rejection/shed raised inside
+# replica user code must reach the router/proxies typed (route
+# elsewhere, 503 + Retry-After), not as a generic TaskError.
+_FT_ERRORS = (TaskError, ActorError, ObjectLostError, ChannelError,
+              BackPressureError, DeadlineExceededError)
 
 _global_lock = threading.Lock()
 _global_runtime: Optional["Runtime"] = None
@@ -182,6 +188,20 @@ class Runtime:
                 raise TypeError(
                     f"get() expects an ObjectRef or a list of ObjectRefs, "
                     f"got {type(refs).__name__}")
+        # An ambient end-to-end deadline (a task executing under one, a
+        # serve request scope) bounds the wait even when the caller
+        # passed no timeout: get() must not outwait the request budget.
+        ambient = _deadlines.current()
+        if ambient is not None:
+            left = ambient - time.time()
+            if left <= 0:
+                from ..exceptions import DeadlineExceededError
+
+                raise DeadlineExceededError(
+                    "get(): request deadline already exceeded",
+                    deadline=ambient)
+            if timeout is None or timeout > left:
+                timeout = left
         deadline = None if timeout is None else time.monotonic() + timeout
         values = []
         for ref in ref_list:
@@ -196,9 +216,21 @@ class Runtime:
                 self.cluster.ensure_local(ref)
             t = None if deadline is None else max(
                 0.0, deadline - time.monotonic())
-            obj = self.object_store.wait_and_get(ref.object_id(), t)
-            if obj.is_located_only():
-                obj = self._materialize_located(ref.object_id(), deadline)
+            try:
+                obj = self.object_store.wait_and_get(ref.object_id(), t)
+                if obj.is_located_only():
+                    obj = self._materialize_located(ref.object_id(),
+                                                    deadline)
+            except TimeoutError:
+                if not _deadlines.expired(ambient):
+                    raise
+                from ..exceptions import DeadlineExceededError
+
+                # The request budget, not the caller's timeout, was the
+                # binding constraint: surface it typed.
+                raise DeadlineExceededError(
+                    "get(): request deadline exceeded while waiting",
+                    deadline=ambient) from None
             if obj.is_error():
                 raise obj.error
             values.append(obj.value)
@@ -395,6 +427,7 @@ class Runtime:
             return_ids=return_ids,
             trace_id=trace_id,
             parent_span_id=parent_span,
+            deadline=_deadlines.for_submission(options.deadline_s),
         )
 
     def submit_task(self, function, args, kwargs, options: TaskOptions,
@@ -592,8 +625,33 @@ class Runtime:
             return getattr(bound_instance, spec.descriptor.function_name)
         return spec.function
 
+    def shed_expired_spec(self, spec: TaskSpec, where: str) -> bool:
+        """Load shedding at a dequeue point: a spec whose end-to-end
+        deadline already passed is completed with a typed
+        ``DeadlineExceededError`` WITHOUT running user code (Tail at
+        Scale: expired work only adds queueing delay for live work).
+        Returns True when the spec was shed."""
+        if spec.deadline is None or time.time() < spec.deadline:
+            return False
+        from ..exceptions import DeadlineExceededError
+        from ..observability.metrics import overload_counters
+
+        overload_counters()["expired_shed"].inc(tags={"where": where})
+        self.task_manager.complete_error(
+            spec, DeadlineExceededError(
+                f"task {spec.repr_name()} shed at {where}: "
+                f"deadline exceeded",
+                deadline=spec.deadline,
+                context={"where": where,
+                         "late_by_s": round(
+                             time.time() - spec.deadline, 4)}),
+            allow_retry=False)
+        return True
+
     def execute_task_inline(self, spec: TaskSpec, bound_instance=None,
                             actor_core=None):
+        if self.shed_expired_spec(spec, "dispatch"):
+            return
         args, kwargs, dep_error = self._resolve_args(spec)
         if dep_error is not None:
             # Dependency failed: propagate its error to our outputs
@@ -606,11 +664,14 @@ class Runtime:
                           actor_id=spec.actor_id,
                           attempt_number=spec.attempt_number,
                           parent_task_id=spec.parent_task_id,
-                          trace_id=spec.trace_id, span_id=span_id)
+                          trace_id=spec.trace_id, span_id=span_id,
+                          deadline=spec.deadline)
         rc_mod.set_task_context(ctx)
-        # This task's span becomes the parent of everything it submits.
+        # This task's span becomes the parent of everything it submits;
+        # its remaining deadline budget bounds everything it awaits.
         prev_trace = _tracing.set_current(
             (spec.trace_id, span_id) if spec.trace_id else None)
+        prev_deadline = _deadlines.set_current(spec.deadline)
         t_start = time.time()
         outcome = "ok"
         try:
@@ -645,6 +706,7 @@ class Runtime:
         finally:
             rc_mod.set_task_context(None)
             _tracing.set_current(prev_trace)
+            _deadlines.set_current(prev_deadline)
             self._record_task_event(spec, t_start, outcome,
                                     span_id=span_id)
 
@@ -653,6 +715,8 @@ class Runtime:
                                         actor_core=None):
         import asyncio
 
+        if self.shed_expired_spec(spec, "dispatch"):
+            return
         # _resolve_args may block waiting for a not-yet-local dep; on
         # the async actor's event loop that would freeze the coroutines
         # producing it — offload the wait to a worker thread.
@@ -666,10 +730,12 @@ class Runtime:
         ctx = TaskContext(spec.task_id, spec.repr_name(),
                           actor_id=spec.actor_id,
                           attempt_number=spec.attempt_number,
-                          trace_id=spec.trace_id, span_id=span_id)
+                          trace_id=spec.trace_id, span_id=span_id,
+                          deadline=spec.deadline)
         rc_mod.set_task_context(ctx)
         prev_trace = _tracing.set_current(
             (spec.trace_id, span_id) if spec.trace_id else None)
+        prev_deadline = _deadlines.set_current(spec.deadline)
         t_start = time.time()
         outcome = "ok"
         try:
@@ -699,6 +765,7 @@ class Runtime:
         finally:
             rc_mod.set_task_context(None)
             _tracing.set_current(prev_trace)
+            _deadlines.set_current(prev_deadline)
             self._record_task_event(spec, t_start, outcome,
                                     span_id=span_id)
 
@@ -981,7 +1048,8 @@ class Runtime:
             retry_exceptions=options.retry_exceptions,
             name=options.name, actor_id=actor_id, is_actor_task=True,
             parent_task_id=self.current_task_id(), return_ids=return_ids,
-            trace_id=trace_id, parent_span_id=parent_span)
+            trace_id=trace_id, parent_span_id=parent_span,
+            deadline=_deadlines.for_submission(options.deadline_s))
         self.task_manager.register_pending(spec)
         arg_ids = [a.object_id() for a in spec.args
                    if isinstance(a, ObjectRef)]
@@ -1048,7 +1116,8 @@ class Runtime:
             retry_exceptions=options.retry_exceptions,
             name=options.name, actor_id=actor_id, is_actor_task=True,
             parent_task_id=self.current_task_id(), return_ids=return_ids,
-            trace_id=trace_id, parent_span_id=parent_span)
+            trace_id=trace_id, parent_span_id=parent_span,
+            deadline=_deadlines.for_submission(options.deadline_s))
         self.task_manager.register_pending(spec)
         arg_ids = [a.object_id() for a in spec.args
                    if isinstance(a, ObjectRef)]

@@ -16,13 +16,14 @@ _local = threading.local()
 
 class TaskContext:
     __slots__ = ("task_id", "task_name", "actor_id", "attempt_number",
-                 "parent_task_id", "trace_id", "span_id")
+                 "parent_task_id", "trace_id", "span_id", "deadline")
 
     def __init__(self, task_id: TaskID, task_name: str = "",
                  actor_id: Optional[ActorID] = None, attempt_number: int = 0,
                  parent_task_id: Optional[TaskID] = None,
                  trace_id: Optional[str] = None,
-                 span_id: Optional[str] = None):
+                 span_id: Optional[str] = None,
+                 deadline: Optional[float] = None):
         self.task_id = task_id
         self.task_name = task_name
         self.actor_id = actor_id
@@ -32,6 +33,10 @@ class TaskContext:
         # this execution belongs to and the span it records.
         self.trace_id = trace_id
         self.span_id = span_id
+        # Absolute end-to-end deadline (core/deadlines.py): user code
+        # can read its remaining budget; batch flush drops entries
+        # whose deadline passed while they coalesced.
+        self.deadline = deadline
 
 
 def set_task_context(ctx: Optional[TaskContext]):
@@ -89,6 +94,29 @@ class RuntimeContext:
 
         cur = tracing.current()
         return cur[0] if cur else None
+
+    def get_deadline(self):
+        """The current task's absolute end-to-end deadline (epoch s),
+        or None when the request carries no deadline.  The ambient
+        contextvar is consulted FIRST: it is per-asyncio-task, so it
+        stays correct when an async actor interleaves many requests on
+        one loop thread — the thread-local TaskContext is overwritten
+        at every task switch and is only the sync-path fallback."""
+        from . import deadlines
+
+        ambient = deadlines.current()
+        if ambient is not None:
+            return ambient
+        ctx = current_task_context()
+        if ctx is not None and ctx.deadline is not None:
+            return ctx.deadline
+        return None
+
+    def remaining_deadline_s(self):
+        """Seconds of budget left (may be negative), or None."""
+        from . import deadlines
+
+        return deadlines.remaining(self.get_deadline())
 
     def current_actor(self):
         aid = self.get_actor_id()

@@ -97,7 +97,7 @@ class LocalScheduler:
             entry.pending_deps = len(deps)
             if entry.pending_deps == 0:
                 entry.state = TaskState.QUEUED
-                self._ready.append(spec.task_id)
+                self._ready.append(spec.task_id)  # raylint: disable=unbounded-mailbox -- resource-gated backlog, not demand-driven: admission happens upstream (cluster spill + deadline shed at dispatch drains expired entries)
                 self._cond.notify_all()
         for dep in deps:
             self._object_store.add_done_callback(

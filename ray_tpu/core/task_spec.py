@@ -109,6 +109,10 @@ class TaskOptions:
     # Run in a pooled worker subprocess (N8 process isolation) instead
     # of inline in the node process.
     isolate: bool = False
+    # End-to-end budget in seconds (core/deadlines.py): resolved to an
+    # ABSOLUTE deadline at submission; None inherits the submitter's
+    # ambient deadline.
+    deadline_s: Optional[float] = None
     _metadata: Dict[str, Any] = field(default_factory=dict)
 
     def resource_demand(self, default_cpus: float = 1.0) -> Dict[str, float]:
@@ -151,6 +155,10 @@ class TaskSpec:
     # span this execution records attaches to the right trace.
     trace_id: Optional[str] = None
     parent_span_id: Optional[str] = None
+    # Absolute end-to-end deadline (epoch seconds, core/deadlines.py):
+    # carried next to the trace id across every hop; dequeue points
+    # shed the spec with DeadlineExceededError once it passes.
+    deadline: Optional[float] = None
     # Cluster: nodes that already failed this task (spillback exclusion,
     # reference: normal_task_submitter.cc:455 retry_at_raylet_address).
     _excluded_nodes: Tuple[str, ...] = ()

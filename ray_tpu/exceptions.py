@@ -162,7 +162,52 @@ class TaskCancelledError(RayTpuError):
 
 
 class PendingCallsLimitExceededError(RayTpuError):
-    """Actor's max_pending_calls exceeded."""
+    """Actor's max_pending_calls exceeded — the bounded-mailbox
+    admission signal.  Serve's router treats it as *route elsewhere*
+    (the replica is saturated, not broken); bare actor callers see it
+    raised at submission."""
+
+
+class BackPressureError(RayTpuError):
+    """Request rejected by admission control: a bounded queue (replica
+    mailbox, ``@serve.batch`` queue, router with every replica
+    saturated) is full.  Deliberately a REJECTION, not a failure — the
+    work was never started, so the caller may safely retry after
+    ``retry_after_s`` (the HTTP proxy maps this to 503 + Retry-After,
+    the gRPC proxy to UNAVAILABLE)."""
+
+    def __init__(self, reason: str = "request rejected: queue full",
+                 retry_after_s: float | None = None, context=None):
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.context = dict(context or {})
+        ctx = dict(self.context)
+        if retry_after_s is not None:
+            ctx.setdefault("retry_after_s", round(retry_after_s, 3))
+        super().__init__(reason + _format_context(ctx))
+
+    def __reduce__(self):
+        return (type(self), (self.reason, self.retry_after_s,
+                             self.context))
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's end-to-end deadline expired.  Raised both when
+    already-expired work is SHED before execution (scheduler dispatch,
+    actor mailbox dequeue, batch flush — user code never ran) and when
+    a caller's ``get``/``result`` budget runs out while the work is
+    still in flight.  ``context`` names the shed point (``where``) and
+    how late the work was (``late_by_s``)."""
+
+    def __init__(self, reason: str = "deadline exceeded",
+                 deadline: float | None = None, context=None):
+        self.reason = reason
+        self.deadline = deadline
+        self.context = dict(context or {})
+        super().__init__(reason + _format_context(self.context))
+
+    def __reduce__(self):
+        return (type(self), (self.reason, self.deadline, self.context))
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

@@ -63,11 +63,12 @@ class ChaosKill(BaseException):
 
 class _Rule:
     __slots__ = ("kind", "target", "nth", "count", "delay_s", "prob",
-                 "no_restart", "exc_type", "hits", "fires")
+                 "no_restart", "exc_type", "jitter_s", "hits", "fires")
 
     def __init__(self, kind: str, target: str, *, nth: int = 1,
                  count: int = 1, delay_s: float = 0.0, prob: float = 1.0,
-                 no_restart: bool = True, exc_type: type = RuntimeError):
+                 no_restart: bool = True, exc_type: type = RuntimeError,
+                 jitter_s: float = 0.0):
         self.kind = kind
         self.target = target
         self.nth = max(1, int(nth))
@@ -76,6 +77,7 @@ class _Rule:
         self.prob = float(prob)
         self.no_restart = bool(no_restart)
         self.exc_type = exc_type
+        self.jitter_s = float(jitter_s)
         self.hits = 0    # matching hook invocations seen
         self.fires = 0   # faults actually injected
 
@@ -131,7 +133,7 @@ class ChaosSchedule:
                        no_restart: bool = True) -> "ChaosSchedule":
         """Kill the executing actor at its ``nth`` dispatch of
         ``method`` (before user code runs)."""
-        self._rules.append(_Rule("actor_kill", method, nth=nth,
+        self._rules.append(_Rule("actor_kill", method, nth=nth,  # raylint: disable=unbounded-mailbox -- schedule BUILDER (finite test-authored rule list), not a request path; 'on_' in the name trips the dispatch heuristic
                                  no_restart=no_restart))
         return self
 
@@ -140,8 +142,35 @@ class ChaosSchedule:
                         exc_type: type = RuntimeError) -> "ChaosSchedule":
         """Inject ``exc_type`` at the ``nth``..``nth+count-1`` dispatch
         of ``method``."""
-        self._rules.append(_Rule("actor_raise", method, nth=nth,
+        self._rules.append(_Rule("actor_raise", method, nth=nth,  # raylint: disable=unbounded-mailbox -- schedule BUILDER (finite test-authored rule list), not a request path; 'on_' in the name trips the dispatch heuristic
                                  count=count, exc_type=exc_type))
+        return self
+
+    # Load-shaping injections (overload testing): make a method or a
+    # whole replica deterministically SLOW instead of dead — the "hot
+    # replica" half of the fault model, where the system must degrade
+    # by shedding rather than by latency collapse.
+    def slow_method(self, method: str, delay_s: float, *,
+                    jitter_s: float = 0.0, nth: int = 1,
+                    count: int = 1 << 30) -> "ChaosSchedule":
+        """Stall the ``nth``..``nth+count-1`` dispatches of ``method``
+        by ``delay_s`` (+ uniform [0, jitter_s) drawn from the
+        schedule's seeded RNG) BEFORE user code runs.  The stall sits
+        on the actor's dispatch path, so an async replica's event loop
+        blocks for the duration — a realistically sick replica."""
+        self._rules.append(_Rule("actor_slow", method, nth=nth,
+                                 count=count, delay_s=delay_s,
+                                 jitter_s=jitter_s))
+        return self
+
+    def stall_replica(self, actor_name: str, stall_s: float, *,
+                      count: int = 1 << 30) -> "ChaosSchedule":
+        """Stall EVERY method dispatch of any actor whose display name
+        contains ``actor_name`` (serve replicas are named
+        ``SERVE_<deployment>#<version>_<rid>``, so one replica of a
+        deployment can be targeted by its ``#v_rid`` suffix)."""
+        self._rules.append(_Rule("actor_stall", actor_name,
+                                 count=count, delay_s=stall_s))
         return self
 
     # ----------------------------------------------------------- queries
@@ -201,7 +230,8 @@ class ChaosSchedule:
                 elif rule.target != key:
                     continue
                 rule.hits += 1
-                if rule.kind in ("rpc_drop", "rpc_delay", "actor_raise"):
+                if rule.kind in ("rpc_drop", "rpc_delay", "actor_raise",
+                                 "actor_slow", "actor_stall"):
                     window = (rule.nth <= rule.hits
                               < rule.nth + rule.count)
                 else:
@@ -248,15 +278,31 @@ class ChaosSchedule:
             return ("kill", fired.no_restart)
         return ("sever",)
 
-    def actor_hook(self, method: str) -> Optional[Tuple]:
+    def actor_hook(self, method: str,
+                   actor_name: str = "") -> Optional[Tuple]:
         rule = self._match(("actor_kill", "actor_raise"), method)
+        if rule is not None:
+            self._record(rule, {"method": method})
+            if rule.kind == "actor_kill":
+                return ("kill", rule.no_restart)
+            return ("raise", rule.exc_type(
+                f"[chaos] injected failure in {method!r} "
+                f"(hit {rule.hits})"))
+        # Load shaping: per-method slowdown, then whole-replica stall
+        # (matched on the actor's display name, substring).
+        rule = self._match(("actor_slow",), method)
+        if rule is None and actor_name:
+            rule = self._match(("actor_stall",), actor_name,
+                               substring=True)
         if rule is None:
             return None
-        self._record(rule, {"method": method})
-        if rule.kind == "actor_kill":
-            return ("kill", rule.no_restart)
-        return ("raise", rule.exc_type(
-            f"[chaos] injected failure in {method!r} (hit {rule.hits})"))
+        delay = rule.delay_s
+        if rule.jitter_s:
+            with self._lock:
+                delay += self._rng.random() * rule.jitter_s
+        self._record(rule, {"method": method, "actor": actor_name,
+                            "delay_s": round(delay, 4)})
+        return ("slow", delay)
 
 
 def schedule(seed: int = 0) -> ChaosSchedule:
@@ -318,13 +364,14 @@ def ring_write_action(path: str, seq: int) -> Optional[Tuple]:
     return sched.ring_hook(path, seq)
 
 
-def actor_task_action(method: str) -> Optional[Tuple]:
+def actor_task_action(method: str,
+                      actor_name: str = "") -> Optional[Tuple]:
     """core/actor_runtime.py, before dispatching a method:
-    None | ("kill", no_restart) | ("raise", exc)."""
+    None | ("kill", no_restart) | ("raise", exc) | ("slow", delay_s)."""
     sched = _active
     if sched is None:
         return None
-    return sched.actor_hook(method)
+    return sched.actor_hook(method, actor_name)
 
 
 # ---------------------------------------------------------------------------

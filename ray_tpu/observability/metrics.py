@@ -75,6 +75,14 @@ class Gauge(_Metric):
         with self._vlock:
             self._values[self._key(tags)] = float(value)
 
+    def remove(self, tags: Optional[Dict[str, str]] = None):
+        """Drop one tagged series.  Gauges keyed by churning entities
+        (actor mailboxes, serve replicas across rolling updates) must
+        be removed on teardown or the registry and /metrics grow
+        without bound and dead entities export stale values forever."""
+        with self._vlock:
+            self._values.pop(self._key(tags), None)
+
 
 class Histogram(_Metric):
     def __init__(self, name: str, description: str = "",
@@ -291,6 +299,36 @@ def runtime_counters():
         "task_seconds": Histogram(
             "ray_tpu_task_seconds", "task execution wall time",
             tag_keys=("kind",)),
+    })
+
+
+def overload_counters():
+    """The overload-protection plane's series (deadline sheds,
+    admission-control rejections, circuit-breaker state, bounded-queue
+    depths) — incremented by core/deadlines.py, core/actor_runtime.py,
+    serve/handle.py, serve/batching.py, cluster/client.py."""
+    return metric_group("overload", lambda: {
+        "expired_shed": Counter(
+            "ray_tpu_requests_expired_shed",
+            "deadline-expired work shed before execution "
+            "(user code never ran)", tag_keys=("where",)),
+        "backpressure": Counter(
+            "ray_tpu_backpressure_rejections",
+            "typed admission-control rejections (BackPressureError / "
+            "PendingCallsLimitExceededError)", tag_keys=("where",)),
+        "breaker_state": Gauge(
+            "ray_tpu_circuit_breaker_state",
+            "per-replica router circuit breaker "
+            "(0 closed, 1 half-open, 2 open)",
+            tag_keys=("deployment", "replica")),
+        "breaker_trips": Counter(
+            "ray_tpu_circuit_breaker_trips",
+            "closed->open breaker transitions",
+            tag_keys=("deployment",)),
+        "queue_depth": Gauge(
+            "ray_tpu_queue_depth",
+            "bounded-queue depths (actor mailboxes, @serve.batch "
+            "queues, object-plane push streams)", tag_keys=("queue",)),
     })
 
 

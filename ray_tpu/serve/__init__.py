@@ -15,6 +15,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .handle import DeploymentHandle, DeploymentResponse
+# Overload-plane error types, re-exported so serving code can catch
+# them without importing ray_tpu.exceptions.
+from ..exceptions import BackPressureError, DeadlineExceededError
 
 _CONTROLLER_NAME = "serve_controller"
 
@@ -39,12 +42,14 @@ class Deployment:
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
                 user_config: Any = None,
                 autoscaling_config: Optional[dict] = None,
                 ray_actor_options: Optional[dict] = None) -> "Deployment":
         cfg = dict(self._config)
         for k, v in (("num_replicas", num_replicas),
                      ("max_ongoing_requests", max_ongoing_requests),
+                     ("max_queued_requests", max_queued_requests),
                      ("user_config", user_config),
                      ("autoscaling_config", autoscaling_config),
                      ("ray_actor_options", ray_actor_options)):
@@ -63,10 +68,17 @@ class Deployment:
 
 def deployment(_callable=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 100,
+               max_queued_requests: int = -1,
                user_config: Any = None,
                autoscaling_config: Optional[dict] = None,
                ray_actor_options: Optional[dict] = None):
     """``@serve.deployment`` decorator (reference: serve/api.py:246).
+
+    ``max_queued_requests`` (reference: serve deployment config of the
+    same name): bounds each replica's mailbox beyond the
+    ``max_ongoing_requests`` executing — a full replica rejects with a
+    typed error the router routes around, and the ingress maps to
+    503 + Retry-After / gRPC UNAVAILABLE.  -1 (default) = unbounded.
 
     ``autoscaling_config`` (reference: serve autoscaling_policy.py):
     ``{"min_replicas", "max_replicas", "target_ongoing_requests",
@@ -77,6 +89,7 @@ def deployment(_callable=None, *, name: Optional[str] = None,
         return Deployment(cd, name or cd.__name__, {
             "num_replicas": num_replicas,
             "max_ongoing_requests": max_ongoing_requests,
+            "max_queued_requests": max_queued_requests,
             "user_config": user_config,
             "autoscaling_config": autoscaling_config,
             "ray_actor_options": ray_actor_options,
@@ -204,7 +217,8 @@ def shutdown():
 
 
 __all__ = [
-    "Application", "Deployment", "DeploymentHandle",
+    "Application", "BackPressureError", "DeadlineExceededError",
+    "Deployment", "DeploymentHandle",
     "DeploymentResponse", "batch", "delete", "deployment",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
     "run", "shutdown", "status",

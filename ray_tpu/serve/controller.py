@@ -153,9 +153,21 @@ class ServeController:
         config = spec["config"]
         ray_actor_options = config.get("ray_actor_options") or {}
         RemoteReplica = ray_tpu.remote(Replica)
+        # Admission control: max_queued_requests bounds the replica's
+        # MAILBOX (max_ongoing_requests bounds concurrent execution).
+        # A full mailbox rejects the submission with a typed
+        # PendingCallsLimitExceededError, which the router treats as
+        # route-elsewhere — so overload degrades by shedding, not by
+        # unbounded queueing (default -1 = unbounded, reference
+        # serve's max_queued_requests).
+        max_queued = int(config.get("max_queued_requests", -1))
+        if max_queued == 0:
+            raise ValueError("max_queued_requests must be >= 1 (or -1 "
+                             "for unbounded)")
         replica = RemoteReplica.options(
             name=f"SERVE_{name}#{version}_{rid}",
             max_concurrency=int(config.get("max_ongoing_requests", 100)),
+            max_pending_calls=max_queued,
             **ray_actor_options,
         ).remote(name, spec["callable"], spec["init_args"],
                  spec["init_kwargs"])

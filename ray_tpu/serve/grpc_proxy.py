@@ -19,6 +19,8 @@ bundle per item of a streaming deployment response.
 
 from __future__ import annotations
 
+import re
+import time
 from typing import Dict, Optional
 
 from ..cluster.serialization import dumps, loads
@@ -44,13 +46,37 @@ class _Ingress:
         return handle
 
     def call(self, request: bytes, _ctx) -> bytes:
+        import grpc
+
+        from ..core import deadlines as _deadlines
+        from ..exceptions import (BackPressureError,
+                                  DeadlineExceededError, GetTimeoutError,
+                                  PendingCallsLimitExceededError)
+
         req = loads(request)
+        deadline_s = req.get("deadline_s")
+        deadline = (None if deadline_s is None
+                    else time.time() + float(deadline_s))
         try:
             handle = self._resolve(req)
-            result = handle.remote(
-                *req.get("args", ()), **req.get("kwargs", {})).result(
-                timeout=req.get("timeout", 60.0))
+            timeout = req.get("timeout", 60.0)
+            if deadline_s is not None:
+                timeout = min(timeout, float(deadline_s))
+            with _deadlines.scope(deadline):
+                result = handle.remote(
+                    *req.get("args", ()),
+                    **req.get("kwargs", {})).result(timeout=timeout)
             return dumps({"result": result})
+        except (BackPressureError, PendingCallsLimitExceededError) as e:
+            # Admission-control rejection → UNAVAILABLE (the gRPC
+            # idiom for "overloaded, retry later"); retry_after rides
+            # the details string for clients that parse it.
+            retry_after = getattr(e, "retry_after_s", None) or 1.0
+            _ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                       f"backpressure: {e} "
+                       f"[retry_after_s={retry_after:.3f}]")
+        except (DeadlineExceededError, GetTimeoutError) as e:
+            _ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:  # noqa: BLE001
             return dumps({"error": e})
 
@@ -134,12 +160,36 @@ class GrpcServeClient:
 
     def call(self, deployment: str, *args, method: str = "",
              multiplexed_model_id: str = "", timeout: float = 60.0,
-             **kwargs):
-        out = loads(self._call(dumps({
-            "deployment": deployment, "method": method,
-            "multiplexed_model_id": multiplexed_model_id,
-            "args": args, "kwargs": kwargs, "timeout": timeout}),
-            timeout=timeout + 30.0))
+             deadline_s: Optional[float] = None, **kwargs):
+        import grpc
+
+        try:
+            out = loads(self._call(dumps({
+                "deployment": deployment, "method": method,
+                "multiplexed_model_id": multiplexed_model_id,
+                "args": args, "kwargs": kwargs, "timeout": timeout,
+                "deadline_s": deadline_s}),
+                timeout=timeout + 30.0))
+        except grpc.RpcError as e:
+            # Translate the ingress's overload statuses back into the
+            # framework's typed errors.
+            from ..exceptions import (BackPressureError,
+                                      DeadlineExceededError)
+
+            code = e.code() if callable(getattr(e, "code", None)) \
+                else None
+            details = (e.details() or "") if callable(
+                getattr(e, "details", None)) else ""
+            if code == grpc.StatusCode.UNAVAILABLE:
+                m = re.search(r"retry_after_s=([0-9.]+)", details)
+                raise BackPressureError(
+                    f"gRPC ingress rejected: {details}",
+                    retry_after_s=float(m.group(1)) if m else None
+                ) from e
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise DeadlineExceededError(
+                    f"gRPC ingress: {details}") from e
+            raise
         if "error" in out:
             raise out["error"]
         return out["result"]

@@ -6,14 +6,25 @@ ThreadingHTTPServer in the driver process (no asgi/uvicorn
 dependencies); ``POST /<deployment>`` with a JSON body calls the
 deployment and returns the JSON-encoded result.  Each request thread
 blocks on its own DeploymentResponse, so concurrency = server threads.
+
+Overload semantics: an ``X-Request-Deadline-S: <seconds>`` header
+mints the request's end-to-end deadline at ingress (carried through
+the handle, the RPC envelope, and the replica mailbox); a typed
+``BackPressureError`` / ``PendingCallsLimitExceededError`` maps to
+**503 + Retry-After**, a blown deadline to **504**.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+
+DEADLINE_HEADER = "X-Request-Deadline-S"
+_DEFAULT_TIMEOUT_S = 60.0
 
 
 class _Proxy:
@@ -26,6 +37,11 @@ class _Proxy:
                 pass
 
             def do_POST(self):
+                from ray_tpu.core import deadlines as _deadlines
+                from ray_tpu.exceptions import (
+                    BackPressureError, DeadlineExceededError,
+                    GetTimeoutError, PendingCallsLimitExceededError)
+
                 name = self.path.strip("/").split("/")[0]
                 handle = proxy.handles.get(name)
                 if handle is None:
@@ -34,16 +50,51 @@ class _Proxy:
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
                 try:
+                    deadline_s = float(
+                        self.headers.get(DEADLINE_HEADER) or 0) or None
+                except ValueError:
+                    deadline_s = None
+                deadline = (time.time() + deadline_s
+                            if deadline_s else None)
+                # An explicit deadline governs the wait — a client
+                # declaring a 120 s budget must not be cut off at the
+                # no-header default.
+                timeout = (deadline_s if deadline_s
+                           else _DEFAULT_TIMEOUT_S)
+                extra_headers = []
+                try:
                     payload = json.loads(raw) if raw else None
-                    result = handle.remote(payload).result(timeout=60.0)
+                    # The ingress deadline scope makes the handle (and
+                    # everything downstream of it) inherit the budget.
+                    with _deadlines.scope(deadline):
+                        result = handle.remote(payload).result(
+                            timeout=timeout)
                     body = json.dumps({"result": result}).encode()
-                    self.send_response(200)
+                    status = 200
+                except (BackPressureError,
+                        PendingCallsLimitExceededError) as e:
+                    # Admission-control rejection: the request never
+                    # ran — tell the client WHEN to come back.
+                    retry_after = getattr(e, "retry_after_s", None)
+                    extra_headers.append(
+                        ("Retry-After",
+                         str(max(1, math.ceil(retry_after or 1.0)))))
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    status = 503
+                except (DeadlineExceededError, GetTimeoutError) as e:
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    status = 504
                 except Exception as e:  # noqa: BLE001 — 500 w/ message
                     body = json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode()
-                    self.send_response(500)
+                    status = 500
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 

@@ -29,13 +29,41 @@ class Replica:
                     "function deployments take no init args")
             self._instance = callable_def
         self._num_ongoing = 0
+        # The hosting actor's core (resolved lazily from the first
+        # request's task context): its mailbox length is the queued
+        # half of this replica's reported queue depth.
+        self._actor_core = None
+
+    def _queue_depth(self) -> int:
+        """ongoing + mailbox-queued — the load signal piggybacked on
+        every response for the router's power-of-two choice (the
+        reference probes this over RPC, pow_2_scheduler.py:52)."""
+        if self._actor_core is None:
+            try:
+                import ray_tpu
+                from ray_tpu.core import runtime_context as rc
+
+                ctx = rc.current_task_context()
+                if ctx is not None and ctx.actor_id is not None:
+                    self._actor_core = (ray_tpu.get_runtime()
+                                        .actor_manager
+                                        .get_core(ctx.actor_id))
+            except Exception:
+                self._actor_core = None
+        queued = (self._actor_core._pending_calls
+                  if self._actor_core is not None else 0)
+        return self._num_ongoing + queued
 
     async def handle_request(self, method: str, args: Tuple,
                              kwargs: Dict[str, Any],
                              multiplexed_model_id: str = ""):
+        from .handle import _PIGGYBACK_KEY
         from .multiplex import _reset_model_id, _set_model_id
 
         self._num_ongoing += 1
+        # Resolve the actor core NOW: the task context is installed
+        # for this coroutine's first (pre-await) step only.
+        self._queue_depth()
         token = _set_model_id(multiplexed_model_id)
         try:
             if method:
@@ -45,7 +73,9 @@ class Replica:
             out = fn(*args, **kwargs)
             if inspect.isawaitable(out):
                 out = await out
-            return out
+            # Piggyback the replica's queue depth on the reply — the
+            # handle unwraps it and feeds its router.
+            return {_PIGGYBACK_KEY: out, "q": self._queue_depth()}
         finally:
             _reset_model_id(token)
             self._num_ongoing -= 1

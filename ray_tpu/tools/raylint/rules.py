@@ -698,6 +698,131 @@ def rule_thread_hygiene(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: unbounded-mailbox
+# --------------------------------------------------------------------------
+
+# Method names that sit on an RPC/dispatch/ingest path: growth there is
+# driven by EXTERNAL demand, so an unbounded queue is the OOM-under-
+# overload failure class the admission-control plane exists to close.
+# Tokens are word-bounded on "_" so e.g. "compute"/"output" don't match
+# "put"; "on" matches only as an `on_*` hook prefix (a trailing "..._on"
+# is prose, not an event handler).
+_GROW_PATH_RE = re.compile(
+    r"(?:^|_)(submit|dispatch|enqueue|push|send|put|call|request|recv|"
+    r"handle|deliver|ship|ingest|accept)(?:_|$)|(?:^|_)on_", re.I)
+# Names whose appearance in a comparison reads as a capacity check.
+_BOUND_NAME_RE = re.compile(
+    r"(max|cap$|capacity|limit|bound|high_water|quota)", re.I)
+# Raising one of these inside the method IS the bound check's teeth.
+_REJECT_EXC_RE = re.compile(
+    r"(BackPressure|LimitExceeded|Overflow|Full)")
+
+
+def _unbounded_mailbox_ctor(info: ModuleInfo,
+                            value: ast.AST) -> Optional[str]:
+    """``queue.Queue()`` with no maxsize / ``deque()`` with no maxlen /
+    a bare ``[]`` — the unbounded mailbox shapes; else None."""
+    if isinstance(value, ast.List) and not value.elts:
+        return "[]"
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    qname = ""
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        qname = f"{info.imports.get(f.value.id, f.value.id)}.{f.attr}"
+    elif isinstance(f, ast.Name):
+        qname = info.imports.get(f.id, f.id)
+    if qname in ("queue.Queue", "queue.LifoQueue",
+                 "queue.PriorityQueue", "queue.SimpleQueue"):
+        bounded = bool(value.args) or any(
+            kw.arg == "maxsize" for kw in value.keywords)
+        return None if bounded else "queue.Queue()"
+    if qname in ("collections.deque", "deque"):
+        bounded = len(value.args) >= 2 or any(
+            kw.arg == "maxlen" for kw in value.keywords)
+        return None if bounded else "deque()"
+    return None
+
+
+def _has_bound_check(model: ProjectModel, fi: FuncInfo) -> bool:
+    """A comparison over len()/qsize()/a capacity-named value, or a
+    typed rejection raise, anywhere in the method."""
+    for node in model.walk_own(fi.node):
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    cf = sub.func
+                    cname = cf.id if isinstance(cf, ast.Name) else \
+                        getattr(cf, "attr", "")
+                    if cname in ("len", "qsize"):
+                        return True
+                if isinstance(sub, ast.Attribute) and \
+                        _BOUND_NAME_RE.search(sub.attr):
+                    return True
+                if isinstance(sub, ast.Name) and \
+                        _BOUND_NAME_RE.search(sub.id):
+                    return True
+        elif isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            f = exc.func if isinstance(exc, ast.Call) else exc
+            ename = f.id if isinstance(f, ast.Name) else \
+                getattr(f, "attr", "")
+            if ename and _REJECT_EXC_RE.search(ename):
+                return True
+    return False
+
+
+def rule_unbounded_mailbox(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "unbounded-mailbox")
+    for ci in model.classes.values():
+        info = model.modules[ci.module]
+        # 1) self-stored unbounded mailbox attributes, assigned
+        #    anywhere in the class body.
+        mailboxes: Dict[str, str] = {}
+        for sub in ast.walk(ci.node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t, v = sub.targets[0], sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                t, v = sub.target, sub.value  # self._q: Queue = Queue()
+            else:
+                continue
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self":
+                kind = _unbounded_mailbox_ctor(info, v)
+                if kind is not None:
+                    mailboxes[t.attr] = kind
+        if not mailboxes:
+            continue
+        # 2) growth sites (put/append) on dispatch-path methods with no
+        #    bound check in the same method.
+        for mname, mqn in ci.methods.items():
+            if not _GROW_PATH_RE.search(mname):
+                continue
+            fi = model.functions[mqn]
+            grows = []
+            for node in model.walk_own(fi.node):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("put", "put_nowait", "append",
+                                           "appendleft"):
+                    recv = node.func.value
+                    if isinstance(recv, ast.Attribute) and \
+                            isinstance(recv.value, ast.Name) and \
+                            recv.value.id == "self" and \
+                            recv.attr in mailboxes:
+                        grows.append((node, recv.attr))
+            if not grows or _has_bound_check(model, fi):
+                continue
+            for node, attr in grows:
+                out.add(info, node.lineno, fi.qualname,
+                        f"self.{attr} ({mailboxes[attr]}) grows on "
+                        f"dispatch-path method {mname!r} with no bound "
+                        f"check — unbounded mailbox")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
 # rule: suppression-syntax (meta): disables must carry a reason and
 # name real rules — a typo'd disable that silently fails to suppress
 # (or a reasonless one) is itself a finding
@@ -729,6 +854,7 @@ RULES = {
     "ft-exception-swallow": rule_ft_exception_swallow,
     "resource-teardown": rule_resource_teardown,
     "thread-hygiene": rule_thread_hygiene,
+    "unbounded-mailbox": rule_unbounded_mailbox,
     "suppression-syntax": rule_suppression_syntax,
 }
 
@@ -766,6 +892,12 @@ RULE_DOCS = {
         "threading.Thread needs daemon= (non-daemon leaks block "
         "interpreter exit), and a thread stored on self is long-lived "
         "infrastructure: some teardown path must join it."),
+    "unbounded-mailbox": (
+        "A self-stored queue.Queue()/deque()/list mailbox appended on "
+        "an RPC/dispatch path (submit/handle/push/recv/...) with no "
+        "bound check in the method is the OOM-under-overload failure "
+        "class: demand-driven queues must reject (BackPressureError / "
+        "maxsize) or carry a reasoned disable."),
     "suppression-syntax": (
         "raylint disables must name real rules and carry a "
         "'-- reason'; a reasonless or typo'd disable does not "

@@ -28,7 +28,7 @@ class ActorPool:
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """Run ``fn(actor, value)`` on a free actor, or queue it."""
         if not self._free:
-            self._backlog.append((fn, value))
+            self._backlog.append((fn, value))  # raylint: disable=unbounded-mailbox -- reference ActorPool semantics: the pool owner drives submission and map() gates on results, so the backlog is caller-paced
             return
         actor = self._free.popleft()
         ref = fn(actor, value)

@@ -1,0 +1,39 @@
+"""True negatives: bounded queues, capacity checks with typed
+rejection, non-dispatch growth, and a reasoned disable."""
+
+import queue
+from collections import deque
+
+
+class Bounded:
+    def __init__(self, cap):
+        self._queue = queue.Queue(maxsize=cap)   # bounded ctor
+        self._ring = deque(maxlen=64)            # bounded ctor
+        self._pending = []
+        self.max_pending = cap
+
+    def submit(self, item):
+        # capacity check + typed rejection guard the list growth
+        if len(self._pending) >= self.max_pending:
+            raise OverflowError("mailbox full")
+        self._pending.append(item)
+        self._queue.put(item)
+        self._ring.append(item)
+
+
+class Accumulator:
+    def __init__(self):
+        self._results = []
+
+    def collect(self, x):
+        # not a dispatch-path method: internal accumulation is fine
+        self._results.append(x)
+
+
+class Reasoned:
+    def __init__(self):
+        self._staging = []
+
+    def dispatch(self, item):
+        self._staging.append(item)  # raylint: disable=unbounded-mailbox -- drained synchronously by the same call before returning
+        return list(self._staging)

@@ -1,0 +1,21 @@
+"""True positives: self-stored unbounded mailboxes growing on
+dispatch paths with no bound check."""
+
+import queue
+from collections import deque
+
+
+class Mailbox:
+    def __init__(self):
+        self._queue = queue.Queue()     # no maxsize
+        self._pending = []              # bare list
+        self._backlog = deque()         # no maxlen
+
+    def submit(self, item):
+        self._queue.put(item)           # finding: demand-driven put
+
+    def handle_request(self, req):
+        self._pending.append(req)       # finding: demand-driven append
+
+    def on_recv(self, frame):
+        self._backlog.append(frame)     # finding
