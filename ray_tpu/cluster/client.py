@@ -55,12 +55,71 @@ def _try_mmap_shm(shm_path, size: int, meta):
         return None  # different host (or raced a free)
 
 
+_PUSH_HDR = None  # initialized lazily (struct import stays local)
+
+# Process-wide chaos budget for the raw push path ("drop the first N
+# push_raw_chunk sends in this PROCESS", not per session — a retried
+# push must find the budget spent, mirroring per-RpcClient budgets).
+_push_chaos_budget = None
+_push_chaos_lock = threading.Lock()
+
+
+def _push_chaos():
+    global _push_chaos_budget
+    with _push_chaos_lock:
+        if _push_chaos_budget is None:
+            from ..experimental.chaos import env_rpc_budget
+
+            _push_chaos_budget = env_rpc_budget()
+        return _push_chaos_budget
+
+
+def _push_hdr():
+    global _PUSH_HDR
+    if _PUSH_HDR is None:
+        import struct as _struct
+
+        _PUSH_HDR = _struct.Struct(">QQ")
+    return _PUSH_HDR
+
+
+def _sendmsg_all(sock, bufs: List[memoryview]) -> None:
+    from .rpc import sendmsg_all
+
+    sendmsg_all(sock, bufs)
+
+
+def _open_push_conn(raw_addr: str, sid: str, timeout: float):
+    """Dial a recipient's raw object-stream server and hand the
+    connection over to push mode for stream ``sid``."""
+    import pickle as _pickle
+    import socket as _socket
+    import struct as _struct
+
+    from .rpc import _tune_socket
+
+    host, port = raw_addr.rsplit(":", 1)
+    sock = _socket.create_connection((host, int(port)),
+                                     timeout=min(30.0, timeout))
+    _tune_socket(sock)
+    sock.settimeout(timeout)
+    hdr = _pickle.dumps(("__push__", sid))
+    sock.sendall(_struct.pack(">Q", len(hdr)) + hdr)
+    return sock
+
+
 class _PushStreamSession:
     """Recipient side of one pipelined push stream: chunks land in a
-    preallocated buffer AND forward to this node's relay children the
-    moment they arrive (the hop never store-and-forwards the payload).
-    ``finish`` seals the buffer into plasma's foreign cache and waits
-    for the whole subtree."""
+    preallocated host staging buffer AND forward to this node's relay
+    children the moment they arrive (the hop never store-and-forwards
+    the payload).  Data arrives either over STRIPED RAW SOCKETS (the
+    sender dials this node's ObjectStreamServer in push mode and
+    recv_into lands bytes directly in the buffer, GIL released) or over
+    the framed ``push_stream_chunk`` RPC (fallback).  Each inbound raw
+    stripe relays over its own raw socket per child, so a depth-d tree
+    runs d hops of striped line-rate forwarding with no cross-stripe
+    locking.  ``finish`` seals the buffer into plasma's foreign cache
+    and waits for the whole subtree."""
 
     def __init__(self, client, oid, owner: str, meta, size: int,
                  relay: List[str], timeout: float, fanout: int):
@@ -79,13 +138,22 @@ class _PushStreamSession:
         # np.empty, NOT bytearray: bytearray zero-fills the whole
         # buffer up front (a second full pass over the payload).
         self._buf = _np.empty(size, dtype=_np.uint8)
+        self._view = memoryview(self._buf)
         self._received = 0
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
+        self._error: Optional[BaseException] = None
         self._off8 = _struct.Struct(">Q")
+        # Per-inbound-stripe relay sockets: {child_index: socket},
+        # thread-local so stripe i forwards over its own connection to
+        # each child (no locking on the hot path); every opened socket
+        # is also registered under the lock for abort-time cleanup.
+        self._tls = threading.local()
+        self._relay_socks: List[Any] = []
+        self._chaos = _push_chaos()
         # Open onward sessions NOW (before any chunk arrives), so the
         # first chunk can relay immediately.
-        self._children: List[Tuple[Any, bytes]] = []
+        self._children: List[Tuple[Any, bytes, Optional[str]]] = []
         self._pending: List[Any] = []
         groups = [relay[i::fanout] for i in range(fanout)]
         for group in [g for g in groups if g]:
@@ -98,11 +166,140 @@ class _PushStreamSession:
                 timeout=timeout, deadline_s=min(timeout, 30.0))
             if not resp.get("ok"):
                 raise ConnectionError(str(resp.get("error")))
-            self._children.append((child, csid.encode()))
+            self._children.append((child, csid.encode(),
+                                   resp.get("raw_addr")))
 
     def expired(self) -> bool:
         return time.monotonic() > self._deadline
 
+    # -------------------------------------------------- raw stripe feed
+    def feed_raw(self, conn) -> None:
+        """Drain one inbound raw push stripe: ``(offset, length)``
+        headers followed by payload recv_into'd straight into the
+        staging buffer, relayed onward chunk by chunk.  Returns on
+        clean sender EOF; raises on a stalled read (the session's
+        remaining deadline is the read deadline — socket timeouts
+        short of it are ticks to re-check the budget, not failures,
+        so a sibling stripe hogging the relay for a minute can't
+        abort a transfer that still has budget) or a dead relay
+        child."""
+        import socket as _socket
+
+        hdr16 = _push_hdr()
+
+        def arm() -> None:
+            left = self._deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    f"push stream for {self.oid!r} read deadline "
+                    f"expired at {self._received}/{self.size} bytes")
+            conn.settimeout(min(left, 60.0))
+
+        try:
+            while True:
+                hdr = b""
+                while len(hdr) < 16:
+                    arm()
+                    try:
+                        got = conn.recv(16 - len(hdr))
+                    except _socket.timeout:
+                        continue  # budget remains: keep waiting
+                    if not got:
+                        if hdr:
+                            raise ConnectionError(
+                                "push stream closed mid-header")
+                        return  # clean EOF: stripe fully delivered
+                    hdr += got
+                offset, length = hdr16.unpack(hdr)
+                view = self._view
+                if view is None:  # aborted (deadline sweep) mid-read
+                    raise ConnectionError(
+                        f"push stream for {self.oid!r} aborted")
+                dst = view[offset:offset + length]
+                done = 0
+                while done < length:
+                    arm()
+                    try:
+                        r = conn.recv_into(dst[done:], length - done)
+                    except _socket.timeout:
+                        continue  # budget remains: keep waiting
+                    if r == 0:
+                        raise ConnectionError(
+                            "push stream closed mid-chunk")
+                    done += r
+                self._relay_raw(offset, length)
+                with self._lock:
+                    self._received += length
+                    if self._received >= self.size:
+                        self._done.notify_all()
+        except BaseException as e:  # noqa: BLE001
+            self._fail(e)
+            raise
+
+    def _relay_raw(self, offset: int, length: int) -> None:
+        """Forward one landed chunk to every relay child over this
+        stripe's own raw sockets (opened lazily on first chunk).
+        Raw-stripe feed only: the per-stripe socket cache lives in
+        thread-local storage, which is a cache hit for a persistent
+        ``feed_raw`` thread and a guaranteed MISS for framed ``chunk``
+        calls (each runs on a fresh RPC handler thread — those relay
+        framed instead, see :meth:`_relay_framed`)."""
+        if not self._children:
+            return
+        view = self._view
+        if view is None:  # aborted (deadline sweep) mid-relay
+            raise ConnectionError(
+                f"push stream for {self.oid!r} aborted")
+        hdr = _push_hdr().pack(offset, length)
+        data = view[offset:offset + length]
+        socks = getattr(self._tls, "socks", None)
+        if socks is None:
+            socks = self._tls.socks = {}
+        for i, (child, csid, raw_addr) in enumerate(self._children):
+            # Chaos surface for the mid-tree-sever fault model: a
+            # relay hop configured with RAY_TPU_TESTING_RPC_FAILURE=
+            # "push_raw_chunk=N" severs its subtree mid-stream.
+            self._chaos.maybe_fail("push_raw_chunk")
+            sock = socks.get(i)
+            if sock is None:
+                if raw_addr is None:
+                    # Child without a raw endpoint: framed fallback.
+                    self._pending.append(child.call_async(
+                        "push_stream_chunk",
+                        b"".join((csid, self._off8.pack(offset),
+                                  bytes(data)))))
+                    continue
+                left = max(0.1, self._deadline - time.monotonic())
+                sock = _open_push_conn(raw_addr, csid.decode(), left)
+                socks[i] = sock
+                with self._lock:
+                    self._relay_socks.append(sock)
+            _sendmsg_all(sock, [memoryview(hdr), data])
+
+    def _relay_framed(self, offset: int, data) -> None:
+        """Forward one landed chunk to every relay child over the
+        framed RPC plane.  Used by the framed ``chunk`` feed, where
+        each call runs on its own RPC handler thread: opening a raw
+        connection per chunk per child there would cost a dial + a
+        child-side reader thread per chunk (fd exhaustion on GiB
+        payloads) — the framed async call rides the child's one
+        persistent RPC connection instead."""
+        body = None
+        for child, csid, _raw_addr in self._children:
+            self._chaos.maybe_fail("push_raw_chunk")
+            if body is None:
+                body = bytes(data)
+            self._pending.append(child.call_async(
+                "push_stream_chunk",
+                b"".join((csid, self._off8.pack(offset), body))))
+
+    def _fail(self, e: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = e
+            self._done.notify_all()
+
+    # ---------------------------------------------- framed chunk feed
     def chunk(self, frame) -> None:
         import numpy as _np
 
@@ -110,34 +307,49 @@ class _PushStreamSession:
         (offset,) = self._off8.unpack(view[32:40])
         data = view[40:]
         n = len(data)
-        body = None
-        for child, csid in self._children:
-            if body is None:
-                body = bytes(data)
-            self._pending.append(child.call_async(
-                "push_stream_chunk",
-                b"".join((csid, self._off8.pack(offset), body))))
-        self._buf[offset:offset + n] = _np.frombuffer(data,
-                                                      dtype=_np.uint8)
+        buf = self._buf
+        if buf is None:  # aborted (deadline sweep) mid-chunk
+            raise ConnectionError(
+                f"push stream for {self.oid!r} aborted")
+        buf[offset:offset + n] = _np.frombuffer(data, dtype=_np.uint8)
+        if self._children:
+            self._relay_framed(offset, data)
         with self._lock:
             self._received += n
             if self._received >= self.size:
                 self._done.notify_all()
+
+    # ------------------------------------------------------ completion
+    def _close_relay_socks(self) -> None:
+        with self._lock:
+            socks, self._relay_socks = self._relay_socks, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def finish(self) -> None:
         from .serialization import sealed_from_flat
 
         with self._lock:
             while self._received < self.size:
+                if self._error is not None:
+                    raise self._error
                 left = self._deadline - time.monotonic()
                 if left <= 0:
                     raise TimeoutError(
                         f"push stream for {self.oid!r} stalled at "
                         f"{self._received}/{self.size} bytes")
                 self._done.wait(left)
+            if self._error is not None:
+                raise self._error
         for call in self._pending:
             call.result(max(0.1, self._deadline - time.monotonic()))
-        for child, csid in self._children:
+        # Half-close the relay stripes: children see EOF after the last
+        # relayed byte drains, exactly like a first-hop sender.
+        self._close_relay_socks()
+        for child, csid, _raw in self._children:
             left = max(0.1, self._deadline - time.monotonic())
             # Retried: a lost END response is acked by the handler's
             # finished-sid ledger instead of re-finishing.
@@ -155,7 +367,11 @@ class _PushStreamSession:
         self._buf = None
 
     def abort(self) -> None:
+        self._fail(ConnectionError(
+            f"push stream for {self.oid!r} aborted"))
+        self._close_relay_socks()
         self._buf = None
+        self._view = None
         self._children = []
         self._pending = []
 
@@ -594,7 +810,7 @@ class ClusterClient:
         recv copies release the GIL, so streams scale until memory
         bandwidth.  Returns the rebuilt Serialized; raises
         ConnectionError on holder loss."""
-        from ..core.config import GLOBAL_CONFIG
+        from .geometry import stripe_ranges, transfer_geometry
         from .rpc import RpcClient
         from .serialization import sealed_from_flat
 
@@ -614,7 +830,10 @@ class ClusterClient:
         if sealed is not None:
             return sealed
 
-        chunk = max(64 * 1024, GLOBAL_CONFIG.object_chunk_bytes())
+        # Adaptive geometry: sub-chunk payloads ride one framed call
+        # (no stream/thread setup); big payloads stripe wider as they
+        # grow, up to the configured cap.
+        chunk, n_streams = transfer_geometry(total, what="pull")
         # np.empty, NOT bytearray: bytearray zero-fills (0.5s for 1 GiB
         # — more than the transfer itself); empty pages fault lazily
         # inside the GIL-released recv_into stream.
@@ -631,10 +850,8 @@ class ClusterClient:
             memoryview(buf)[:] = data
             return sealed_from_flat(meta, memoryview(buf).toreadonly())
 
-        ranges = [(off, min(chunk, total - off))
-                  for off in range(0, total, chunk)]
-        n_streams = max(1, min(GLOBAL_CONFIG.object_pull_streams(),
-                               len(ranges)))
+        ranges = stripe_ranges(total, chunk)
+        n_streams = min(n_streams, len(ranges))
         deadline = time.monotonic() + timeout
         err: List[Optional[BaseException]] = [None]
         view = memoryview(buf)
@@ -846,27 +1063,35 @@ class ClusterClient:
         def get_chunk(offset, length):
             return self.runtime.plasma.read_chunk(oid, offset, length)
 
+        def get_pieces(offset, length):
+            return self.runtime.plasma.read_chunk_pieces(
+                oid, offset, length)
+
         self._relay_push(oid, owner, m["meta"], m["size"], shm_path,
                          get_chunk, list(addresses),
                          max(1, GLOBAL_CONFIG.object_broadcast_fanout()),
-                         timeout)
+                         timeout, get_pieces=get_pieces)
         return len(addresses)
 
     def _relay_push(self, oid, owner: str, meta, size: int,
                     shm_path: Optional[str], get_chunk,
                     targets: List[str], fanout: int,
-                    timeout: float) -> None:
+                    timeout: float, get_pieces=None) -> None:
         """Push to ``fanout`` children, each with its share of the
         remaining targets to relay onward.  Two-phase data: the first
         attempt ships only the shm path (same-host children mmap it —
-        free); a child that can't map it gets a pipelined CHUNK STREAM
-        (push_stream_* protocol) whose chunks relay onward hop-by-hop
-        as they arrive — no store-and-forward of whole payloads.  A
-        push RPC returns once its subtree stored the copy, so
-        completion here = subtree completion."""
+        free); a child that can't map it gets a pipelined STRIPED CHUNK
+        STREAM (push_stream_* + raw push sockets) whose chunks relay
+        onward hop-by-hop as they arrive — no store-and-forward of
+        whole payloads.  A push RPC returns once its subtree stored the
+        copy, so completion here = subtree completion.  A dead or
+        severed hop surfaces as a typed :class:`ChannelError` naming
+        the object and the failed subtree root."""
+        from ..exceptions import ChannelError
+
         groups = [targets[i::fanout] for i in range(fanout)]
         groups = [g for g in groups if g]
-        errs: List[BaseException] = []
+        errs: List[Tuple[str, BaseException]] = []
 
         def push_one(group: List[str]):
             try:
@@ -880,12 +1105,13 @@ class ClusterClient:
                         "data": None}, timeout=timeout)
                 if resp.get("need_data"):
                     self._stream_push(cl, oid, owner, meta, size,
-                                      group[1:], timeout, get_chunk)
+                                      group[1:], timeout, get_chunk,
+                                      get_pieces=get_pieces)
                     return
                 if not resp.get("ok"):
                     raise ConnectionError(str(resp.get("error")))
             except BaseException as e:  # noqa: BLE001
-                errs.append(e)
+                errs.append((group[0], e))
 
         threads = [threading.Thread(target=push_one, args=(g,),
                                     daemon=True) for g in groups]
@@ -894,9 +1120,14 @@ class ClusterClient:
         for t in threads:
             t.join(timeout=timeout)
         if errs:
-            raise errs[0] if isinstance(
-                errs[0], (ConnectionError, TimeoutError)) \
-                else ConnectionError(str(errs[0]))
+            hop, e = errs[0]
+            if isinstance(e, ChannelError):
+                raise e  # already typed by a deeper hop
+            raise ChannelError(
+                f"broadcast push severed: {e}",
+                context={"oid": getattr(oid, "hex", lambda: oid)()[:16],
+                         "subtree_root": hop,
+                         "cause": type(e).__name__}) from e
 
     def accept_pushed_object(self, oid, owner: str, meta, size: int,
                              shm_path: Optional[str], data,
@@ -933,25 +1164,32 @@ class ClusterClient:
             def get_chunk(offset, length):
                 return plasma.read_chunk(oid, offset, length)
 
+            def get_pieces(offset, length):
+                return plasma.read_chunk_pieces(oid, offset, length)
+
             self._relay_push(
                 oid, owner, meta, size, shm_path, get_chunk, relay,
-                max(1, GLOBAL_CONFIG.object_broadcast_fanout()), timeout)
+                max(1, GLOBAL_CONFIG.object_broadcast_fanout()), timeout,
+                get_pieces=get_pieces)
         return True
 
     # ------------------------------------------------ streamed push
     # Pipelined broadcast data plane (reference: push_manager.h:30 —
     # chunked pushes with a bounded in-flight window).  A recipient
-    # that cannot mmap the pusher's shm file gets BEGIN / CHUNK* / END:
-    # chunks write into a preallocated buffer AND forward to the
-    # recipient's own relay children as they arrive, so a depth-d tree
-    # streams at ~line rate instead of d serial store-and-forwards.
+    # that cannot mmap the pusher's shm file gets BEGIN, then the
+    # payload over STRIPED RAW SOCKETS (push-mode connections to the
+    # recipient's ObjectStreamServer — sendmsg straight from the plasma
+    # layout's live memoryviews, recv_into straight into the
+    # recipient's staging buffer, both sides GIL-released), then END.
+    # Chunks forward to the recipient's own relay children as they
+    # arrive, so a depth-d tree streams at ~line rate instead of d
+    # serial store-and-forwards.  Recipients without a raw endpoint
+    # fall back to framed ``push_stream_chunk`` RPCs.
 
     def _stream_push(self, cl, oid, owner: str, meta, size: int,
-                     relay: List[str], timeout: float, get_chunk) -> None:
-        import struct as _struct
+                     relay: List[str], timeout: float, get_chunk,
+                     get_pieces=None) -> None:
         import uuid as _uuid
-
-        from ..core.config import GLOBAL_CONFIG
 
         sid = _uuid.uuid4().hex
         resp = cl.call_with_retry("push_stream_begin", {
@@ -960,6 +1198,97 @@ class ClusterClient:
             timeout=timeout, deadline_s=min(timeout, 30.0))
         if not resp.get("ok"):
             raise ConnectionError(str(resp.get("error")))
+        from ..core.config import GLOBAL_CONFIG
+
+        raw_addr = resp.get("raw_addr")
+        # Sub-chunk payloads ride the already-open framed RPC
+        # connection (same shortcut as pull_sealed): a raw push would
+        # pay a fresh TCP dial + handshake + receiver thread per
+        # recipient just to ship one chunk.
+        one_chunk = max(64 * 1024, GLOBAL_CONFIG.object_chunk_bytes())
+        if raw_addr and size > one_chunk:
+            self._raw_stream_push(raw_addr, sid, size, timeout,
+                                  get_chunk, get_pieces)
+        else:
+            self._framed_stream_push(cl, sid, size, timeout, get_chunk)
+        resp = cl.call_with_retry("push_stream_end", {"sid": sid},
+                                  timeout=timeout,
+                                  deadline_s=min(timeout, 30.0))
+        if not resp.get("ok"):
+            raise ConnectionError(str(resp.get("error")))
+
+    def _raw_stream_push(self, raw_addr: str, sid: str, size: int,
+                         timeout: float, get_chunk, get_pieces) -> None:
+        """Ship ``size`` payload bytes as ``(offset, length)``-framed
+        chunks striped over adaptive parallel push connections."""
+        from ..experimental import chaos
+        from .geometry import stripe_ranges, transfer_geometry
+
+        chunk, n_streams = transfer_geometry(size, what="push")
+        ranges = stripe_ranges(size, chunk)
+        n_streams = min(n_streams, len(ranges))
+        deadline = time.monotonic() + timeout
+        hdr16 = _push_hdr()
+        err: List[Optional[BaseException]] = [None]
+
+        def stream_main(idx: int):
+            sock = None
+            try:
+                sock = _open_push_conn(raw_addr, sid, timeout)
+                for off, ln in ranges[idx::n_streams]:
+                    if err[0] is not None:
+                        return
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"push to {raw_addr} timed out")
+                    chaos.on_rpc("push_raw_chunk")
+                    pieces = None
+                    if get_pieces is not None:
+                        pieces = get_pieces(off, ln)
+                    if pieces is None:
+                        data = get_chunk(off, ln)
+                        if data is None:
+                            raise ConnectionError(
+                                f"source lost chunk at {off}")
+                        pieces = [data]
+                    _sendmsg_all(sock, [memoryview(hdr16.pack(off, ln)),
+                                        *pieces])
+            except BaseException as e:  # noqa: BLE001
+                if err[0] is None:
+                    err[0] = e
+            finally:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+        if n_streams == 1:
+            stream_main(0)
+        else:
+            threads = [threading.Thread(target=stream_main, args=(i,),
+                                        daemon=True,
+                                        name=f"rawpush-{i}")
+                       for i in range(n_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic())
+                       + 5.0)
+                if t.is_alive() and err[0] is None:
+                    err[0] = TimeoutError(
+                        f"raw push to {raw_addr} timed out")
+        if err[0] is not None:
+            e = err[0]
+            raise e if isinstance(e, (ConnectionError, TimeoutError)) \
+                else ConnectionError(str(e))
+
+    def _framed_stream_push(self, cl, sid: str, size: int,
+                            timeout: float, get_chunk) -> None:
+        import struct as _struct
+
+        from ..core.config import GLOBAL_CONFIG
+
         chunk = max(64 * 1024, GLOBAL_CONFIG.object_chunk_bytes())
         off8 = _struct.Struct(">Q")
         sid_b = sid.encode()
@@ -978,11 +1307,6 @@ class ClusterClient:
             offset += n
         for call in window:
             call.result(timeout)
-        resp = cl.call_with_retry("push_stream_end", {"sid": sid},
-                                  timeout=timeout,
-                                  deadline_s=min(timeout, 30.0))
-        if not resp.get("ok"):
-            raise ConnectionError(str(resp.get("error")))
 
     def _push_stream_begin(self, p) -> dict:
         from ..core.config import GLOBAL_CONFIG
@@ -1005,10 +1329,11 @@ class ClusterClient:
                          and sess.expired()]
                 for s in stale:
                     self._push_streams.pop(s).abort()
+        raw_addr = self.server.raw_stream_address()
         if cur is not None:
             if isinstance(cur, threading.Event):
                 cur.wait(timeout=float(p.get("timeout") or 600.0))
-            return {"ok": True}
+            return {"ok": True, "raw_addr": raw_addr}
         try:
             session = _PushStreamSession(
                 self, p["oid"], p["owner"], p["meta"], int(p["size"]),
@@ -1025,7 +1350,7 @@ class ClusterClient:
             self._push_streams[p["sid"]] = session
         claim.set()
         self._gauge_push_streams()
-        return {"ok": True}
+        return {"ok": True, "raw_addr": raw_addr}
 
     def _gauge_push_streams(self):
         """Object-plane push path queue depth: live inbound stream
@@ -1539,12 +1864,19 @@ class ObjectStreamServer:
     Per-connection protocol, repeatable:
       -> [8-byte len][pickle (oid, offset, length)]
       <- [8-byte payload length (0 = not found)][raw bytes]
+
+    A first request of ``("__push__", sid)`` instead flips the
+    connection into PUSH mode: the remote writes ``[8-byte offset]
+    [8-byte length][raw bytes]`` frames that land directly in push
+    stream ``sid``'s preallocated staging buffer (the inbound half of
+    the striped broadcast relay) until EOF.
     """
 
-    def __init__(self, runtime, host: str = "127.0.0.1"):
+    def __init__(self, runtime, host: str = "127.0.0.1", client=None):
         import socket as _socket
 
         self.runtime = runtime
+        self.client = client
         self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
         self._sock.setsockopt(_socket.SOL_SOCKET,
                               _socket.SO_REUSEADDR, 1)
@@ -1587,25 +1919,19 @@ class ObjectStreamServer:
         try:
             while not self._stopped.is_set():
                 (hn,) = _len8.unpack(bytes(recv_exact(8)))
-                oid, offset, length = _pickle.loads(recv_exact(hn))
+                req = _pickle.loads(recv_exact(hn))
+                if isinstance(req, tuple) and req[0] == "__push__":
+                    self._serve_push(conn, req[1])
+                    return
+                oid, offset, length = req
                 pieces = self.runtime.plasma.read_chunk_pieces(
                     oid, offset, length)
                 if pieces is None:
                     conn.sendall(_len8.pack(0))
                     continue
                 total = sum(len(p) for p in pieces)
-                bufs = [memoryview(_len8.pack(total))] + \
-                    [p if isinstance(p, memoryview) else memoryview(p)
-                     for p in pieces]
-                while bufs:
-                    # Cap the iovec at IOV_MAX-ish: a chunk spanning
-                    # thousands of tiny externs would EMSGSIZE.
-                    sent = conn.sendmsg(bufs[:1024])
-                    while bufs and sent >= len(bufs[0]):
-                        sent -= len(bufs[0])
-                        bufs.pop(0)
-                    if sent and bufs:
-                        bufs[0] = bufs[0][sent:]
+                _sendmsg_all(conn,
+                             [memoryview(_len8.pack(total)), *pieces])
         except (ConnectionError, OSError, EOFError):
             pass
         finally:
@@ -1613,6 +1939,19 @@ class ObjectStreamServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_push(self, conn, sid: str) -> None:
+        """Inbound half of one striped push stream: hand the connection
+        to the session, which recv_into's its staging buffer and relays
+        onward.  No session (expired, aborted, or unknown sid) closes
+        the connection — the sender sees the break as a typed push
+        failure."""
+        if self.client is None:
+            return
+        session = self.client._push_stream_session(sid)
+        if session is None:
+            return  # close: sender's sendall surfaces the severed hop
+        session.feed_raw(conn)
 
     def shutdown(self):
         self._stopped.set()
@@ -1658,10 +1997,15 @@ class NodeServer:
             "ping": lambda p: "pong",
         }, ordered={"actor_call"})
         self.address = self._server.address
-        # Raw object-stream side channel: chunk pulls at plain-socket
-        # speed (no framing/pickle/correlation on the hot path).
+        # Raw object-stream side channel: chunk pulls AND inbound push
+        # stripes at plain-socket speed (no framing/pickle/correlation
+        # on the hot path).
         self._raw_stream = ObjectStreamServer(
-            self.runtime, host=self.address.rsplit(":", 1)[0])
+            self.runtime, host=self.address.rsplit(":", 1)[0],
+            client=client)
+
+    def raw_stream_address(self) -> str:
+        return self._raw_stream.address
 
     # Completion helper: wait for the local returns, then per return —
     # small → inline wire bytes in the reply; big → pin a primary copy

@@ -1,0 +1,76 @@
+"""Adaptive transfer geometry: chunk size and stream count per payload.
+
+One fixed ``(object_chunk_bytes, object_pull_streams)`` pair cannot fit
+both ends of the payload spectrum: a 100 KB value must not pay 4 socket
+setups and thread spawns (stream setup dwarfs the transfer), and a
+multi-GB value should stripe across every socket the cap allows (one
+reader thread tops out ~0.8 GB/s loopback; recv_into releases the GIL,
+so streams scale until memory bandwidth).  ``transfer_geometry`` picks
+the pair from the payload size:
+
+- payloads at or below one chunk ride a single stream (and a single
+  chunk — no striping bookkeeping at all);
+- above that, streams scale one per ``object_stream_stripe_bytes`` of
+  payload up to the ``object_pull_streams`` cap, and the chunk size
+  grows so no stream sees more than ``_MAX_CHUNKS_PER_STREAM`` chunks
+  (per-chunk header overhead amortizes away on huge payloads).
+
+The chosen geometry is logged at DEBUG (logger ``ray_tpu.transfer``)
+so transfer-rate investigations can see what the wire actually did.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Tuple
+
+from ..core.config import GLOBAL_CONFIG
+
+logger = logging.getLogger("ray_tpu.transfer")
+
+_MIN_CHUNK = 64 * 1024
+_MAX_CHUNKS_PER_STREAM = 64
+# Chunks are rounded up to this alignment so a chunk-framed wire is
+# always a whole number of array elements for every numeric itemsize
+# (collectives count received elements as frame_bytes // itemsize; an
+# unaligned mid-stream frame would truncate that count and shift every
+# later frame — silent corruption above 256 MiB/segment).
+_CHUNK_ALIGN = 4096
+
+
+def transfer_geometry(total_bytes: int, *, what: str = "pull",
+                      streams_cap: int = 0) -> Tuple[int, int]:
+    """(chunk_bytes, n_streams) for a ``total_bytes`` transfer.
+
+    ``streams_cap`` overrides the ``object_pull_streams`` config cap
+    when positive (collectives cap differently from object pulls)."""
+    base_chunk = max(_MIN_CHUNK, GLOBAL_CONFIG.object_chunk_bytes())
+    cap = streams_cap if streams_cap > 0 \
+        else max(1, GLOBAL_CONFIG.object_pull_streams())
+    total = max(0, int(total_bytes))
+    if total <= base_chunk:
+        # Small payload: one chunk, one stream — stream/thread setup
+        # must not dominate the transfer.
+        geometry = (max(total, 1), 1)
+    else:
+        stripe = max(base_chunk,
+                     GLOBAL_CONFIG.object_stream_stripe_bytes())
+        n_streams = min(cap, max(1, -(-total // stripe)))
+        # Grow chunks so no stream loops over an unbounded chunk list
+        # (header + syscall overhead per chunk).
+        per_stream = -(-total // n_streams)
+        chunk = max(base_chunk,
+                    -(-per_stream // _MAX_CHUNKS_PER_STREAM))
+        chunk = -(-chunk // _CHUNK_ALIGN) * _CHUNK_ALIGN
+        geometry = (chunk, n_streams)
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug(
+            "%s geometry for %d bytes: %d stream(s) x %d-byte chunks",
+            what, total, geometry[1], geometry[0])
+    return geometry
+
+
+def stripe_ranges(total_bytes: int, chunk: int) -> List[Tuple[int, int]]:
+    """[(offset, length)] chunk ranges covering ``total_bytes``."""
+    return [(off, min(chunk, total_bytes - off))
+            for off in range(0, total_bytes, chunk)]

@@ -219,19 +219,24 @@ def _send_msg(sock: socket.socket, kind: str, req_id: str, method: str,
         body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
         # Scatter-gather write: no concatenation copy of the body.
-        # sendmsg may queue only a prefix (signal, full send buffer) —
-        # loop on the remainder or the framing desynchronizes.
-        bufs = [_LEN.pack(len(env)), memoryview(env),
-                _LEN.pack(len(body)),
-                memoryview(body) if not isinstance(body, memoryview)
-                else body]
-        while bufs:
-            sent = sock.sendmsg(bufs)
-            while bufs and sent >= len(bufs[0]):
-                sent -= len(bufs[0])
-                bufs.pop(0)
-            if sent and bufs:
-                bufs[0] = bufs[0][sent:]
+        sendmsg_all(sock, [_LEN.pack(len(env)), memoryview(env),
+                           _LEN.pack(len(body)), body])
+
+
+def sendmsg_all(sock: socket.socket, bufs) -> None:
+    """Scatter-gather write of the whole iovec.  sendmsg may queue only
+    a prefix (signal, full send buffer) — loop on the remainder or the
+    framing desynchronizes.  The iovec is capped at IOV_MAX-ish per
+    call: a payload spanning thousands of tiny pieces would EMSGSIZE."""
+    bufs = [b if isinstance(b, memoryview) else memoryview(b)
+            for b in bufs]
+    while bufs:
+        sent = sock.sendmsg(bufs[:1024])
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if sent and bufs:
+            bufs[0] = bufs[0][sent:]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:

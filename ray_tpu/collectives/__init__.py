@@ -1,0 +1,33 @@
+"""``ray_tpu.collectives`` — cross-host array collectives over the DCN.
+
+The ICI half of the collective story is XLA's (psum/all_gather inside
+one jax runtime, see ``ray_tpu.parallel``); this package is the DCN
+half: ring ``allreduce`` / ``allgather`` / ``broadcast`` between
+processes/hosts that do NOT share a jax runtime, running over striped
+raw sockets with chunked, reduce-overlapped transfers (docs/
+networking.md).  ``train/`` gradient sync across worker groups and
+``util/broadcast`` weight distribution build on this.
+
+Usage (every member, same order — the SPMD contract)::
+
+    from ray_tpu import collectives
+
+    group = collectives.create_group("grad-sync", rank=r, world_size=n)
+    grads = group.allreduce(grads, op="sum")       # numpy or jax.Array
+    state = group.allreduce_tree(state, op="sum")  # one pass per dtype
+    group.close()
+
+Failure model: a dead or wedged peer raises a typed
+:class:`~ray_tpu.exceptions.ChannelError` within the op deadline
+(never a hang); ops also honor the ambient request deadline
+(``core/deadlines.py``) and the chaos plane's ``collective_*`` hook
+targets (``experimental/chaos.py``).
+"""
+
+from .group import (CollectiveGroup, allgather, allreduce, broadcast,
+                    create_group, destroy_group, get_group)
+
+__all__ = [
+    "CollectiveGroup", "create_group", "destroy_group", "get_group",
+    "allreduce", "allgather", "broadcast",
+]

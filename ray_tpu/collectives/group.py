@@ -1,0 +1,488 @@
+"""Ring collectives over the DCN: allreduce, allgather, broadcast.
+
+Reference role: the object manager's push/pull plane moves *objects*;
+gradient sync and weight distribution need *in-place array* collectives
+at NIC line rate (SURVEY §N10/N11; SNIPPETS' pjit notes cover the ICI
+half — this module is the DCN half, the layer ``train/`` gradient sync
+and ``util/broadcast`` stand on when a gang spans hosts without a
+shared jax runtime).
+
+Algorithms (bandwidth-optimal ring, NCCL-style):
+
+- ``allreduce``: ring reduce-scatter + ring allgather.  Each member
+  moves ``2 * (n-1)/n * size`` bytes regardless of ``n``.  Segments
+  move in adaptive chunks (cluster/geometry.py) and the receive side
+  reduces each landed chunk while its send thread streams the next one
+  out — reduce overlaps transfer, double-buffered staging, so the wire
+  never idles behind the CPU adds.
+- ``allgather``: ring pass-through, ``(n-1)/n * n * size`` moved.
+- ``broadcast``: chunked pipeline around the ring — hop latency is one
+  *chunk*, not one payload, so depth costs almost nothing.
+
+Failure model: a dead or stalled peer surfaces as a typed
+:class:`~ray_tpu.exceptions.ChannelError` naming the group, ranks, op
+and round — never a hang.  Every op bounds itself by the group timeout
+AND the ambient request deadline (core/deadlines.py), and chaos
+schedules can sever deterministically via the ``collective_chunk``
+RPC-hook target (experimental/chaos.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import deadlines
+from ..exceptions import ChannelError
+from .transport import (PeerServer, connect_peer, publish_endpoint,
+                        resolve_members, retract_endpoint)
+
+_REDUCE_OPS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def _is_jax_array(x) -> bool:
+    import sys
+
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(x, jax.Array)
+
+
+class CollectiveGroup:
+    """One member's handle on a named collective ring.
+
+    Construction is a collective act: every member of ``world_size``
+    must call it with the same ``name`` (rendezvous blocks until the
+    ring closes).  Ops are synchronous and must be called by all
+    members in the same order — the usual SPMD contract.
+    """
+
+    def __init__(self, name: str, rank: int, world_size: int, *,
+                 timeout: float = 60.0):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} outside [0, {world_size})")
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.timeout = timeout
+        self._closed = False
+        self._lock = threading.Lock()
+        self._server = PeerServer(name, rank)
+        publish_endpoint(name, rank, self._server.address)
+        if world_size == 1:
+            self._next = self._prev = None
+            return
+        try:
+            members = resolve_members(name, world_size, timeout)
+            # Dial next, accept prev — one persistent link each way
+            # around the ring.
+            self._next = connect_peer(members[(rank + 1) % world_size],
+                                      name, rank, timeout)
+            self._prev = self._server.accept_peer(
+                (rank - 1) % world_size, timeout)
+        except (ConnectionError, TimeoutError, OSError) as e:
+            self._teardown()
+            raise ChannelError(
+                f"collective group setup failed: {e}",
+                context={"group": name, "rank": rank,
+                         "world_size": world_size}) from e
+
+    # ------------------------------------------------------------ plumbing
+    def _deadline(self, timeout: Optional[float]) -> float:
+        """Monotonic deadline for one op: explicit timeout, else the
+        group default, further clamped by the ambient request deadline
+        (PR 5 plane) when one is installed."""
+        budget = self.timeout if timeout is None else timeout
+        ambient = deadlines.current()
+        if ambient is not None:
+            budget = min(budget, max(0.0, ambient - time.time()))
+        return time.monotonic() + budget
+
+    def _error(self, op: str, e: BaseException,
+               **detail) -> ChannelError:
+        if isinstance(e, ChannelError):
+            return e
+        kind = "stalled (deadline)" if isinstance(e, TimeoutError) \
+            else "severed"
+        return ChannelError(
+            f"collective {op} {kind}: peer died or wedged mid-op "
+            f"({e})",
+            context={"group": self.name, "rank": self.rank,
+                     "op": op, "cause": type(e).__name__, **detail})
+
+    def _arm(self, deadline: float) -> None:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise TimeoutError(f"collective deadline expired")
+        if self._next is not None:
+            self._next.settimeout(left)
+        if self._prev is not None:
+            self._prev.settimeout(left)
+
+    @staticmethod
+    def _chunks(n: int) -> List[tuple]:
+        from ..cluster.geometry import stripe_ranges, transfer_geometry
+
+        chunk, _streams = transfer_geometry(n, what="collective",
+                                            streams_cap=1)
+        return stripe_ranges(n, chunk)
+
+    @staticmethod
+    def _chaos_chunk() -> None:
+        from ..experimental import chaos
+
+        chaos.on_rpc("collective_chunk")
+
+    def _send_view(self, conn, view: memoryview,
+                   err: List[Optional[BaseException]]) -> threading.Thread:
+        """Stream ``view`` to ``conn`` chunk-framed from a background
+        thread (ring sends and receives must run concurrently — a
+        blocking send against a peer that is itself blocked sending
+        would deadlock the ring once payloads outgrow socket buffers)."""
+        def sender():
+            try:
+                for off, ln in self._chunks(len(view)):
+                    self._chaos_chunk()
+                    conn.send_frame(view[off:off + ln])
+            except BaseException as e:  # noqa: BLE001
+                err[0] = e
+
+        t = threading.Thread(target=sender, daemon=True,
+                             name=f"coll-send-{self.name}-{self.rank}")
+        t.start()
+        return t
+
+    def _recv_into(self, conn, view: memoryview,
+                   deadline: float) -> None:
+        """Receive a chunk-framed stream into ``view`` (frame sizes
+        mirror the sender's chunking).  Re-armed per frame: the socket
+        timeout must track the SHRINKING remaining budget, or a
+        trickling peer gets a full budget per frame (64 chunks x the
+        deadline) instead of failing typed within it."""
+        got = 0
+        n = len(view)
+        while got < n:
+            self._arm(deadline)
+            got += conn.recv_frame_into(view[got:])
+
+    # ----------------------------------------------------------------- ops
+    def allreduce(self, value, op: str = "sum", *,
+                  timeout: Optional[float] = None):
+        """Elementwise ``op`` reduction of ``value`` across all ranks;
+        every rank returns the identical full result.  Accepts numpy or
+        ``jax.Array`` (returned as the same kind; jax results are
+        ``device_put`` with the input's sharding when reconstructable)."""
+        if op not in _REDUCE_OPS:
+            raise ValueError(f"unknown reduce op {op!r} "
+                             f"(have {sorted(_REDUCE_OPS)})")
+        return self._run("allreduce", self._allreduce_host, value,
+                         timeout, op=op)
+
+    def allgather(self, value, *, timeout: Optional[float] = None):
+        """Stack every rank's ``value`` along a new leading axis
+        (result shape ``(world_size, *value.shape)``, identical on all
+        ranks)."""
+        return self._run("allgather", self._allgather_host, value,
+                         timeout, stacked=True)
+
+    def broadcast(self, value, root: int = 0, *,
+                  timeout: Optional[float] = None):
+        """Every rank returns root's ``value`` (non-root inputs supply
+        only shape/dtype)."""
+        return self._run("broadcast", self._broadcast_host, value,
+                         timeout, root=root)
+
+    def _run(self, opname: str, fn, value, timeout, stacked=False,
+             **kw):
+        from ..cluster.serialization import _export_host
+        from ..experimental import chaos
+
+        if self._closed:
+            raise ChannelError(
+                f"collective group {self.name!r} is closed",
+                context={"group": self.name, "rank": self.rank})
+        was_jax = _is_jax_array(value)
+        host = _export_host(value) if not isinstance(value, np.ndarray) \
+            else np.ascontiguousarray(value)
+        deadline = self._deadline(timeout)
+        if deadline <= time.monotonic():
+            # Shed, don't sever: no byte has moved, the ring is still
+            # consistent — an inherited already-expired request budget
+            # (PR 5 plane) must not cost the gang its group.
+            from ..exceptions import DeadlineExceededError
+
+            raise DeadlineExceededError(
+                f"collective {opname} shed: deadline expired before "
+                f"the op started (group={self.name!r} "
+                f"rank={self.rank})")
+        try:
+            chaos.on_rpc(f"collective_{opname}")
+            with self._lock:  # one op at a time per member (SPMD order)
+                if self.world_size == 1:
+                    out = np.stack([host]) if stacked else host.copy()
+                else:
+                    self._arm(deadline)
+                    out = fn(host, deadline, **kw)
+        except (ConnectionError, TimeoutError, OSError) as e:
+            # close() outside the lock: teardown retracts the KV
+            # endpoint over a head RPC, which must not stall a
+            # concurrent op thread blocked on the lock.
+            self.close()
+            raise self._error(opname, e) from e
+        if was_jax:
+            from ..cluster.serialization import (_device_put_host,
+                                                 _sharding_desc)
+
+            return _device_put_host(
+                out, None if stacked else _sharding_desc(value))
+        return out
+
+    # ring reduce-scatter + allgather
+    def _allreduce_host(self, host: np.ndarray, deadline: float, *,
+                        op: str) -> np.ndarray:
+        n = self.world_size
+        ufunc = _REDUCE_OPS[op]
+        acc = host.copy()
+        flat = acc.reshape(-1)
+        # ml_dtypes (bfloat16, float8) accumulate exactly like jax
+        # would on-chip; numpy ufuncs dispatch through ml_dtypes.
+        bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
+        segs = [(int(bounds[i]), int(bounds[i + 1])) for i in range(n)]
+        longest = max(b - a for a, b in segs)
+        # Double-buffered staging: recv chunk k+1 lands while chunk k
+        # reduces (the send thread keeps the outbound side streaming
+        # concurrently).
+        staging = np.empty(longest, dtype=flat.dtype)
+        sview = memoryview(staging.view(np.uint8))
+        item = flat.dtype.itemsize
+        err: List[Optional[BaseException]] = [None]
+
+        for step in range(n - 1):
+            self._arm(deadline)
+            s_out = segs[(self.rank - step) % n]
+            s_in = segs[(self.rank - step - 1) % n]
+            out_v = memoryview(
+                flat[s_out[0]:s_out[1]].view(np.uint8))
+            t = self._send_view(self._next, out_v, err)
+            in_len = s_in[1] - s_in[0]
+            got = 0
+            while got < in_len:
+                self._arm(deadline)  # per-frame: budget shrinks
+                self._chaos_chunk()
+                nb = self._prev.recv_frame_into(
+                    sview[got * item:in_len * item])
+                nrecv = nb // item
+                # Reduce the landed chunk immediately — the next frame
+                # is already in flight behind it.
+                ufunc(flat[s_in[0] + got:s_in[0] + got + nrecv],
+                      staging[got:got + nrecv],
+                      out=flat[s_in[0] + got:s_in[0] + got + nrecv])
+                got += nrecv
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+            if err[0] is not None:
+                raise err[0]
+            if t.is_alive():
+                raise TimeoutError("collective send stalled")
+        # Allgather phase: circulate the now-complete segments.
+        self._ring_allgather_segments(flat, segs, deadline,
+                                      start=self.rank + 1)
+        return acc
+
+    def _ring_allgather_segments(self, flat: np.ndarray, segs,
+                                 deadline: float, start: int) -> None:
+        n = self.world_size
+        err: List[Optional[BaseException]] = [None]
+        for step in range(n - 1):
+            self._arm(deadline)
+            s_out = segs[(start - step) % n]
+            s_in = segs[(start - step - 1) % n]
+            out_v = memoryview(flat[s_out[0]:s_out[1]].view(np.uint8))
+            in_v = memoryview(flat[s_in[0]:s_in[1]].view(np.uint8))
+            t = self._send_view(self._next, out_v, err)
+            self._recv_into(self._prev, in_v, deadline)
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+            if err[0] is not None:
+                raise err[0]
+            if t.is_alive():
+                raise TimeoutError("collective send stalled")
+
+    def _allgather_host(self, host: np.ndarray,
+                        deadline: float) -> np.ndarray:
+        n = self.world_size
+        out = np.empty((n,) + host.shape, dtype=host.dtype)
+        out[self.rank] = host
+        flat = out.reshape(n, -1)
+        seg = flat.shape[1]
+        segs = [(r * seg, (r + 1) * seg) for r in range(n)]
+        self._ring_allgather_segments(flat.reshape(-1), segs, deadline,
+                                      start=self.rank)
+        return out
+
+    def _broadcast_host(self, host: np.ndarray, deadline: float, *,
+                        root: int) -> np.ndarray:
+        n = self.world_size
+        out = host if self.rank == root else np.empty_like(host)
+        view = memoryview(out.reshape(-1).view(np.uint8))
+        is_root = self.rank == root
+        next_is_root = (self.rank + 1) % n == root
+        err: List[Optional[BaseException]] = [None]
+        self._arm(deadline)
+        if is_root:
+            t = self._send_view(self._next, view, err)
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+            if err[0] is not None:
+                raise err[0]
+            if t.is_alive():
+                raise TimeoutError("collective send stalled")
+            return out
+        # Pipeline hop: forward each landed chunk before reading the
+        # next — ring depth costs one chunk of latency, not one
+        # payload.
+        got = 0
+        total = len(view)
+        while got < total:
+            self._arm(deadline)  # per-frame: budget shrinks
+            self._chaos_chunk()
+            nb = self._prev.recv_frame_into(view[got:])
+            if not next_is_root:
+                self._next.send_frame(view[got:got + nb])
+            got += nb
+        return out
+
+    # ------------------------------------------------------------- pytree
+    def allreduce_tree(self, tree, op: str = "sum", *,
+                       timeout: Optional[float] = None):
+        """Allreduce every array leaf of a pytree in ONE ring pass:
+        leaves pack into a single contiguous buffer (per dtype), so a
+        million tiny gradient tensors cost one collective, not a
+        million."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            return tree
+        from ..cluster.serialization import _export_host
+
+        hosts = [_export_host(x) if not isinstance(x, np.ndarray)
+                 else np.ascontiguousarray(x) for x in leaves]
+        was_jax = [_is_jax_array(x) for x in leaves]
+        by_dtype: Dict[Any, List[int]] = {}
+        for i, h in enumerate(hosts):
+            by_dtype.setdefault(h.dtype, []).append(i)
+        out_hosts: List[Optional[np.ndarray]] = [None] * len(hosts)
+        for dtype, idxs in by_dtype.items():
+            packed = np.concatenate(
+                [hosts[i].reshape(-1) for i in idxs]) if len(idxs) > 1 \
+                else hosts[idxs[0]].reshape(-1)
+            reduced = self.allreduce(packed, op, timeout=timeout)
+            off = 0
+            for i in idxs:
+                size = hosts[i].size
+                out_hosts[i] = np.asarray(
+                    reduced[off:off + size]).reshape(hosts[i].shape)
+                off += size
+        from ..cluster.serialization import (_device_put_host,
+                                             _sharding_desc)
+
+        outs = []
+        for i, h in enumerate(out_hosts):
+            if was_jax[i]:
+                # Reapply the input leaf's sharding (same contract as
+                # allreduce): gradients must land where the optimizer
+                # step expects them, not all on device 0.
+                outs.append(_device_put_host(
+                    h, _sharding_desc(leaves[i])))
+            else:
+                outs.append(h)
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    # ------------------------------------------------------------ teardown
+    def _teardown(self) -> None:
+        retract_endpoint(self.name, self.rank)
+        for conn in (getattr(self, "_next", None),
+                     getattr(self, "_prev", None)):
+            if conn is not None:
+                conn.close()
+        self._server.shutdown()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._teardown()
+
+    def __enter__(self) -> "CollectiveGroup":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Named-group registry (module-level convenience API)
+# ---------------------------------------------------------------------------
+
+_groups: Dict[str, CollectiveGroup] = {}
+_groups_lock = threading.Lock()
+
+
+def create_group(name: str, rank: int, world_size: int, *,
+                 timeout: float = 60.0) -> CollectiveGroup:
+    """Create (and register) this process/actor's membership in a
+    named group.  All ``world_size`` members must call this."""
+    # Close any old same-named group BEFORE constructing the new one:
+    # close() retracts the rendezvous endpoint key, which would delete
+    # the key the new group just published and strand other members
+    # still polling resolve_members.
+    with _groups_lock:
+        old = _groups.pop(name, None)
+    if old is not None:
+        old.close()
+    g = CollectiveGroup(name, rank, world_size, timeout=timeout)
+    with _groups_lock:
+        _groups[name] = g
+    return g
+
+
+def get_group(name: str) -> Optional[CollectiveGroup]:
+    with _groups_lock:
+        return _groups.get(name)
+
+
+def destroy_group(name: str) -> None:
+    with _groups_lock:
+        g = _groups.pop(name, None)
+    if g is not None:
+        g.close()
+
+
+def allreduce(value, op: str = "sum", *, group: str = "default",
+              timeout: Optional[float] = None):
+    return _require(group).allreduce(value, op, timeout=timeout)
+
+
+def allgather(value, *, group: str = "default",
+              timeout: Optional[float] = None):
+    return _require(group).allgather(value, timeout=timeout)
+
+
+def broadcast(value, root: int = 0, *, group: str = "default",
+              timeout: Optional[float] = None):
+    return _require(group).broadcast(value, root, timeout=timeout)
+
+
+def _require(name: str) -> CollectiveGroup:
+    g = get_group(name)
+    if g is None:
+        raise ValueError(
+            f"no collective group {name!r} in this process — call "
+            f"ray_tpu.collectives.create_group(...) on every member "
+            f"first")
+    return g

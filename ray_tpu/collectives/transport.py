@@ -1,0 +1,280 @@
+"""Collective transport: peer endpoints, rendezvous, framed sends.
+
+Each group member runs one :class:`PeerServer` (a raw TCP listener,
+sibling of the object plane's ObjectStreamServer) and dials its ring
+neighbours directly — collective traffic never touches the framed RPC
+plane or the head.  Rendezvous publishes each member's endpoint under
+``__collectives__/<group>/<rank>`` in the head KV store (cluster mode)
+or a process-local registry (local mode, where "members" are actors
+sharing one process), then polls until the full membership is visible.
+
+Wire protocol per peer connection (persistent for the group's life):
+
+  handshake -> [8-byte len][pickle ("__coll__", group, from_rank)]
+  then raw  -> [8-byte length][payload bytes] frames in both directions
+
+Sends go out via ``sendall``/``sendmsg`` from live memoryviews; reads
+``recv_into`` preallocated staging buffers — both sides GIL-released,
+same discipline as the object plane's raw stream path.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_LEN8 = struct.Struct(">Q")
+_KV_NS = "__collectives__"
+
+# Local-mode rendezvous: {group: {rank: address}} shared by the
+# process's actor threads.
+_local_groups: Dict[str, Dict[int, str]] = {}
+_local_cond = threading.Condition()
+
+
+def _tune(sock: socket.socket) -> None:
+    from ..cluster.rpc import _tune_socket
+
+    _tune_socket(sock)
+
+
+class PeerConnection:
+    """One framed, bidirectional peer link."""
+
+    __slots__ = ("sock", "peer_rank")
+
+    def __init__(self, sock: socket.socket, peer_rank: int):
+        self.sock = sock
+        self.peer_rank = peer_rank
+
+    def send_frame(self, *bufs) -> None:
+        from ..cluster.rpc import sendmsg_all
+
+        total = sum(len(b) for b in bufs)
+        sendmsg_all(self.sock, [memoryview(_LEN8.pack(total)), *bufs])
+
+    def recv_frame_into(self, view: memoryview) -> int:
+        """Read one frame into ``view`` (must be large enough);
+        returns the frame length."""
+        from ..cluster.rpc import _recv_exact
+
+        (n,) = _LEN8.unpack(_recv_exact(self.sock, 8))
+        if n > len(view):
+            raise ConnectionError(
+                f"oversize collective frame ({n} > {len(view)})")
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(view[got:n], n - got)
+            if r == 0:
+                raise ConnectionError("peer closed mid-frame")
+            got += r
+        return n
+
+    def settimeout(self, t: Optional[float]) -> None:
+        self.sock.settimeout(t)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _advertised_host() -> str:
+    """The host other group members should dial: this node's
+    cluster-advertised address (the same interface the object plane's
+    ObjectStreamServer binds), loopback only in local mode."""
+    cl = _cluster()
+    if cl is not None:
+        try:
+            return cl.address.rsplit(":", 1)[0]
+        except (AttributeError, ValueError):
+            pass
+    return "127.0.0.1"
+
+
+class PeerServer:
+    """Accepts tagged peer connections for one group member."""
+
+    def __init__(self, group: str, rank: int,
+                 host: Optional[str] = None):
+        self.group = group
+        self.rank = rank
+        if host is None:
+            host = _advertised_host()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(16)
+        self.address = "%s:%d" % self._sock.getsockname()
+        self._inbox: Dict[int, socket.socket] = {}
+        self._cond = threading.Condition()
+        self._stopped = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"coll-{group}-{rank}").start()
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            _tune(conn)
+            threading.Thread(target=self._handshake, args=(conn,),
+                             daemon=True).start()
+
+    def _handshake(self, conn: socket.socket):
+        from ..cluster.rpc import _recv_exact
+
+        try:
+            conn.settimeout(30.0)
+            (n,) = _LEN8.unpack(_recv_exact(conn, 8))
+            tag, group, from_rank = pickle.loads(
+                bytes(_recv_exact(conn, n)))
+            if tag != "__coll__" or group != self.group:
+                raise ConnectionError(f"bad handshake {tag!r}/{group!r}")
+            conn.settimeout(None)
+            with self._cond:
+                self._inbox[int(from_rank)] = conn
+                self._cond.notify_all()
+        except (ConnectionError, OSError, EOFError,
+                pickle.UnpicklingError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def accept_peer(self, from_rank: int,
+                    timeout: float) -> PeerConnection:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while from_rank not in self._inbox:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._stopped.is_set():
+                    raise TimeoutError(
+                        f"group {self.group!r} rank {self.rank}: peer "
+                        f"{from_rank} never connected")
+                self._cond.wait(left)
+            return PeerConnection(self._inbox.pop(from_rank), from_rank)
+
+    def shutdown(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._cond:
+            for conn in self._inbox.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._inbox.clear()
+            self._cond.notify_all()
+
+
+def connect_peer(address: str, group: str, my_rank: int,
+                 timeout: float) -> PeerConnection:
+    """Dial a peer's PeerServer, retrying until it is up (members
+    start in any order) or the deadline passes."""
+    host, port = address.rsplit(":", 1)
+    deadline = time.monotonic() + timeout
+    last: Optional[BaseException] = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection(
+                (host, int(port)),
+                timeout=max(0.1, min(5.0, deadline - time.monotonic())))
+            _tune(sock)
+            hs = pickle.dumps(("__coll__", group, my_rank))
+            sock.sendall(_LEN8.pack(len(hs)) + hs)
+            return PeerConnection(sock, -1)
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise ConnectionError(
+        f"cannot reach collective peer {address} for group "
+        f"{group!r}: {last}")
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous
+# ---------------------------------------------------------------------------
+
+def _cluster() :
+    try:
+        from ..core.runtime import get_runtime
+
+        rt = get_runtime()
+        return rt.cluster
+    except Exception:
+        return None
+
+
+def publish_endpoint(group: str, rank: int, address: str) -> None:
+    cl = _cluster()
+    if cl is not None:
+        cl.kv_put(f"{group}/{rank}", address, ns=_KV_NS)
+        return
+    with _local_cond:
+        _local_groups.setdefault(group, {})[rank] = address
+        _local_cond.notify_all()
+
+
+def resolve_members(group: str, world_size: int,
+                    timeout: float) -> List[str]:
+    """Block until every rank's endpoint is published; returns
+    addresses indexed by rank."""
+    cl = _cluster()
+    deadline = time.monotonic() + timeout
+    if cl is None:
+        with _local_cond:
+            while len(_local_groups.get(group, {})) < world_size:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"collective rendezvous for {group!r} timed "
+                        f"out at {len(_local_groups.get(group, {}))}"
+                        f"/{world_size} members")
+                _local_cond.wait(left)
+            members = _local_groups[group]
+            return [members[r] for r in range(world_size)]
+    # Incremental scan: each endpoint is fetched from the head exactly
+    # once (ranks publish before polling, so a seen key never changes
+    # within one formation) — a tick costs one kv_get for the first
+    # still-missing rank, not world_size of them.  Keeps head RPC load
+    # linear in gang size instead of quadratic-at-20Hz.
+    found: List[str] = []
+    while True:
+        while len(found) < world_size:
+            v = cl.kv_get(f"{group}/{len(found)}", ns=_KV_NS)
+            if v is None:
+                break
+            found.append(v)
+        if len(found) == world_size:
+            return found
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective rendezvous for {group!r} timed out at "
+                f"{len(found)}/{world_size} members")
+        time.sleep(0.05)
+
+
+def retract_endpoint(group: str, rank: int) -> None:
+    cl = _cluster()
+    if cl is not None:
+        try:
+            cl.kv_del(f"{group}/{rank}", ns=_KV_NS)
+        except Exception:
+            pass  # head unreachable at teardown: keys expire unused
+        return
+    with _local_cond:
+        members = _local_groups.get(group)
+        if members is not None:
+            members.pop(rank, None)
+            if not members:
+                _local_groups.pop(group, None)

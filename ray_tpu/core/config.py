@@ -137,10 +137,16 @@ _d("object_shm_min_bytes", int, 1024 * 1024,
    "Primary copies at or above this size are re-homed to shared "
    "memory at seal time; 0 disables.")
 _d("object_pull_streams", int, 4,
-   "Parallel TCP connections per chunked pull.  One socket serializes "
-   "all chunks behind one reader thread (~0.8 GB/s loopback); striping "
-   "chunks over N sockets multiplies throughput until memory "
-   "bandwidth (recv copies release the GIL).")
+   "Cap on parallel TCP connections per chunked pull/push.  One socket "
+   "serializes all chunks behind one reader thread (~0.8 GB/s "
+   "loopback); striping chunks over N sockets multiplies throughput "
+   "until memory bandwidth (recv copies release the GIL).  The actual "
+   "stream count adapts to payload size (cluster/geometry.py): small "
+   "payloads ride one stream, big ones scale up to this cap.")
+_d("object_stream_stripe_bytes", int, 16 * 1024 * 1024,
+   "Payload bytes per additional transfer stream: a pull/push opens "
+   "ceil(total / this) streams, capped at object_pull_streams "
+   "(cluster/geometry.py adaptive geometry).")
 _d("object_broadcast_fanout", int, 2,
    "Children per node in the push-based broadcast tree "
    "(push_manager.h:30 analogue; depth = log_fanout(n)).")

@@ -142,12 +142,18 @@ class LocalObjectStore:
             meta, bufs = wire_layout(sealed)
             total = wire_size(meta)
             with open(path, "wb+") as f:
-                # posix_fallocate, NOT truncate: truncate on tmpfs
-                # reserves nothing, so running out of /dev/shm mid-copy
-                # is a SIGBUS (process death), not a catchable error.
-                os.posix_fallocate(f.fileno(), 0, total)
+                # Sequential write(), NOT fallocate + mmap fill: write()
+                # lands user bytes straight into fresh tmpfs pages (one
+                # pass of page traffic), where fallocate zero-commits
+                # every page first and the memcpy re-dirties it — 3x
+                # slower measured at 256 MB.  Running out of /dev/shm
+                # mid-copy stays a catchable ENOSPC (write reserves as
+                # it goes), never the SIGBUS a sparse truncate+store
+                # would be.
+                for b in bufs:
+                    f.write(b)
+                f.flush()
                 mm = mmap.mmap(f.fileno(), total)
-            self._fill_shm(mm, bufs)
             return (path, mm, meta)
         except OSError:
             try:
@@ -155,19 +161,6 @@ class LocalObjectStore:
             except OSError:
                 pass
             return None
-
-    @staticmethod
-    def _fill_shm(mm, bufs) -> None:
-        """Copy the flat layout into the mapping.  Plain memoryview
-        slice assignment, deliberately: it measured 8x faster than a
-        GIL-releasing numpy copy under a loaded cluster (the released
-        GIL wakes idle runtime threads, which burn the cgroup CPU quota
-        the memcpy needs)."""
-        off = 0
-        mv = memoryview(mm)
-        for b in bufs:
-            mv[off:off + len(b)] = b
-            off += len(b)
 
     @staticmethod
     def _discard_shm(shm) -> None:

@@ -31,6 +31,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams → CompilerParams; accept either.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 LANES = 128
 # 1024 measured end-to-end on the 440M train bench (v5e, chained steps
 # with host readback): 22.5k tok/s vs 18.9k at 512 and 14.9k at 256 —
@@ -197,7 +201,7 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -345,7 +349,7 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
         out_specs=pl.BlockSpec((1, 1, bq, D), q_map),
         out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -385,7 +389,7 @@ def _bwd_impl(q, k, v, o, lse, do, *, causal, block_q, block_k,
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

@@ -11,8 +11,10 @@ Public surface mirrors ray.train:
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import (CheckpointConfig, FailureConfig, Result, RunConfig,
                      ScalingConfig)
-from .session import (get_checkpoint, get_context, get_dataset_shard,
-                      make_temp_checkpoint_dir, report)
+from .session import (allreduce_gradients, get_checkpoint,
+                      get_collective_group, get_context,
+                      get_dataset_shard, make_temp_checkpoint_dir,
+                      report)
 from .trainer import JaxTrainer, TrainingFailedError
 
 __all__ = [
@@ -30,4 +32,6 @@ __all__ = [
     "get_checkpoint",
     "get_dataset_shard",
     "make_temp_checkpoint_dir",
+    "allreduce_gradients",
+    "get_collective_group",
 ]

@@ -24,7 +24,7 @@ class TrainContext:
                  mesh=None, experiment_name: str = "",
                  storage_path: str = "", datasets=None,
                  latest_checkpoint: Optional[Checkpoint] = None,
-                 colocated: bool = True):
+                 colocated: bool = True, collective_group=None):
         self._rank = rank
         self._world_size = world_size
         self._local_rank = local_rank
@@ -34,6 +34,10 @@ class TrainContext:
         # only works when all `world` consumers live in one process.
         self._colocated = colocated
         self.mesh = mesh
+        # DCN collective group (ray_tpu.collectives) spanning the gang,
+        # when the trainer set one up — the gradient-sync path for
+        # gangs without a shared jax runtime.
+        self.collective_group = collective_group
         self._experiment_name = experiment_name
         self._storage_path = storage_path
         self._datasets = datasets or {}
@@ -108,6 +112,43 @@ def report(metrics: Dict[str, Any],
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return _get_session().latest_checkpoint
+
+
+def get_collective_group():
+    """The gang's DCN collective group (ray_tpu.collectives), or None
+    when the gang shares one jax runtime (use psum over the mesh)."""
+    return _get_session().context.collective_group
+
+
+def allreduce_gradients(grads, op: str = "mean"):
+    """Synchronize a gradient pytree across the worker gang over the
+    DCN collective plane (docs/networking.md).
+
+    The data-parallel contract: every rank calls this with its local
+    gradients and receives the gang-wide ``sum`` (or ``mean``) — the
+    cross-host analogue of ``jax.lax.pmean`` for gangs that do NOT
+    share a jax runtime.  Single-worker gangs return ``grads``
+    unchanged; gangs with a shared mesh should psum inside their jitted
+    step instead (ICI beats DCN)."""
+    ctx = get_context()
+    group = ctx.collective_group
+    if group is None:
+        if ctx.get_world_size() == 1:
+            return grads
+        raise RuntimeError(
+            "no DCN collective group in this session — the trainer "
+            "sets one up for cross-process gangs without a shared "
+            "mesh; for shared-mesh gangs psum inside the step "
+            "(ICI), or call WorkerGroup.setup_collectives() "
+            "explicitly")
+    reduce_op = "sum" if op in ("sum", "mean") else op
+    out = group.allreduce_tree(grads, reduce_op)
+    if op == "mean":
+        import jax
+
+        n = float(ctx.get_world_size())
+        out = jax.tree_util.tree_map(lambda x: x / n, out)
+    return out
 
 
 def get_dataset_shard(dataset_name: str = "train"):

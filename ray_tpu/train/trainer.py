@@ -100,6 +100,15 @@ class JaxTrainer:
                     coordinator = ray_tpu.get(
                         group.workers[0].reserve_coordinator.remote())
                     group.run_all("setup_distributed", coordinator)
+                elif (not colocated
+                        and self.scaling_config.num_workers > 1):
+                    # No shared jax runtime across the gang: gradient
+                    # sync rides the DCN collective ring instead
+                    # (session.allreduce_gradients → ring allreduce,
+                    # docs/networking.md).  Fresh uuid-suffixed group
+                    # name per attempt — a restarted gang must never
+                    # rendezvous against a dead gang's stale endpoints.
+                    group.setup_collectives()
                 datasets = self.datasets
                 if not colocated and datasets:
                     # Cross-process gang: host ONE shared execution per

@@ -83,9 +83,30 @@ class _TrainWorker:
     def __init__(self, rank: int, world_size: int):
         self.rank = rank
         self.world_size = world_size
+        self._collective_group = None
 
     def identity(self):
         return process_identity()
+
+    def setup_collectives(self, group_name: str,
+                          timeout: float = 60.0) -> bool:
+        """Join the gang's DCN collective ring (ray_tpu.collectives):
+        the gradient-sync/weight-distribution path for gangs without a
+        shared jax runtime.  Collective: every worker must be called
+        (rendezvous blocks until the ring closes)."""
+        from ray_tpu.collectives.group import CollectiveGroup
+
+        if self._collective_group is not None:
+            self._collective_group.close()
+        self._collective_group = CollectiveGroup(
+            group_name, self.rank, self.world_size, timeout=timeout)
+        return True
+
+    def teardown_collectives(self) -> bool:
+        if self._collective_group is not None:
+            self._collective_group.close()
+            self._collective_group = None
+        return True
 
     def reserve_coordinator(self) -> str:
         """Rank 0: reserve a host:port for the jax coordination service
@@ -158,7 +179,8 @@ class _TrainWorker:
             rank=self.rank, world_size=self.world_size,
             mesh=mesh, experiment_name=experiment_name,
             storage_path=storage_path, datasets=datasets,
-            latest_checkpoint=latest, colocated=colocated)
+            latest_checkpoint=latest, colocated=colocated,
+            collective_group=self._collective_group)
         _set_session(_Session(ctx, collector, latest))
         try:
             if mesh is not None:
@@ -219,7 +241,31 @@ class WorkerGroup:
     def run_all_async(self, method: str, *args):
         return [getattr(w, method).remote(*args) for w in self.workers]
 
+    def setup_collectives(self, group_name: Optional[str] = None,
+                          timeout: float = 60.0) -> str:
+        """Form one DCN collective ring across the gang (all workers
+        rendezvous concurrently); returns the group name."""
+        import uuid
+
+        name = group_name or f"__train__/{uuid.uuid4().hex[:12]}"
+        ray_tpu.get([w.setup_collectives.remote(name, timeout)
+                     for w in self.workers])
+        self._has_collectives = True
+        return name
+
     def shutdown(self):
+        # Retract collective rendezvous keys before killing: the head
+        # KV entries outlive the actors, so a kill-only shutdown would
+        # leak one __collectives__/<group>/<rank> key per worker per
+        # training run.  Best-effort and bounded — dead workers' keys
+        # are the restart path's problem (fresh uuid per attempt).
+        if getattr(self, "_has_collectives", False):
+            try:
+                ray_tpu.get([w.teardown_collectives.remote()
+                             for w in self.workers], timeout=10.0)
+            except Exception:
+                pass
+            self._has_collectives = False
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

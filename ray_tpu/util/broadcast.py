@@ -7,13 +7,30 @@ release envelope includes 1 GiB broadcast to 50+ nodes
 (cluster/client.py broadcast_object): the source uploads ``fanout``
 copies, recipients relay to their subtrees.  Same-host recipients mmap
 the source's /dev/shm flat layout (no bytes move); everyone else gets
-a PIPELINED CHUNK STREAM (push_stream_* RPCs) whose chunks forward to
-the next hop as they arrive — a depth-d relay tree streams at ~line
-rate instead of d serial whole-payload store-and-forwards.
+a STRIPED, PIPELINED CHUNK STREAM (push_stream_* control RPCs + raw
+push sockets, docs/networking.md) whose chunks forward to the next hop
+as they arrive — a depth-d relay tree streams at ~line rate instead of
+d serial whole-payload store-and-forwards.
+
+Device arrays ride the same path natively: ``jax.Array`` leaves export
+zero-copy (dlpack) into the wire layout with a header-only metadata
+frame (dtype incl. bfloat16, shape, sharding), and each recipient
+rebuilds with ``device_put`` straight from its staging buffer — so
+weight distribution (model shards, optimizer state) costs one
+device→host transfer at the source and one host→device per recipient,
+with no pickle round-trip of the bytes in between.
 
 Typical use: ship a big read-only array (tokenizer table, eval set,
 model shard) to every node before a task wave, so the wave's
 dependency resolution hits local copies instead of serializing pulls.
+A severed or dead relay hop raises a typed
+:class:`~ray_tpu.exceptions.ChannelError` naming the subtree — never a
+hang (the stream read deadline bounds every hop).
+
+For in-place array broadcast *within a collective gang* (every member
+gets the value as an array, not an object ref), see
+``ray_tpu.collectives.broadcast`` — it pipelines chunks around the
+group ring instead of the cluster-wide fanout tree.
 """
 
 from __future__ import annotations
@@ -27,8 +44,9 @@ def broadcast(ref, node_ids: Optional[List[str]] = None,
 
     ``node_ids``: target node ids (default: every other alive node).
     Returns the number of nodes that received a copy.  Copies are
-    registered as borrowers with the owner, so the object stays alive
-    until they go out of scope.  No-op (returns 0) in local mode.
+    CACHES (plasma foreign entries, no borrower holds at the owner):
+    keep the ref alive through the task wave that uses it; idle copies
+    are swept.  No-op (returns 0) in local mode.
     """
     from ..core.runtime import get_runtime
 
@@ -40,3 +58,21 @@ def broadcast(ref, node_ids: Optional[List[str]] = None,
         by_id = {n["node_id"]: n for n in rt.cluster.list_nodes()}
         addresses = [by_id[i]["address"] for i in node_ids if i in by_id]
     return rt.cluster.broadcast_object(ref, addresses, timeout=timeout)
+
+
+def broadcast_value(value, node_ids: Optional[List[str]] = None,
+                    timeout: float = 600.0):
+    """``put`` + :func:`broadcast` in one step: seal ``value`` (device
+    arrays export zero-copy), replicate it cluster-wide, and return the
+    ref for the task wave that consumes it.
+
+    The weight-distribution idiom::
+
+        ref = broadcast_value(params)          # one striped tree push
+        ray_tpu.get([load.remote(ref, i) for i in range(n)])
+    """
+    import ray_tpu
+
+    ref = ray_tpu.put(value)
+    broadcast(ref, node_ids, timeout=timeout)
+    return ref

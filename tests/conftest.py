@@ -42,11 +42,13 @@ class ChaosHangGuardTimeout(BaseException):
 
 @pytest.fixture(autouse=True)
 def _chaos_hang_guard(request):
-    # overload tests share the guard: their failure mode is ALSO a
-    # hang (a shed point that never fires leaves waiters queued
-    # forever under sustained load).
+    # overload and net tests share the guard: their failure mode is
+    # ALSO a hang (a shed point that never fires leaves waiters queued
+    # forever under sustained load; a wedged collective ring blocks
+    # every member on a recv that never lands).
     if request.node.get_closest_marker("chaos") is None and \
-            request.node.get_closest_marker("overload") is None:
+            request.node.get_closest_marker("overload") is None and \
+            request.node.get_closest_marker("net") is None:
         yield
         return
     import signal

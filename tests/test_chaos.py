@@ -433,3 +433,60 @@ class TestServeHandleFailover:
                 assert handle.remote(i).result(timeout=30)[0] == "ok"
         finally:
             serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Broadcast relay tree under chaos (the striped push data plane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.net
+class TestBroadcastRelaySever:
+    def test_severed_mid_tree_hop_fails_typed_and_releases_refs(self):
+        """A mid-tree relay hop severs its subtree mid-stream (env
+        chaos budget on the raw push path): the source gets a typed
+        ChannelError within the read deadline, the source holds no
+        borrower registrations for the object (copies are caches, not
+        borrows), and a retry after the fault budget drains succeeds —
+        no wedged stream sessions."""
+        from ray_tpu.cluster.cluster_utils import Cluster
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        ray_tpu.shutdown()
+        c = Cluster()
+        # n1 is the mid-tree hop: its FIRST raw relay chunk raises.
+        c.add_node(num_cpus=1, name="n1", env={
+            "RAY_TPU_TESTING_RPC_FAILURE": "push_raw_chunk=1"})
+        c.add_node(num_cpus=1, name="n2")
+        c.connect(num_cpus=1)
+        try:
+            # Force the wire path (no shm mmap shortcut) and a chain
+            # topology: driver -> n1 -> n2.
+            GLOBAL_CONFIG.set("object_shm_min_bytes", 0)
+            GLOBAL_CONFIG.set("object_broadcast_fanout", 1)
+            rt = ray_tpu.get_runtime()
+            nodes = {n["name"]: n["address"]
+                     for n in rt.cluster.list_nodes()
+                     if n.get("alive") and n["name"]}
+            payload = np.zeros(12 * 1024 * 1024, dtype=np.uint8)
+            ref = ray_tpu.put(payload)
+            oid = ref.object_id()
+            t0 = time.monotonic()
+            with pytest.raises(ChannelError) as ei:
+                rt.cluster.broadcast_object(
+                    ref, [nodes["n1"], nodes["n2"]], timeout=20.0)
+            assert time.monotonic() - t0 < 20.0, "not within deadline"
+            assert "subtree_root" in ei.value.context
+            # No leaked borrower registrations at the source: pushed
+            # copies are caches, never borrows.
+            entry = rt.reference_counter._refs.get(oid)
+            assert entry is None or not entry.borrowers
+            # The fault budget is spent; a retry must stream cleanly
+            # through the SAME hop (no wedged session state anywhere
+            # in the tree).
+            n = rt.cluster.broadcast_object(
+                ref, [nodes["n1"], nodes["n2"]], timeout=30.0)
+            assert n == 2
+        finally:
+            GLOBAL_CONFIG.reset()
+            ray_tpu.shutdown()
+            c.shutdown()

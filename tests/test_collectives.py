@@ -1,0 +1,339 @@
+"""DCN collectives: ring allreduce/allgather/broadcast, typed failure
+under peer death, and the train gradient-sync wiring.
+
+Reference role: the DCN half of the collective story (ROADMAP item 1;
+SNIPPETS pjit multi-process notes are the ICI half) — gradient sync
+for gangs without a shared jax runtime, weight distribution, and the
+parity contract: the ring result must match the single-process
+reference within dtype tolerance.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collectives.group import CollectiveGroup
+from ray_tpu.exceptions import ChannelError
+
+pytestmark = pytest.mark.net
+
+
+def _run_members(n, fn, timeout=60.0):
+    """Run fn(rank) on n threads (local-mode members); returns results
+    indexed by rank, raising the first member error."""
+    results = [None] * n
+    errs = [None] * n
+
+    def main(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001
+            errs[r] = e
+
+    threads = [threading.Thread(target=main, args=(r,), daemon=True)
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    for e in errs:
+        if e is not None:
+            raise e
+    assert not any(t.is_alive() for t in threads), "member wedged"
+    return results
+
+
+class TestRingOps:
+    def test_allreduce_parity_vs_single_process(self):
+        """The acceptance contract: ring allreduce equals the
+        single-process reference within dtype tolerance."""
+        n = 3
+        datas = [np.random.default_rng(r).standard_normal(
+            10_007).astype(np.float32) for r in range(n)]
+        ref = datas[0] + datas[1] + datas[2]
+
+        def member(r):
+            with CollectiveGroup("ar-parity", r, n, timeout=30) as g:
+                return g.allreduce(datas[r], "sum")
+
+        for out in _run_members(n, member):
+            # Ring segment order differs from left-to-right summation;
+            # equality holds to f32 rounding (dtype tolerance).
+            np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+    def test_allreduce_ops_and_world_size_one(self):
+        def member(r):
+            with CollectiveGroup("ar-max", r, 2, timeout=30) as g:
+                return g.allreduce(
+                    np.array([r + 1, 10 - r], np.int64), "max")
+
+        for out in _run_members(2, member):
+            np.testing.assert_array_equal(out, [2, 10])
+        with CollectiveGroup("solo", 0, 1) as g:
+            np.testing.assert_array_equal(
+                g.allreduce(np.arange(4), "sum"), np.arange(4))
+            assert g.allgather(np.arange(4)).shape == (1, 4)
+
+    def test_allgather_stacks_all_ranks(self):
+        n = 3
+
+        def member(r):
+            with CollectiveGroup("ag", r, n, timeout=30) as g:
+                return g.allgather(
+                    np.full((2, 2), r, dtype=np.float64))
+
+        for out in _run_members(n, member):
+            assert out.shape == (n, 2, 2)
+            for r in range(n):
+                np.testing.assert_array_equal(out[r], np.full((2, 2), r))
+
+    def test_broadcast_pipelines_from_root(self):
+        n = 3
+        payload = np.random.default_rng(7).integers(
+            0, 255, 2_000_000, dtype=np.uint8)  # multi-chunk
+
+        def member(r):
+            x = payload if r == 1 else np.empty_like(payload)
+            with CollectiveGroup("bc", r, n, timeout=30) as g:
+                return g.broadcast(x, root=1)
+
+        for out in _run_members(n, member):
+            np.testing.assert_array_equal(out, payload)
+
+    def test_jax_array_and_bf16_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        n = 2
+
+        def member(r):
+            x = jnp.arange(512, dtype=jnp.bfloat16) * (r + 1)
+            with CollectiveGroup("jaxbf16", r, n, timeout=30) as g:
+                out = g.allreduce(x, "sum")
+            assert isinstance(out, jax.Array)
+            assert out.dtype == jnp.bfloat16
+            return np.asarray(out, dtype=np.float32)
+
+        ref = np.asarray(
+            jnp.arange(512, dtype=jnp.bfloat16) * 1
+            + jnp.arange(512, dtype=jnp.bfloat16) * 2, np.float32)
+        for out in _run_members(n, member):
+            np.testing.assert_allclose(out, ref, rtol=0.02, atol=0.5)
+
+    def test_allreduce_tree_packs_leaves(self):
+        n = 2
+
+        def member(r):
+            tree = {"w": np.full((4, 4), float(r + 1), np.float32),
+                    "b": np.full(3, float(r), np.float64)}
+            with CollectiveGroup("tree", r, n, timeout=30) as g:
+                return g.allreduce_tree(tree, "sum")
+
+        for out in _run_members(n, member):
+            np.testing.assert_array_equal(out["w"],
+                                          np.full((4, 4), 3.0))
+            np.testing.assert_array_equal(out["b"], np.full(3, 1.0))
+
+
+class TestTypedFailure:
+    @pytest.mark.chaos
+    def test_chaos_severed_chunk_raises_channel_error(self):
+        """A chaos-severed member mid-allreduce: every member gets a
+        typed ChannelError within the deadline, no hang."""
+        from ray_tpu.experimental import chaos
+
+        n = 3
+        data = np.zeros(500_000, np.float32)
+        # Member threads share the process, so the process-wide
+        # schedule fires on whichever member hits the nth chunk hook.
+        sched = chaos.schedule().drop_rpc("collective_chunk", count=1,
+                                          prob=1.0)
+
+        def member(r):
+            with CollectiveGroup("sever", r, n, timeout=15) as g:
+                with pytest.raises(ChannelError) as ei:
+                    g.allreduce(data, "sum")
+                return ei.value
+
+        t0 = time.monotonic()
+        with sched:
+            errs = _run_members(n, member, timeout=30)
+        assert time.monotonic() - t0 < 20
+        assert sched.fired("rpc_drop") >= 1
+        for e in errs:
+            assert e.context.get("group") == "sever"
+            assert "op" in e.context
+
+    @pytest.mark.chaos
+    def test_dead_peer_mid_allreduce_raises_typed_within_deadline(self):
+        """One member's thread dies (closes its group) mid-sequence:
+        survivors' next op raises ChannelError before the deadline."""
+        n = 3
+        data = np.arange(100_000, dtype=np.float32)
+        barrier = threading.Barrier(n, timeout=30)
+
+        def member(r):
+            g = CollectiveGroup("deadpeer", r, n, timeout=10)
+            try:
+                out = g.allreduce(data, "sum")
+                np.testing.assert_allclose(out, data * n)
+                barrier.wait()
+                if r == 2:
+                    g.close()  # sudden death after the first round
+                    return "died"
+                t0 = time.monotonic()
+                with pytest.raises(ChannelError):
+                    g.allreduce(data, "sum")
+                assert time.monotonic() - t0 < 12
+                return "typed"
+            finally:
+                g.close()
+
+        out = _run_members(n, member, timeout=40)
+        assert out.count("typed") == 2 and out.count("died") == 1
+
+    def test_ambient_request_deadline_bounds_op(self):
+        """An installed PR-5 deadline caps the op budget: a lone member
+        of a 2-ring (peer never joins the op) fails fast, typed."""
+        from ray_tpu.core import deadlines
+
+        n = 2
+        ready = threading.Barrier(n, timeout=30)
+
+        def member(r):
+            g = CollectiveGroup("ambient", r, n, timeout=60)
+            try:
+                ready.wait()
+                if r == 1:
+                    time.sleep(4.0)  # never enters the op window
+                    return "late"
+                prev = deadlines.set_current(time.time() + 1.5)
+                try:
+                    t0 = time.monotonic()
+                    with pytest.raises(ChannelError):
+                        g.allreduce(np.zeros(64 << 20, np.uint8))
+                    assert time.monotonic() - t0 < 5.0
+                finally:
+                    deadlines.set_current(prev)
+                return "fast"
+            finally:
+                g.close()
+
+        out = _run_members(n, member, timeout=40)
+        assert "fast" in out
+
+
+@pytest.fixture(scope="module")
+def coll_cluster():
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2, resources={"c0": 2}, name="c0")
+    c.add_node(num_cpus=2, resources={"c1": 2}, name="c1")
+    c.connect(num_cpus=2)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+class _Member:
+    """A collective member living in a (possibly remote) node
+    process — rendezvous rides the head KV store."""
+
+    def __init__(self, name, rank, world):
+        from ray_tpu.collectives.group import CollectiveGroup as CG
+
+        self.group = CG(name, rank, world, timeout=60)
+        self.rank = rank
+
+    def reduce(self, n):
+        out = self.group.allreduce(
+            np.full(n, float(self.rank + 1), np.float32), "sum")
+        return float(out[0]), float(out[-1])
+
+
+class TestCrossProcess:
+    def test_kv_rendezvous_allreduce_across_nodes(self, coll_cluster):
+        """3 members across 3 processes (driver node + 2 workers):
+        endpoints rendezvous through the head KV store and the ring
+        runs over real sockets between processes."""
+        members = [
+            _Member.options(resources={"c0": 1}).remote("xp", 0, 3),
+            _Member.options(resources={"c1": 1}).remote("xp", 1, 3),
+            _Member.remote("xp", 2, 3),
+        ]
+        outs = ray_tpu.get([m.reduce.remote(50_000) for m in members],
+                           timeout=120)
+        assert outs == [(6.0, 6.0)] * 3
+        for m in members:
+            ray_tpu.kill(m)
+
+
+class TestTrainWiring:
+    def test_worker_group_gradient_sync_parity(self, shutdown_only):
+        """The train wiring end-to-end: a worker gang with a DCN
+        collective ring, session.allreduce_gradients mean-reduces each
+        rank's gradients, and the result matches the single-process
+        full-batch gradient (dtype tolerance)."""
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        from ray_tpu.train.worker_group import WorkerGroup
+
+        group = WorkerGroup(2, {})
+        try:
+            group.setup_collectives()
+
+            def loop(config):
+                import jax
+                import jax.numpy as jnp
+                import numpy as np
+
+                from ray_tpu import train
+
+                ctx = train.get_context()
+                assert train.get_collective_group() is not None
+                rank, world = ctx.get_world_rank(), ctx.get_world_size()
+                full_x = np.arange(8, dtype=np.float32).reshape(4, 2)
+                full_y = np.array([1., 2., 3., 4.], np.float32)
+                rows = full_x.shape[0] // world
+                x = full_x[rank * rows:(rank + 1) * rows]
+                y = full_y[rank * rows:(rank + 1) * rows]
+                w = jnp.zeros(2, jnp.float32)
+
+                def loss_fn(w):
+                    return jnp.mean((x @ w - y) ** 2)
+
+                g = jax.grad(loss_fn)(w)
+                g = train.allreduce_gradients(g, op="mean")
+                train.report({"g0": float(np.asarray(g)[0]),
+                              "g1": float(np.asarray(g)[1])})
+                return True
+
+            from ray_tpu.train.worker_group import _ReportCollector
+
+            collector = _ReportCollector.remote()
+            refs = group.run_all_async(
+                "run", loop, {}, None, collector, "gsync", "", None,
+                None, True)
+            assert ray_tpu.get(refs, timeout=120) == [True, True]
+            reports, _ = ray_tpu.get(collector.drain.remote())
+            # Single-process full-batch reference.
+            import jax
+            import jax.numpy as jnp
+
+            full_x = np.arange(8, dtype=np.float32).reshape(4, 2)
+            full_y = np.array([1., 2., 3., 4.], np.float32)
+            ref = jax.grad(
+                lambda w: jnp.mean((full_x @ w - full_y) ** 2))(
+                jnp.zeros(2, jnp.float32))
+            # mean over ranks of half-batch grads == full-batch grad.
+            assert reports, "rank 0 reported nothing"
+            np.testing.assert_allclose(
+                [reports[-1]["g0"], reports[-1]["g1"]],
+                np.asarray(ref), rtol=1e-5)
+        finally:
+            group.shutdown()

@@ -73,6 +73,141 @@ class TestWireLayout:
         np.testing.assert_array_equal(
             out["x"].astype(np.float32), arr.astype(np.float32))
 
+    def test_bfloat16_jax_array_flat_roundtrip(self):
+        """A bf16 jax.Array leaf survives the flat wire path with its
+        dtype (no fail, no silent upcast through numpy)."""
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.arange(128, dtype=jnp.bfloat16) * 0.5
+        sealed = serialize({"w": x})
+        meta, bufs = wire_layout(sealed)
+        kind, dtype, _shape, _n, _sh = meta["externs"][0]
+        assert (kind, dtype) == ("jax", "bfloat16")
+        flat = b"".join(bytes(b) for b in bufs)
+        from ray_tpu.cluster.serialization import deserialize
+
+        out = deserialize(sealed_from_flat(meta, flat))["w"]
+        assert isinstance(out, jax.Array) and out.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(np.asarray(out, np.float32),
+                                      np.asarray(x, np.float32))
+
+    def test_v2_wire_frame_and_v1_compat(self):
+        """to_wire emits the header-only v2 frame; from_wire accepts
+        both v2 and legacy v1 pickles."""
+        import pickle
+
+        from ray_tpu.cluster.serialization import (deserialize,
+                                                   from_wire, to_wire)
+
+        value = {"a": np.arange(1000, dtype=np.float32), "k": "v"}
+        blob = to_wire(serialize(value))
+        assert blob[:4] == b"RTW2"
+        out = deserialize(from_wire(blob))
+        np.testing.assert_array_equal(out["a"], value["a"])
+        assert out["k"] == "v"
+        v1 = pickle.dumps((serialize("v1").payload,
+                           [("np", "int32", (3,),
+                             np.arange(3, dtype=np.int32).tobytes())]))
+        old = from_wire(v1)
+        np.testing.assert_array_equal(old.externs[0][1],
+                                      np.arange(3, dtype=np.int32))
+
+    def test_sharding_descriptor_roundtrips(self):
+        """A NamedSharding survives the wire as a header descriptor and
+        is re-applied on rebuild when the devices exist."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2),
+                    ("data", "model"))
+        x = jax.device_put(
+            jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            NamedSharding(mesh, P("data", "model")))
+        sealed = serialize(x)
+        meta, bufs = wire_layout(sealed)
+        desc = meta["externs"][0][4]
+        assert desc == {"mesh_shape": (4, 2),
+                        "axis_names": ("data", "model"),
+                        "spec": ("data", "model")}
+        flat = b"".join(bytes(b) for b in bufs)
+        from ray_tpu.cluster.serialization import deserialize
+
+        out = deserialize(sealed_from_flat(meta, flat))
+        assert out.sharding.is_equivalent_to(x.sharding, x.ndim)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_zero_copy_export_aliases_cpu_device_buffer(self):
+        """dlpack export of a CPU-backed f32 jax.Array is zero-copy
+        (same base address) — the wire layout never tobytes()-copies
+        it."""
+        import jax.numpy as jnp
+
+        from ray_tpu.cluster.serialization import _export_host
+
+        x = jnp.arange(4096, dtype=jnp.float32)
+        h1 = _export_host(x)
+        h2 = np.from_dlpack(x)
+        assert h1.__array_interface__["data"][0] \
+            == h2.__array_interface__["data"][0]
+
+
+class TestTransferGeometry:
+    def test_small_payload_single_stream(self):
+        from ray_tpu.cluster.geometry import transfer_geometry
+
+        chunk, streams = transfer_geometry(100 * 1024)
+        assert streams == 1
+        assert chunk >= 100 * 1024
+
+    def test_large_payload_scales_to_cap(self):
+        from ray_tpu.cluster.geometry import transfer_geometry
+
+        GLOBAL_CONFIG.set("object_pull_streams", 4)
+        GLOBAL_CONFIG.set("object_stream_stripe_bytes",
+                          16 * 1024 * 1024)
+        try:
+            _chunk, streams = transfer_geometry(1024 * 1024 * 1024)
+            assert streams == 4  # capped
+            _chunk, streams = transfer_geometry(33 * 1024 * 1024)
+            assert streams == 3  # ceil(33/16)
+        finally:
+            GLOBAL_CONFIG.reset()
+
+    def test_geometry_logged_at_debug(self, caplog):
+        import logging
+
+        from ray_tpu.cluster.geometry import transfer_geometry
+
+        with caplog.at_level(logging.DEBUG, logger="ray_tpu.transfer"):
+            transfer_geometry(64 * 1024 * 1024, what="pull")
+        assert any("pull geometry" in r.message for r in caplog.records)
+
+    def test_grown_chunks_stay_element_aligned(self):
+        # Above _MAX_CHUNKS_PER_STREAM chunks/stream the chunk size
+        # grows past the configured base; it must stay a multiple of
+        # every numeric itemsize or the collectives' frame-bytes //
+        # itemsize receive accounting shifts mid-stream (silent
+        # corruption for >256 MiB bf16 segments).
+        from ray_tpu.cluster.geometry import transfer_geometry
+
+        for total in (256 * 1024 * 1024 + 2,
+                      512 * 1024 * 1024 + 130,
+                      300 * 1024 * 1024 + 2):
+            chunk, _streams = transfer_geometry(
+                total, what="collective", streams_cap=1)
+            assert chunk % 4096 == 0
+
+    def test_stripe_ranges_cover_payload(self):
+        from ray_tpu.cluster.geometry import stripe_ranges
+
+        total = 10 * 1024 * 1024 + 3
+        ranges = stripe_ranges(total, 4 * 1024 * 1024)
+        assert sum(ln for _o, ln in ranges) == total
+        assert ranges[0] == (0, 4 * 1024 * 1024)
+        assert ranges[-1][0] + ranges[-1][1] == total
+
 
 # ---------------------------------------------------------------------------
 # Node-local store: pinning, spill, restore, chunk serving
@@ -418,3 +553,91 @@ class TestDataOverObjectPlane:
             fn_constructor_args=(3,))
         assert sorted(r["id"] for r in ds.take_all()) == \
             [i * 3 for i in range(80)]
+
+
+# ---------------------------------------------------------------------------
+# Device-array wire path across real process boundaries
+# ---------------------------------------------------------------------------
+
+class TestDeviceArrayAcrossBoundary:
+    def test_bf16_jax_array_task_return_parity(self, plane_cluster):
+        """bf16 device arrays cross the wire with dtype/shape/value
+        parity — both the inline path (small) and the chunked
+        primary-copy pull (big)."""
+        import jax
+        import jax.numpy as jnp
+
+        @ray_tpu.remote(resources={"w0": 1})
+        def make(n):
+            import jax.numpy as jnp
+
+            return {"w": jnp.arange(n, dtype=jnp.bfloat16) * 0.25,
+                    "tag": n}
+
+        for n in (1024, 300_000):  # inline; primary-copy redirect
+            out = ray_tpu.get(make.remote(n), timeout=120)
+            w = out["w"]
+            assert isinstance(w, jax.Array), type(w)
+            assert w.dtype == jnp.bfloat16 and w.shape == (n,)
+            ref32 = (np.arange(n, dtype=np.float32) * 0.25).astype(
+                jnp.bfloat16).astype(np.float32)
+            np.testing.assert_array_equal(
+                np.asarray(w, dtype=np.float32), ref32)
+            assert out["tag"] == n
+
+    def test_sharded_array_reshards_on_receiver(self, plane_cluster):
+        """The wire sharding descriptor survives a real process
+        boundary: the driver rebuilds the producer's NamedSharding
+        (both processes run the 8-device CPU mesh)."""
+        import jax
+
+        @ray_tpu.remote(resources={"w1": 1})
+        def make_sharded():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+
+            mesh = Mesh(np.array(jax.devices()).reshape(8), ("d",))
+            return jax.device_put(
+                jnp.arange(80_000, dtype=jnp.float32).reshape(8, 10_000),
+                NamedSharding(mesh, P("d", None)))
+
+        out = ray_tpu.get(make_sharded.remote(), timeout=120)
+        assert isinstance(out, jax.Array)
+        from jax.sharding import NamedSharding
+
+        assert isinstance(out.sharding, NamedSharding)
+        assert tuple(out.sharding.mesh.devices.shape) == (8,)
+        assert tuple(out.sharding.spec) == ("d", None)
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            np.arange(80_000, dtype=np.float32).reshape(8, 10_000))
+
+    @pytest.mark.net
+    def test_device_array_broadcast_consumed_on_every_node(
+            self, plane_cluster):
+        """Weight-distribution path: broadcast a jax.Array object over
+        the striped push tree; every node's consumer sees value parity
+        without pulling from the source."""
+        import jax.numpy as jnp
+
+        from ray_tpu.util import broadcast
+
+        x = jnp.arange(500_000, dtype=jnp.float32)
+        ref = ray_tpu.put(x)
+        n = broadcast(ref)
+        assert n >= 2
+
+        @ray_tpu.remote
+        def consume(a):
+            import numpy as np
+
+            return float(np.asarray(a).sum())
+
+        outs = ray_tpu.get(
+            [consume.options(resources={f"w{i}": 1}).remote(ref)
+             for i in (0, 1)], timeout=120)
+        expect = float(np.arange(500_000, dtype=np.float32).sum())
+        assert outs == [expect, expect]
