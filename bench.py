@@ -237,22 +237,28 @@ def _dag_recovery_bench() -> dict:
         ray_tpu.shutdown()
 
 
-def _obs_overhead_bench(n_pairs: int = 220) -> dict:
-    """Observability-plane overhead on ``dag_roundtrip_us``: the same
-    cross-process 2-actor compiled-DAG ping-pong as the roundtrip
-    phase, measured in PAIRED adjacent passes — tracing toggled
-    cluster-wide between passes (driver via ``tracing.disable()``,
-    workers via a pinned remote task flipping their process-local
-    flag).  The median per-pair ratio cancels the box's load drift,
-    which is larger than the overhead itself on shared CI hardware.
-    Guard target: obs_overhead_pct < 5."""
+def _paired_overhead_bench(module: str, pct_key: str, on_key: str,
+                           off_key: str, n_pairs: int = 220) -> dict:
+    """ONE harness for the <plane>_overhead_pct phases (tracing plane,
+    log plane): the cross-process 2-actor compiled-DAG ping-pong from
+    the roundtrip phase, measured in PAIRED adjacent passes with the
+    named observability module (``enable()``/``disable()``) toggled
+    cluster-wide between passes — driver-side directly, workers via a
+    pinned remote task flipping their process-local flag.  The pass
+    time is bimodal on shared CI (thread-scheduling regimes lasting
+    seconds dwarf the plane's cost), so only back-to-back passes
+    compare; the median per-pair ratio cancels the box's load drift,
+    which is larger than the overhead itself.  Guard target for every
+    phase built on this: <plane>_overhead_pct < 5."""
+    import importlib
+
     import numpy as np
 
     import ray_tpu
     from ray_tpu.cluster.cluster_utils import Cluster
     from ray_tpu.dag import InputNode
-    from ray_tpu.observability import tracing
 
+    plane = importlib.import_module(module)
     ray_tpu.shutdown()
     c = Cluster()
     c.add_node(num_cpus=2, resources={"d0": 10})
@@ -265,20 +271,20 @@ def _obs_overhead_bench(n_pairs: int = 220) -> dict:
                 return x
 
         @ray_tpu.remote
-        def set_tracing(on: bool):
-            from ray_tpu.observability import tracing as t
+        def set_plane(mod: str, on: bool):
+            import importlib as il
 
-            t.enable() if on else t.disable()
+            m = il.import_module(mod)
+            m.enable() if on else m.disable()
             return on
 
         def toggle(on: bool):
-            if on:
-                tracing.enable()
-            else:
-                tracing.disable()
+            plane.enable() if on else plane.disable()
             ray_tpu.get([
-                set_tracing.options(resources={"d0": 1}).remote(on),
-                set_tracing.options(resources={"d1": 1}).remote(on)])
+                set_plane.options(resources={"d0": 1}).remote(
+                    module, on),
+                set_plane.options(resources={"d1": 1}).remote(
+                    module, on)])
 
         payload = np.zeros(16384, dtype=np.float32)
         with InputNode() as inp:
@@ -295,12 +301,8 @@ def _obs_overhead_bench(n_pairs: int = 220) -> dict:
             ray_tpu.get(compiled.execute(payload))
             return (time.perf_counter() - t0) * 1e6
 
-        # PER-PASS adjacent pairs, order alternating within pairs: the
-        # pass time is bimodal (thread-scheduling regimes lasting
-        # seconds dwarf the plane's cost), so only back-to-back passes
-        # are comparable; the median of per-pair on/off ratios is
-        # robust to pairs straddling a regime shift.  Toggles happen
-        # OUTSIDE the timed region.
+        # PER-PASS adjacent pairs, order alternating within pairs;
+        # toggles happen OUTSIDE the timed region.
         ratios: list = []
         on_samples: list = []
         off_samples: list = []
@@ -328,15 +330,32 @@ def _obs_overhead_bench(n_pairs: int = 220) -> dict:
         on_samples.sort()
         off_samples.sort()
         return {
-            "obs_overhead_pct": round((med_ratio - 1.0) * 100.0, 2),
-            "obs_traced_roundtrip_us": round(
-                on_samples[len(on_samples) // 2], 1),
-            "obs_untraced_roundtrip_us": round(
-                off_samples[len(off_samples) // 2], 1),
+            pct_key: round((med_ratio - 1.0) * 100.0, 2),
+            on_key: round(on_samples[len(on_samples) // 2], 1),
+            off_key: round(off_samples[len(off_samples) // 2], 1),
         }
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def _obs_overhead_bench(n_pairs: int = 220) -> dict:
+    """Tracing/metrics-plane overhead on ``dag_roundtrip_us`` (guard:
+    obs_overhead_pct < 5; measured ~1-4% on CI hardware)."""
+    return _paired_overhead_bench(
+        "ray_tpu.observability.tracing", "obs_overhead_pct",
+        "obs_traced_roundtrip_us", "obs_untraced_roundtrip_us",
+        n_pairs)
+
+
+def _log_plane_overhead_bench(n_pairs: int = 220) -> dict:
+    """Structured-log-plane overhead on ``dag_roundtrip_us``: each
+    logged pass emits one driver dag record + per-task records on both
+    workers and ships them on the EventShipper rails (guard:
+    log_plane_overhead_pct < 5; measured ~1.4% on CI hardware)."""
+    return _paired_overhead_bench(
+        "ray_tpu.observability.logs", "log_plane_overhead_pct",
+        "log_on_roundtrip_us", "log_off_roundtrip_us", n_pairs)
 
 
 def _broadcast_bench(size_bytes: int, n_nodes: int = 3) -> dict:
@@ -788,6 +807,13 @@ def main():
         extra.update(_obs_overhead_bench())
     except Exception as e:  # noqa: BLE001
         extra["obs_overhead_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: log plane overhead phase start", file=sys.stderr,
+          flush=True)
+    try:
+        extra.update(_log_plane_overhead_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["log_plane_overhead_error"] = f"{type(e).__name__}: {e}"
 
     print("bench: overload goodput phase start", file=sys.stderr,
           flush=True)

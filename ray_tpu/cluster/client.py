@@ -1994,6 +1994,7 @@ class NodeServer:
             "remove_pg_capacity": self._remove_pg_capacity,  # raylint: disable=handler-idempotency -- callers are single-shot (no retry wrapper), and PG teardown races resolve by pg_id
             "tail_log": self._tail_log,
             "node_state": self._node_state,
+            "profile": self._profile,
             "ping": lambda p: "pong",
         }, ordered={"actor_call"})
         self.address = self._server.address
@@ -2328,10 +2329,23 @@ class NodeServer:
 
     def _node_state(self, p):
         """Per-node task/object listings for the state CLI (the
-        reference aggregates these through per-node agents)."""
+        reference aggregates these through per-node agents); filters
+        (trace_id/state) apply node-side before the reply ships."""
         from ..core.util_state_compat import node_state
 
-        return node_state(self.runtime, p.get("what", "tasks"))
+        return node_state(self.runtime, p.get("what", "tasks"),
+                          filters=p.get("filters"))
+
+    def _profile(self, p):
+        """On-demand sampling profile of THIS node process (pure
+        Python, no py-spy — reference: the dashboard reporter's
+        profile_manager).  Serves `ray_tpu profile` + /api/profile."""
+        from ..observability.profiling import profile_process
+
+        return profile_process(
+            duration_s=float(p.get("duration_s", 1.0)),
+            interval_s=float(p.get("interval_s", 0.01)),
+            thread_filter=p.get("thread_filter"))
 
     def _tail_log(self, p):
         """Tail this node's log file (reference: the dashboard log

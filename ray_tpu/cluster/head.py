@@ -113,6 +113,11 @@ class HeadServer:
         self._node_events: Dict[str, Any] = {}
         self._node_event_meta: Dict[str, Dict[str, Any]] = {}
         self._node_metrics: Dict[str, Dict] = {}
+        # Structured log plane: bounded per-node record stores fed by
+        # the same push_events flushes (observability/logs.py).
+        self._logs_max = int(_os.environ.get(
+            "RAY_TPU_HEAD_LOGS_MAX", "50000"))
+        self._node_logs: Dict[str, Any] = {}
         self._events_lock = threading.Lock()
         self._deque = _collections.deque
         # After a restart, actors replay before their nodes reattach:
@@ -152,6 +157,7 @@ class HeadServer:
             "push_events": self._push_events,
             "cluster_timeline": self._cluster_timeline,
             "cluster_metrics": self._cluster_metrics,
+            "cluster_logs": self._cluster_logs,
             "ping": lambda p: "pong",
         }, host=host, port=port)
         # Batched long-poll pubsub: node deaths and actor FSM
@@ -322,6 +328,11 @@ class HeadServer:
         bounded drop-oldest rings, mirroring the worker buffers."""
         node_id = p["node_id"]
         events = p.get("events") or []
+        records = p.get("logs") or []
+        for r in records:
+            # Stamp the origin node ONCE at ingest (cheaper than every
+            # worker resolving it per record on its emit path).
+            r.setdefault("node", node_id)
         with self._events_lock:
             store = self._node_events.get(node_id)
             if store is None:
@@ -329,14 +340,53 @@ class HeadServer:
                     maxlen=self._events_max)
                 self._prune_event_nodes_locked(keep=node_id)
             store.extend(events)
+            if records:
+                log_store = self._node_logs.get(node_id)
+                if log_store is None:
+                    log_store = self._node_logs[node_id] = self._deque(
+                        maxlen=self._logs_max)
+                log_store.extend(records)
             meta = self._node_event_meta.setdefault(node_id, {})
             meta["pid"] = p.get("pid")
             meta["node_dropped"] = int(p.get("dropped") or 0)
+            meta["logs_dropped"] = int(p.get("logs_dropped") or 0)
             meta["received"] = meta.get("received", 0) + len(events)
+            meta["logs_received"] = (meta.get("logs_received", 0)
+                                     + len(records))
             meta["ts"] = time.monotonic()
             if p.get("metrics") is not None:
                 self._node_metrics[node_id] = p["metrics"]
+        if records:
+            # Follow-mode fanout: one pubsub batch per ingested flush
+            # (`ray_tpu logs -f` long-polls the "logs" channel).  A
+            # SHORT replay ring: each batch can hold up to BATCH_MAX
+            # records, and the authoritative store is _node_logs — a
+            # follower further behind re-syncs via cluster_logs, so
+            # an unsubscribed channel must not pin megabytes of
+            # records at the default 1000-batch retention.
+            self._publisher.publish("logs", {"node_id": node_id,
+                                             "records": records},
+                                    retain=32)
         return {"ok": True, "stored": len(events)}
+
+    def _cluster_logs(self, p):
+        """SERVER-SIDE-filtered log query over every node's record
+        store (filters: trace_id, node, actor, level, logger, since/
+        until, text, limit — observability.logs.filter_records is the
+        one implementation)."""
+        from ..observability.logs import filter_records
+
+        p = dict(p or {})
+        limit = int(p.pop("limit", 1000) or 1000)
+        known = {"trace_id", "node", "actor", "level", "logger",
+                 "since", "until", "text"}
+        filters = {k: v for k, v in p.items()
+                   if k in known and v is not None}
+        with self._events_lock:
+            records = [r for store in self._node_logs.values()
+                       for r in store]
+        out = filter_records(records, limit=limit, **filters)
+        return {"records": out, "total_stored": len(records)}
 
     def _prune_event_nodes_locked(self, keep: str) -> None:
         """Hold the node dimension at its cap: evict the
@@ -357,21 +407,35 @@ class HeadServer:
             self._node_events.pop(victim, None)
             self._node_event_meta.pop(victim, None)
             self._node_metrics.pop(victim, None)
+            self._node_logs.pop(victim, None)
 
     def _cluster_timeline(self, p):
         """The merged event store: every node's shipped events in one
         list (each process keeps its own Chrome-trace pid lane)."""
         node_id = p.get("node_id") if isinstance(p, dict) else None
+        with_logs = (p.get("with_logs", True) if isinstance(p, dict)
+                     else True)
         with self._events_lock:
             if node_id is not None:
                 events = list(self._node_events.get(node_id, ()))
                 nodes = [node_id] if node_id in self._node_events else []
+                records = list(self._node_logs.get(node_id, ())) \
+                    if with_logs else []
             else:
                 events = [e for store in self._node_events.values()
                           for e in store]
                 nodes = list(self._node_events)
+                records = [r for store in self._node_logs.values()
+                           for r in store] if with_logs else []
             meta = {nid: dict(m)
                     for nid, m in self._node_event_meta.items()}
+        if records:
+            # Log records interleave with spans as instant events on
+            # their process's lane: a trace id links spans ↔ logs in
+            # ONE merged view.
+            from ..observability.logs import to_timeline_events
+
+            events = events + to_timeline_events(records)
         return {"events": events, "nodes": nodes, "meta": meta}
 
     def _cluster_metrics(self, _p):
@@ -722,12 +786,24 @@ class HeadServer:
                 self._mark_dirty()
         return {"ok": info is not None}
 
-    def _list_actors_rpc(self, _p):
+    def _list_actors_rpc(self, p):
+        """Optionally server-side filtered (state API: ``ray_tpu list
+        actors --node/--state`` applies filters HERE, not client-side
+        — the reference state aggregator's predicate pushdown)."""
+        node = (p or {}).get("node") if isinstance(p, dict) else None
+        state = (p or {}).get("state") if isinstance(p, dict) else None
+        # Same normalization as the task path (node_state uppercases):
+        # `--state alive` must not silently match zero actors.
+        state = state.upper() if isinstance(state, str) else state
         with self._lock:
             return [{"actor_id": aid, "node_id": i["node_id"],
                      "name": i["name"],
                      "state": i.get("state", "ALIVE")}
-                    for aid, i in self._actors.items()]
+                    for aid, i in self._actors.items()
+                    if (node is None
+                        or str(i["node_id"]).startswith(node))
+                    and (state is None
+                         or i.get("state", "ALIVE") == state)]
 
     # ---------------------------------------------------------------- pgs
     def _create_pg(self, p):

@@ -29,12 +29,16 @@ class Publisher:
         # channel -> (next_seq, [(seq, payload), ...])
         self._channels: Dict[str, Tuple[int, List[Tuple[int, Any]]]] = {}
 
-    def publish(self, channel: str, payload: Any) -> None:
+    def publish(self, channel: str, payload: Any,
+                retain: int = _RETAIN) -> None:
+        """``retain`` bounds this channel's replay ring — high-volume
+        channels (log batches) pass a small window so an unsubscribed
+        channel cannot pin memory at the head."""
         with self._cond:
             seq, events = self._channels.get(channel, (0, []))
             events.append((seq, payload))
-            if len(events) > _RETAIN:
-                events = events[-_RETAIN:]
+            if len(events) > retain:
+                events = events[-retain:]
             self._channels[channel] = (seq + 1, events)
             self._cond.notify_all()
 

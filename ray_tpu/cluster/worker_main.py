@@ -51,6 +51,12 @@ def main(argv=None) -> int:
         node_name=args.name, labels=labels)
     print(f"ray_tpu worker node {rt.node_id.hex()[:12]} "
           f"@ {rt.address} (head {args.head})", flush=True)
+    # Structured log plane: task/actor prints on this node become
+    # trace-stamped records in the shipped stream (observability/
+    # logs.py) — `ray_tpu logs --trace <id>` sees worker stdout too.
+    from ray_tpu.observability import logs as logs_mod
+
+    logs_mod.capture_stdio()
     if args.log_dir:
         # Per-node log capture (reference: per-process files in the
         # session dir + log_monitor routing, _private/log_monitor.py):
@@ -75,6 +81,11 @@ def main(argv=None) -> int:
         except Exception:
             pass
         rt.log_path = log_path
+        # Bounded per-node STRUCTURED ring file alongside the raw
+        # tail file: JSONL records survive the process (post-mortem
+        # reads) without unbounded disk growth.
+        logs_mod.configure_ring_file(os.path.join(
+            args.log_dir, f"node-{rt.node_id.hex()[:12]}.jsonl"))
 
     try:
         head_gone_since = None

@@ -25,6 +25,7 @@ from .task_spec import TaskSpec
 from ..exceptions import (ActorDiedError, PendingCallsLimitExceededError,
                           TaskError)
 from ..experimental import chaos as _chaos
+from ..observability.profiling import stuck_guard as _stuck_guard
 
 
 class ActorState(Enum):
@@ -223,10 +224,21 @@ class _ActorCore:
         # user code never runs (the overload plane's core invariant).
         if self._runtime.shed_expired_spec(spec, "actor_mailbox"):
             return
-        if self._chaos_gate(spec):
-            return
-        self._runtime.execute_task_inline(
-            spec, bound_instance=self.instance, actor_core=self)
+        # Stuck detector (observability/profiling.py): a dispatch that
+        # is still running STUCK_FACTOR x past its remaining deadline
+        # budget gets every thread's stack snapshotted — the deadline
+        # plane promises the caller an answer by then, so overshooting
+        # it this far means something is wedged, and the post-mortem
+        # needs the stacks from the moment it happened.
+        budget = (None if spec.deadline is None
+                  else spec.deadline - time.time())
+        with _stuck_guard("actor_dispatch", budget,
+                          {"method": spec.descriptor.function_name,
+                           "actor": self.info.display_name()}):
+            if self._chaos_gate(spec):
+                return
+            self._runtime.execute_task_inline(
+                spec, bound_instance=self.instance, actor_core=self)
 
     def _chaos_gate(self, spec: TaskSpec) -> bool:
         """Fault-injection hook before method dispatch: an active
@@ -285,10 +297,18 @@ class _ActorCore:
         # Same mailbox-dequeue shed as the sync path.
         if self._runtime.shed_expired_spec(spec, "actor_mailbox"):
             return
-        if self._chaos_gate(spec):
-            return
-        await self._runtime.execute_task_inline_async(
-            spec, bound_instance=self.instance, actor_core=self)
+        # Same stuck guard as the sync path: a chaos-stalled (or truly
+        # wedged) async replica blocks its event loop — the snapshot
+        # shows the loop thread pinned inside the stall.
+        budget = (None if spec.deadline is None
+                  else spec.deadline - time.time())
+        with _stuck_guard("actor_dispatch", budget,
+                          {"method": spec.descriptor.function_name,
+                           "actor": self.info.display_name()}):
+            if self._chaos_gate(spec):
+                return
+            await self._runtime.execute_task_inline_async(
+                spec, bound_instance=self.instance, actor_core=self)
 
     def _dead_error(self) -> ActorDiedError:
         suffix = ""

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import atexit
 import inspect
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -55,6 +56,10 @@ _FT_ERRORS = (TaskError, ActorError, ObjectLostError, ChannelError,
 
 _global_lock = threading.Lock()
 _global_runtime: Optional["Runtime"] = None
+
+# Per-execution structured log records ride this logger's level gate
+# (observability/logs.py stamps + ships them).
+_task_logger = logging.getLogger("ray_tpu.task")
 
 
 class Runtime:
@@ -100,6 +105,12 @@ class Runtime:
             object_store=self.object_store)
         self.actor_manager = ActorManager(self)
         self.runtime_context = RuntimeContext(self)
+        # Structured log plane (observability/logs.py): every process
+        # running a Runtime stamps its log records with trace/task
+        # identity; cluster mode ships them on the EventShipper rails.
+        from ..observability import logs as _logs_mod
+
+        _logs_mod.install()
 
         self._driver_task_id = TaskID.for_driver(self.job_id)
         self._put_counters: Dict[TaskID, int] = {}
@@ -773,12 +784,32 @@ class Runtime:
                            outcome: str, span_id: Optional[str] = None):
         """Timeline span + counters for one executed task (reference:
         TaskEventBuffer, task_event_buffer.h:220 → ray.timeline)."""
+        from ..observability import logs as _logs
         from ..observability import metrics as _metrics
         from ..observability.timeline import record_span
 
         t_end = time.time()
         kind = ("actor_creation" if spec.is_actor_creation
                 else "actor_task" if spec.is_actor_task else "task")
+        # One structured log record per execution (the task context was
+        # already torn down in the caller's finally, so identity fields
+        # are stamped explicitly — the handler's ambient lookup would
+        # come up empty).  Gated on the ray_tpu.task logger level so
+        # RAY_TPU_LOG_LEVEL=WARNING silences it.
+        if _logs.enabled() and _task_logger.isEnabledFor(logging.INFO):
+            rec = {"level": "INFO", "levelno": logging.INFO,
+                   "logger": "ray_tpu.task",
+                   "msg": f"{kind} {spec.repr_name()} {outcome} "
+                          f"in {(t_end - t_start) * 1e3:.1f}ms",
+                   "thread": threading.current_thread().name,
+                   "task": spec.repr_name()}
+            if spec.trace_id is not None:
+                rec["trace_id"] = spec.trace_id
+                if span_id is not None:
+                    rec["span_id"] = span_id
+            if spec.actor_id is not None:
+                rec["actor"] = spec.actor_id.hex()
+            _logs.emit_record(rec)
         args = {"task_id": spec.task_id.hex(), "kind": kind,
                 "outcome": outcome,
                 "attempt": spec.attempt_number}

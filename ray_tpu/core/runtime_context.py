@@ -6,12 +6,17 @@ current job/task/actor/node plus ``was_current_actor_reconstructed`` etc.
 
 from __future__ import annotations
 
-import threading
+import contextvars
 from typing import Any, Dict, Optional
 
 from .ids import ActorID, JobID, NodeID, TaskID, WorkerID
 
-_local = threading.local()
+# Per-asyncio-task, not merely per-thread (see core/deadlines.py):
+# an async actor interleaves requests on one loop thread, and the
+# executing task's identity must follow each request across awaits
+# — log records and nested submissions stamp from here.
+_ctx_var: "contextvars.ContextVar[Optional[TaskContext]]" = \
+    contextvars.ContextVar("ray_tpu_task_ctx", default=None)
 
 
 class TaskContext:
@@ -40,11 +45,11 @@ class TaskContext:
 
 
 def set_task_context(ctx: Optional[TaskContext]):
-    _local.ctx = ctx
+    _ctx_var.set(ctx)
 
 
 def current_task_context() -> Optional[TaskContext]:
-    return getattr(_local, "ctx", None)
+    return _ctx_var.get()
 
 
 class RuntimeContext:

@@ -3,16 +3,41 @@
 per node by the CLI (reference: util/state backed by per-node agents +
 GCS task events).  Thin shim over ray_tpu.util.state, which reads the
 LOCAL runtime — exactly what a per-node RPC handler wants.
+
+Filters (``trace_id``, ``state``) are applied HERE, node-side, before
+the reply crosses the wire — the state API's predicate pushdown
+(reference: server-side filtering in the state aggregator), so a
+``ray_tpu list tasks --trace-id X`` over a busy cluster ships only
+the matching rows.
 """
 
 from __future__ import annotations
 
+from typing import Any, Dict, Optional
 
-def node_state(runtime, what: str):
+
+def node_state(runtime, what: str,
+               filters: Optional[Dict[str, Any]] = None):
     from ray_tpu.util import state
 
+    filters = filters or {}
     if what == "tasks":
-        return {"pending": state.list_tasks(),
+        # Any task filter implies the caller wants the full picture —
+        # a --state FINISHED query over pending-only rows would
+        # silently return nothing.
+        tasks = state.list_tasks(
+            include_done=bool(filters.get("trace_id")
+                              or filters.get("state")
+                              or filters.get("include_done")))
+        trace_id = filters.get("trace_id")
+        if trace_id is not None:
+            tasks = [t for t in tasks
+                     if t.get("trace_id") == trace_id]
+        want_state = filters.get("state")
+        if want_state is not None:
+            tasks = [t for t in tasks
+                     if t.get("state") == str(want_state).upper()]
+        return {"pending": tasks,
                 "summary": state.summarize_tasks()}
     if what == "objects":
         return {"objects": state.list_objects()[:200],

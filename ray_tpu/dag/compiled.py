@@ -36,6 +36,7 @@ size hint; default sizes from the first pass), ``channel_timeout``.
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -44,6 +45,7 @@ from .dag_node import (ClassMethodNode, ClassNode, DAGNode, FunctionNode,
 from ..observability import tracing as _tracing
 
 _NULL_CTX = contextlib.nullcontext()
+_log = logging.getLogger("ray_tpu.dag")
 
 def _dag_metrics():
     """Compiled-DAG pass/recovery series (rebuilt after registry
@@ -491,10 +493,18 @@ class CompiledDAG:
             # One trace per pass: the driver-side span is the root, and
             # every step submitted under it (local or cross-process)
             # attaches to the same trace id.
-            with _tracing.span("dag.execute"), \
+            with _tracing.span("dag.execute") as _span, \
                     self._submit_order_lock if (
                     self._channel_edges or self._chan_recovery) \
                     else _NULL_CTX:
+                # The driver-side record of this pass: stamped with the
+                # pass's root trace id (the span just installed it), so
+                # `ray_tpu logs --trace <id>` returns the driver line
+                # next to every worker's task records.  Lazy %-args —
+                # this sits on the pass hot path (raylint log-hygiene).
+                if _log.isEnabledFor(logging.INFO):
+                    _log.info("dag pass trace=%s steps=%d",
+                              _span.trace_id, len(self._steps))
                 self._maybe_replan()
                 for step in self._steps:
                     args = tuple(resolve(e) for e in step.arg_plan)

@@ -16,9 +16,15 @@ Endpoints:
   /api/actors       actor table
   /api/tasks        pending tasks + summary
   /api/objects      object-store entries
-  /api/jobs         job table
+  /api/jobs         job table; POST submits {entrypoint, runtime_env}
+  /api/jobs/<id>        one job's status record
+  /api/jobs/<id>/logs   that job's captured output (text)
   /api/serve        serve app status
   /api/memory       object store stats per node
+  /api/logs         structured log query (?trace_id=&node=&actor=
+                    &level=&since=&until=&text=&limit=)
+  /api/profile      sampling profile (?node=&duration=&thread=
+                    &format=collapsed|chrome)
   /api/timeline     Chrome trace JSON (open in perfetto)
   /metrics          Prometheus text exposition
 """
@@ -27,6 +33,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -151,6 +158,55 @@ def _collect(path: str):
     raise KeyError(path)
 
 
+def _logs_api(params: Dict[str, str]):
+    """Structured log query: server-side-filtered through the head's
+    ``cluster_logs`` in cluster mode, the local ring otherwise."""
+    from ..core.runtime import get_runtime
+    from ..observability import logs as logs_mod
+
+    filters: Dict[str, Any] = {}
+    for key in ("trace_id", "node", "actor", "level", "logger", "text"):
+        if params.get(key):
+            filters[key] = params[key]
+    for key in ("since", "until"):
+        if params.get(key):
+            filters[key] = float(params[key])
+    limit = int(params.get("limit", 1000))
+    rt = get_runtime()
+    if rt.cluster is not None:
+        return {"records": logs_mod.query_cluster(
+            rt.cluster, limit=limit, **filters)}
+    return {"records": logs_mod.query(limit=limit, **filters)}
+
+
+def _profile_api(params: Dict[str, str]):
+    """On-demand sampling profile: the named node's process (node RPC)
+    or, with no/own node, this process."""
+    from ..core.runtime import get_runtime
+    from ..observability.profiling import profile_process
+
+    rt = get_runtime()
+    duration = min(float(params.get("duration", 1.0)), 30.0)
+    interval = float(params.get("interval", 0.01))
+    thread = params.get("thread") or None
+    node = params.get("node") or None
+    if node and rt.cluster is not None:
+        for n in rt.cluster.list_nodes():
+            if not (n["node_id"].startswith(node)
+                    or n.get("name") == node):
+                continue
+            if n["node_id"] == rt.cluster.node_id:
+                break  # ourselves: profile in-process
+            return rt.cluster.pool.get(n["address"]).call(
+                "profile", {"duration_s": duration,
+                            "interval_s": interval,
+                            "thread_filter": thread},
+                timeout=duration + 30.0)
+        else:
+            raise KeyError(f"no node matching {node!r}")
+    return profile_process(duration, interval, thread)
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -162,9 +218,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_json(self, data, code: int = 200):
+        return self._send(code, json.dumps(data, default=str).encode(),
+                          "application/json")
+
     def do_GET(self):  # noqa: N802
         try:
-            self.path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
+            self.path = path
+            params = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(query).items()}
             if self.path in ("/", "/index.html"):
                 return self._send(200, _PAGE.encode(),
                                   "text/html; charset=utf-8")
@@ -178,15 +241,27 @@ class _Handler(BaseHTTPRequestHandler):
                                   "text/plain; version=0.0.4")
             if self.path == "/api/timeline":
                 # ONE Chrome trace for the whole cluster (per-node pid
-                # lanes, cross-process flow arrows).
+                # lanes, cross-process flow arrows, log instants).
                 from ..observability.events import export_cluster_timeline
 
                 body = json.dumps(export_cluster_timeline(None)).encode()
                 return self._send(200, body, "application/json")
+            if self.path == "/api/logs":
+                return self._send_json(_logs_api(params))
+            if self.path == "/api/profile":
+                prof = _profile_api(params)
+                if params.get("format") == "collapsed":
+                    return self._send(200,
+                                      prof["collapsed"].encode(),
+                                      "text/plain; charset=utf-8")
+                if params.get("format") == "chrome":
+                    return self._send_json(prof["chrome"])
+                return self._send_json(prof)
+            if self.path.startswith("/api/jobs/"):
+                return self._job_get(self.path[len("/api/jobs/"):])
             if self.path.startswith("/api/"):
                 data = _collect(self.path[len("/api/"):])
-                return self._send(200, json.dumps(data).encode(),
-                                  "application/json")
+                return self._send_json(data)
             return self._send(404, b"not found", "text/plain")
         except KeyError:
             return self._send(404, b"unknown api", "text/plain")
@@ -195,6 +270,54 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             return self._send(500, f"{type(e).__name__}: {e}".encode(),
                               "text/plain")
+
+    def _job_get(self, rest: str):
+        """GET /api/jobs/<id> (status record) and /api/jobs/<id>/logs
+        (captured output) — the dashboard job module's read half."""
+        from .. import job as job_mod
+
+        rest = rest.strip("/")
+        if not rest:
+            return self._send_json(_collect("jobs"))
+        if rest.endswith("/logs"):
+            job_id = rest[:-len("/logs")]
+            return self._send(200,
+                              job_mod.get_job_logs(job_id).encode(),
+                              "text/plain; charset=utf-8")
+        return self._send_json(job_mod.get_job_info(rest))
+
+    def do_POST(self):  # noqa: N802
+        """POST /api/jobs/ — REST job submission (reference:
+        job_head.py:329 POST /api/jobs/ → JobManager.submit_job),
+        riding the existing detached-supervisor path."""
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path != "/api/jobs":
+                return self._send(404, b"not found", "text/plain")
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(
+                    self.rfile.read(length).decode() or "{}")
+            except ValueError as e:
+                return self._send_json(
+                    {"error": f"bad JSON body: {e}"}, code=400)
+            entrypoint = body.get("entrypoint")
+            if not entrypoint:
+                return self._send_json(
+                    {"error": "missing 'entrypoint'"}, code=400)
+            from .. import job as job_mod
+
+            job_id = job_mod.submit_job(
+                entrypoint,
+                runtime_env=body.get("runtime_env"),
+                submission_id=body.get("submission_id"))
+            return self._send_json({"job_id": job_id,
+                                    "submission_id": job_id})
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            return self._send_json(
+                {"error": f"{type(e).__name__}: {e}"}, code=500)
 
 
 class Dashboard:

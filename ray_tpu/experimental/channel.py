@@ -55,6 +55,7 @@ from ray_tpu.native.channel import (Channel, ChannelClosed,
 from ..exceptions import (ActorDiedError, ActorError, ChannelError,
                           ObjectLostError, _picklable_cause)
 from ..observability import tracing as _tracing
+from ..observability.profiling import stuck_guard as _stuck_guard
 from . import chaos as _chaos
 
 __all__ = [
@@ -432,6 +433,16 @@ class ChannelReader:
         reader until its full timeout.  ONE timeout budget covers both
         waiting for the ring to exist and waiting for the frame."""
         deadline = time.monotonic() + self.timeout
+        # Stuck detector: this loop PROMISES to resolve (frame, typed
+        # error, or deadline raise) within self.timeout — running
+        # STUCK_FACTOR x past that means the machinery itself is wedged
+        # (a native wait stuck, a liveness-probe RPC hung); snapshot
+        # the stacks at that moment for the post-mortem.
+        with _stuck_guard("channel_wait", self.timeout,
+                          {"ring": os.path.basename(self.path)}):
+            return self._read_frame_bounded(producer, deadline)
+
+    def _read_frame_bounded(self, producer, deadline) -> bytearray:
         chan = self._ensure(producer, deadline)
         probe_at = 0.0
         while True:

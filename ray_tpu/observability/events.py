@@ -30,6 +30,7 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+from . import logs as _logs
 from . import metrics as _metrics
 from . import timeline as _timeline
 
@@ -45,6 +46,7 @@ class EventShipper:
         self._interval = (DEFAULT_FLUSH_S if flush_interval_s is None
                           else float(flush_interval_s))
         self._cursor = 0
+        self._log_cursor = 0
         # RLock: stop() pre-acquires with a BOUND so the farewell
         # flush can't queue forever behind a periodic flush wedged in
         # a re-dial against a dead head, then calls flush() re-entrant.
@@ -74,19 +76,30 @@ class EventShipper:
                 else self._client.head._client)
         with self._flush_lock:
             events, self._cursor = _timeline.drain_since(self._cursor)
+            records, self._log_cursor = _logs.drain_since(
+                self._log_cursor)
             shipped = 0
+            logs_shipped = 0
             # Chunked so one giant backlog can't build an unbounded
             # RPC payload; the LAST chunk (possibly empty) refreshes
-            # the metrics snapshot.
+            # the metrics snapshot.  Structured log records piggyback
+            # on the same flush (the log plane ships on the event
+            # shipper's rails — no second connection, no second timer).
             while True:
                 chunk = events[shipped:shipped + BATCH_MAX]
-                last = shipped + len(chunk) >= len(events)
+                log_chunk = records[logs_shipped:logs_shipped
+                                    + BATCH_MAX]
+                last = (shipped + len(chunk) >= len(events)
+                        and logs_shipped + len(log_chunk)
+                        >= len(records))
                 payload = {
                     "node_id": self._client.node_id,
                     "pid": os.getpid(),
                     "events": chunk,
+                    "logs": log_chunk,
                     "metrics": _metrics.export_state() if last else None,
                     "dropped": _timeline.dropped_events(),
+                    "logs_dropped": _logs.dropped_records(),
                 }
                 # The push rides under _flush_lock BY DESIGN: batches
                 # must land at the head in cursor order (a manual flush
@@ -96,6 +109,7 @@ class EventShipper:
                 head.call("push_events", payload,  # raylint: disable=blocking-under-lock -- dedicated per-shipper lock; in-order batch shipping is the invariant
                           timeout=timeout)
                 shipped += len(chunk)
+                logs_shipped += len(log_chunk)
                 if last:
                     return shipped
 

@@ -31,11 +31,19 @@ from __future__ import annotations
 import itertools
 import os
 import random
+import contextvars
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
-_local = threading.local()
+# A ContextVar, NOT threading.local: async actors interleave many
+# requests on one event-loop thread, and each request runs as its
+# own asyncio task — the trace scope must follow the task, or a
+# request resuming after an await logs/submits under whichever
+# trace last dispatched (same reasoning as core/deadlines.py).
+# On plain threads a ContextVar behaves like a thread-local.
+_ctx_var: "contextvars.ContextVar[Optional[TraceCtx]]" = \
+    contextvars.ContextVar("ray_tpu_trace", default=None)
 # RAY_TPU_TRACING=0 disables the plane process-wide (worker
 # subprocesses inherit it through the environment — how the bench
 # measures a whole cluster untraced).
@@ -86,15 +94,15 @@ def current() -> Optional[TraceCtx]:
     """The thread's active (trace_id, parent_span_id), or None."""
     if not _enabled:
         return None
-    return getattr(_local, "ctx", None)
+    return _ctx_var.get()
 
 
 def set_current(ctx: Optional[TraceCtx]) -> Optional[TraceCtx]:
     """Install ``ctx`` on this thread; returns the previous context so
     callers can restore it (always restore — server handler threads
     are reused)."""
-    prev = getattr(_local, "ctx", None)
-    _local.ctx = ctx
+    prev = _ctx_var.get()
+    _ctx_var.set(ctx)
     return prev
 
 
@@ -104,7 +112,7 @@ def for_submission() -> Tuple[Optional[str], Optional[str]]:
     operation and gets a fresh trace id."""
     if not _enabled:
         return None, None
-    ctx = getattr(_local, "ctx", None)
+    ctx = _ctx_var.get()
     if ctx is not None:
         return ctx[0], ctx[1]
     return new_trace_id(), None
@@ -132,7 +140,7 @@ class span:
             self.trace_id = self.span_id = self.parent_span_id = None
             self._prev = None
             return self
-        prev = getattr(_local, "ctx", None)
+        prev = _ctx_var.get()
         if prev is not None:
             self.trace_id, self.parent_span_id = prev
         else:

@@ -84,23 +84,38 @@ def cmd_status(args) -> int:
 
 def cmd_list(args) -> int:
     rt = _connect(args.address)
+    node = getattr(args, "node", None) or None
+    state_f = getattr(args, "state", None) or None
+    trace_id = getattr(args, "trace_id", None) or None
     if args.what == "nodes":
         rows = rt.cluster.list_nodes()
+        if node:
+            rows = [n for n in rows if n["node_id"].startswith(node)
+                    or n.get("name") == node]
     elif args.what == "actors":
-        rows = rt.cluster.head.call("list_actors", {})
+        # Filters apply at the HEAD, not here (state API predicate
+        # pushdown — the reply ships only matching rows).
+        rows = rt.cluster.head.call(
+            "list_actors", {"node": node, "state": state_f})
         for r in rows:
             r["actor_id"] = r["actor_id"].hex()[:16]
     elif args.what == "jobs":
         from ray_tpu import job as job_mod
 
         rows = job_mod.list_jobs()
+        if state_f:
+            rows = [j for j in rows
+                    if j.get("status") == state_f.upper()]
     elif args.what == "tasks":
         # Task/object tables are per-node runtime state; the head has
         # no global view (reference: the state API aggregates via
-        # per-node agents).  Gather over the nodes' RPC servers.
-        rows = _gather_node_state(rt, "tasks")
+        # per-node agents).  Gather over the nodes' RPC servers;
+        # trace/state filters ship WITH the RPC and apply node-side.
+        rows = _gather_node_state(
+            rt, "tasks", node=node,
+            filters={"trace_id": trace_id, "state": state_f})
     elif args.what == "objects":
-        rows = _gather_node_state(rt, "objects")
+        rows = _gather_node_state(rt, "objects", node=node)
     else:
         print(f"unknown listing {args.what!r}", file=sys.stderr)
         return 2
@@ -108,16 +123,24 @@ def cmd_list(args) -> int:
     return 0
 
 
-def _gather_node_state(rt, what: str):
+def _gather_node_state(rt, what: str, node=None, filters=None):
     """Per-node task/object state over the node RPC servers (the
-    driver's own runtime is empty — it just connected)."""
+    driver's own runtime is empty — it just connected).  ``node``
+    restricts which nodes are asked at all; ``filters`` ride the RPC
+    and are applied by the node before its reply ships."""
     out = []
+    filters = {k: v for k, v in (filters or {}).items()
+               if v is not None}
     for n in rt.cluster.list_nodes():
         if not n.get("alive"):
             continue
+        if node and not (n["node_id"].startswith(node)
+                         or n.get("name") == node):
+            continue
         try:
             resp = rt.cluster.pool.get(n["address"]).call(
-                "node_state", {"what": what}, timeout=30.0)
+                "node_state", {"what": what, "filters": filters},
+                timeout=30.0)
             out.append({"node": n.get("name") or n["node_id"][:12],
                         what: resp})
         except Exception as e:  # noqa: BLE001
@@ -148,20 +171,59 @@ def cmd_memory(args) -> int:
 
 
 def cmd_logs(args) -> int:
+    """Three modes (reference: ``ray logs`` + the log monitor's
+    driver-routed streams, log_monitor.py:103):
+
+    - ``logs <node>`` — legacy raw tail of that node's log file;
+    - ``logs --trace <id> [--node/--actor/--level/...]`` — structured
+      query, filtered SERVER-SIDE at the head (``cluster_logs``);
+    - ``logs -f`` — follow mode: stream records to the driver as the
+      head ingests them (the ``logs`` pubsub channel)."""
     rt = _connect(args.address)
+    from ray_tpu.observability import logs as logs_mod
+
+    # ANY structured filter selects structured mode — `logs <node>
+    # --level ERROR` must not silently drop the level filter and
+    # return the raw tail; the positional then acts as --node.
+    structured = bool(args.trace or args.follow or args.level
+                      or args.actor or args.grep or args.node)
+    if args.node_tail and not structured:
+        return _tail_node_file(rt, args.node_tail, args.bytes)
+    filters = {k: v for k, v in {
+        "trace_id": args.trace, "node": args.node or args.node_tail,
+        "actor": args.actor, "level": args.level,
+        "text": args.grep,
+    }.items() if v}
+    if args.follow:
+        try:
+            for rec in logs_mod.follow(rt.cluster, **filters):
+                print(logs_mod.format_record(rec), flush=True)
+        except KeyboardInterrupt:
+            return 0
+        return 0
+    records = logs_mod.query_cluster(rt.cluster, limit=args.limit,
+                                     **filters)
+    for rec in records:
+        print(logs_mod.format_record(rec))
+    if not records:
+        print("(no matching records)", file=sys.stderr)
+    return 0
+
+
+def _tail_node_file(rt, node: str, tail_bytes: int) -> int:
     for n in rt.cluster.list_nodes():
-        if not (n["node_id"].startswith(args.node)
-                or n.get("name") == args.node):
+        if not (n["node_id"].startswith(node)
+                or n.get("name") == node):
             continue
         if not n["alive"]:
-            print(f"node {args.node!r} is dead; its log file lives on "
+            print(f"node {node!r} is dead; its log file lives on "
                   f"that host's --log-dir", file=sys.stderr)
             return 1
         try:
             resp = rt.cluster.pool.get(n["address"]).call(
-                "tail_log", {"bytes": args.bytes}, timeout=30.0)
+                "tail_log", {"bytes": tail_bytes}, timeout=30.0)
         except Exception as e:  # noqa: BLE001
-            print(f"node {args.node!r} unreachable: {e}",
+            print(f"node {node!r} unreachable: {e}",
                   file=sys.stderr)
             return 1
         if not resp.get("found"):
@@ -170,8 +232,61 @@ def cmd_logs(args) -> int:
             return 1
         sys.stdout.write(resp["data"])
         return 0
-    print(f"no node matching {args.node!r}", file=sys.stderr)
+    print(f"no node matching {node!r}", file=sys.stderr)
     return 1
+
+
+def cmd_profile(args) -> int:
+    """On-demand sampling profile of a node process or an actor
+    (reference: the reporter module's profile_manager endpoints) —
+    collapsed-stack flamegraph text by default, Chrome-trace JSON
+    with --chrome (mergeable with `ray_tpu timeline` output)."""
+    rt = _connect(args.address)
+    thread_filter = args.thread or None
+    target_node = args.node or None
+    if args.actor:
+        # Resolve the actor to its node; its executor threads are
+        # named "actor-<name>..." so the sampler can isolate them.
+        found = {}
+        for ns in ([args.namespace] if args.namespace
+                   else ["default", ""]):
+            found = rt.cluster.head.call(
+                "lookup_named_actor", {"name": args.actor,
+                                       "namespace": ns},
+                timeout=10.0)
+            if found.get("found"):
+                break
+        if not found.get("found"):
+            print(f"no actor named {args.actor!r}", file=sys.stderr)
+            return 1
+        target_node = found["node_id"]
+        thread_filter = thread_filter or f"actor-{args.actor}"
+    payload = {"duration_s": args.duration,
+               "interval_s": args.interval,
+               "thread_filter": thread_filter}
+    prof = None
+    for n in rt.cluster.list_nodes():
+        if target_node and not (n["node_id"].startswith(target_node)
+                                or n.get("name") == target_node):
+            continue
+        if not target_node and n["node_id"] != rt.cluster.node_id:
+            continue
+        prof = rt.cluster.pool.get(n["address"]).call(
+            "profile", payload, timeout=args.duration + 30.0)
+        break
+    if prof is None:
+        print(f"no node matching {target_node!r}", file=sys.stderr)
+        return 1
+    body = (json.dumps(prof["chrome"]) if args.chrome
+            else prof["collapsed"])
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(body)
+        print(f"wrote {args.output} ({prof['num_samples']} samples, "
+              f"{len(prof['threads'])} threads)")
+    else:
+        print(body)
+    return 0
 
 
 def cmd_dashboard(args) -> int:
@@ -273,6 +388,15 @@ def main(argv=None) -> int:
     p.add_argument("what", choices=["nodes", "actors", "jobs",
                                     "tasks", "objects"])
     p.add_argument("--address", required=True)
+    p.add_argument("--trace-id", default="",
+                   help="tasks: only rows of this distributed trace "
+                        "(applied node-side)")
+    p.add_argument("--node", default="",
+                   help="node id prefix or name filter "
+                        "(applied server-side)")
+    p.add_argument("--state", default="",
+                   help="actors/jobs/tasks: state filter, e.g. ALIVE "
+                        "/ PENDING / SUCCEEDED")
     p.set_defaults(fn=cmd_list)
 
     p = sub.add_parser("timeline", help="export Chrome trace")
@@ -284,11 +408,56 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_memory)
 
-    p = sub.add_parser("logs", help="tail a node's log file")
-    p.add_argument("node", help="node id prefix or name")
+    p = sub.add_parser(
+        "logs", help="structured cluster logs (query/follow) or a "
+                     "node's raw log tail")
+    p.add_argument("node_tail", nargs="?", default="",
+                   metavar="node",
+                   help="node id prefix or name: raw file tail mode")
     p.add_argument("--address", required=True)
-    p.add_argument("--bytes", type=int, default=64 * 1024)
+    p.add_argument("--bytes", type=int, default=64 * 1024,
+                   help="raw tail mode: bytes to fetch")
+    p.add_argument("--trace", default="",
+                   help="only records of this trace id (the "
+                        "cross-process correlation query)")
+    p.add_argument("--node", default="",
+                   help="only records shipped by this node "
+                        "(id prefix)")
+    p.add_argument("--actor", default="",
+                   help="only records from this actor (id prefix)")
+    p.add_argument("--level", default="",
+                   type=lambda s: s.upper(),
+                   choices=["", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "CRITICAL"],
+                   help="minimum level (DEBUG/INFO/WARNING/ERROR)")
+    p.add_argument("--grep", default="",
+                   help="message substring filter")
+    p.add_argument("--limit", type=int, default=1000)
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="stream new records to this terminal")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser(
+        "profile", help="sampling profile of a node or actor "
+                        "(collapsed-stack flamegraph text)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--node", default="",
+                   help="node id prefix or name (default: the "
+                        "driver-attached node)")
+    p.add_argument("--actor", default="",
+                   help="profile the node hosting this named actor, "
+                        "filtered to its executor threads")
+    p.add_argument("--namespace", default="")
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--interval", type=float, default=0.01)
+    p.add_argument("--thread", default="",
+                   help="thread-name substring filter")
+    p.add_argument("--chrome", action="store_true",
+                   help="emit Chrome-trace JSON instead of "
+                        "collapsed stacks")
+    p.add_argument("-o", "--output", default="",
+                   help="write to a file instead of stdout")
+    p.set_defaults(fn=cmd_profile)
 
     from ray_tpu.tools.raylint.cli import add_lint_parser
 

@@ -17,14 +17,18 @@ the handle, the RPC envelope, and the replica mailbox); a typed
 from __future__ import annotations
 
 import json
+import logging
 import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ..observability import tracing as _tracing
+
 DEADLINE_HEADER = "X-Request-Deadline-S"
 _DEFAULT_TIMEOUT_S = 60.0
+_access_log = logging.getLogger("ray_tpu.serve.http")
 
 
 class _Proxy:
@@ -62,11 +66,20 @@ class _Proxy:
                 timeout = (deadline_s if deadline_s
                            else _DEFAULT_TIMEOUT_S)
                 extra_headers = []
+                t_req0 = time.time()
+                trace_id = None
                 try:
                     payload = json.loads(raw) if raw else None
                     # The ingress deadline scope makes the handle (and
-                    # everything downstream of it) inherit the budget.
-                    with _deadlines.scope(deadline):
+                    # everything downstream of it) inherit the budget;
+                    # the ingress SPAN makes this HTTP request the
+                    # trace root, so the access-log record, the
+                    # replica's spans, and its log lines all share one
+                    # trace id.
+                    with _deadlines.scope(deadline), \
+                            _tracing.span("http.request",
+                                          {"deployment": name}) as span:
+                        trace_id = span.trace_id
                         result = handle.remote(payload).result(
                             timeout=timeout)
                     body = json.dumps({"result": result}).encode()
@@ -90,6 +103,16 @@ class _Proxy:
                     body = json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode()
                     status = 500
+                # Access-log record (structured plane): one line per
+                # request, carrying the ingress trace id — `ray_tpu
+                # logs --trace <id>` pulls the proxy line next to the
+                # replica's.  Lazy %-args: this is the serving hot
+                # path (raylint log-hygiene).
+                if _access_log.isEnabledFor(logging.DEBUG):
+                    _access_log.debug(
+                        "%s %s -> %d in %.1fms trace=%s", name,
+                        self.command, status,
+                        (time.time() - t_req0) * 1e3, trace_id)
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

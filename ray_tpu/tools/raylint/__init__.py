@@ -14,6 +14,9 @@ distributed-runtime invariants the test suite can only sample:
                             close on some path
 - ``thread-hygiene``        daemon= required; self-stored threads need
                             a teardown join
+- ``unbounded-mailbox``     demand-driven queues must bound or reject
+- ``log-hygiene``           lazy %-args on hot-path logger calls; no
+                            bare print() in runtime modules
 - ``suppression-syntax``    disables must name real rules + a reason
 
 Suppress a finding in place::

@@ -823,6 +823,104 @@ def rule_unbounded_mailbox(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: log-hygiene
+# --------------------------------------------------------------------------
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+# Receivers that read as loggers ("logger", "_log", "_access_log", ...)
+_LOGGER_NAME_RE = re.compile(r"(^|_)log(ger)?s?($|_)|logger", re.I)
+# Hot/dispatch-path method names: the unbounded-mailbox token set plus
+# the execution/data-plane verbs — a record formatted EAGERLY there is
+# paid even when the level is off.
+_HOT_PATH_RE = re.compile(
+    r"(?:^|_)(submit|dispatch|enqueue|push|send|put|call|request|recv|"
+    r"handle|deliver|ship|ingest|accept|execute|step|read|write|flush|"
+    r"poll|emit|sample|observe|record)(?:_|$)|(?:^|_)on_", re.I)
+# Modules where bare print() IS the interface (CLI entry points).
+_PRINT_OK_MODULE_RE = re.compile(
+    r"(^|\.)((scripts|tools)(\.|$)|__main__$|worker_main$|bench)")
+
+
+def _is_logger_call(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _LOG_METHODS):
+        return None
+    recv = f.value
+    name = ""
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    elif isinstance(recv, ast.Call):
+        cf = recv.func
+        cname = cf.attr if isinstance(cf, ast.Attribute) else \
+            getattr(cf, "id", "")
+        if cname == "getLogger":
+            return f"getLogger(...).{f.attr}"
+    if name and _LOGGER_NAME_RE.search(name):
+        return f"{name}.{f.attr}"
+    return None
+
+
+def _eager_format_kind(arg: ast.AST) -> Optional[str]:
+    """How the message argument is PRE-formatted (paid even when the
+    level is disabled), or None when it is lazy."""
+    if isinstance(arg, ast.JoinedStr):
+        return "f-string"
+    if isinstance(arg, ast.Call) and \
+            isinstance(arg.func, ast.Attribute) and \
+            arg.func.attr == "format":
+        return ".format(...)"
+    if isinstance(arg, ast.BinOp):
+        if isinstance(arg.op, ast.Mod):
+            return "'%'-interpolated string"
+        if isinstance(arg.op, ast.Add):
+            for side in (arg.left, arg.right):
+                if isinstance(side, ast.Constant) and \
+                        isinstance(side.value, str):
+                    return "string concatenation"
+    return None
+
+
+def rule_log_hygiene(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "log-hygiene")
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        on_hot_path = bool(_HOT_PATH_RE.search(fi.name))
+        print_ok = (_PRINT_OK_MODULE_RE.search(info.name) is not None
+                    or fi.name == "main")
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # (a) bare print() in runtime modules: output that bypasses
+            # the structured plane entirely (no level, no trace stamp,
+            # no shipping) — CLI entry points are the one legit home.
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "print" and not print_ok:
+                out.add(info, node.lineno, fi.qualname,
+                        "bare print() in a runtime module — use a "
+                        "logger (records get trace-stamped and "
+                        "shipped) or move output to the CLI layer")
+                continue
+            # (b) eager formatting in logger calls on hot paths: the
+            # formatting cost is paid per call even with the level
+            # off; %-style args defer it to the handler.
+            if not on_hot_path or not node.args:
+                continue
+            desc = _is_logger_call(node)
+            if desc is None:
+                continue
+            kind = _eager_format_kind(node.args[0])
+            if kind is not None:
+                out.add(info, node.lineno, fi.qualname,
+                        f"{desc}({kind}) on hot-path method "
+                        f"{fi.name!r} pre-formats its message — pass "
+                        f"lazy %-style args instead")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
 # rule: suppression-syntax (meta): disables must carry a reason and
 # name real rules — a typo'd disable that silently fails to suppress
 # (or a reasonless one) is itself a finding
@@ -855,6 +953,7 @@ RULES = {
     "resource-teardown": rule_resource_teardown,
     "thread-hygiene": rule_thread_hygiene,
     "unbounded-mailbox": rule_unbounded_mailbox,
+    "log-hygiene": rule_log_hygiene,
     "suppression-syntax": rule_suppression_syntax,
 }
 
@@ -898,6 +997,12 @@ RULE_DOCS = {
         "bound check in the method is the OOM-under-overload failure "
         "class: demand-driven queues must reject (BackPressureError / "
         "maxsize) or carry a reasoned disable."),
+    "log-hygiene": (
+        "Logger calls on dispatch/hot-path methods must pass lazy "
+        "%-style args (no f-string/.format/%/concat pre-formatting — "
+        "the cost is paid even when the level is off), and runtime "
+        "modules must not use bare print() (unleveled, untraced, "
+        "unshipped output; CLI entry points are exempt)."),
     "suppression-syntax": (
         "raylint disables must name real rules and carry a "
         "'-- reason'; a reasonless or typo'd disable does not "

@@ -33,6 +33,7 @@ TRUE global norm, and each stage applies the same clip scale.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -40,6 +41,8 @@ import numpy as np
 import ray_tpu
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.parallel.mesh import MeshSpec
+
+_log = logging.getLogger("ray_tpu.train")
 
 PyTree = Any
 
@@ -346,7 +349,7 @@ class CrossSlicePipeline:
         # One trace per train step: every microbatch task on every
         # stage (and the retried wave, if any) shares the trace id.
         with tracing.span("train.step",
-                          args={"stages": self.n_stages}):
+                          args={"stages": self.n_stages}) as span:
             try:
                 self._run_wave(tokens)
             except (ActorError, ChannelError, ObjectLostError,
@@ -357,6 +360,12 @@ class CrossSlicePipeline:
                     raise
                 if not self._recover_stages():
                     raise
+                # The recovery that used to be only a counter is now a
+                # correlated log line: `logs --trace <step trace>`
+                # shows WHY this step was slow next to its spans.
+                _log.warning(
+                    "train.step wave retried after %s trace=%s",
+                    type(cause).__name__, span.trace_id)
                 self._run_wave(tokens)
             return self._apply_updates()
 

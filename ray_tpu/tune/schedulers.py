@@ -164,20 +164,31 @@ class MedianStoppingRule:
         n = len(vals)
         if n == 0 or iteration < self.grace_period:
             return CONTINUE
-        # Peers one report behind still count (the controller polls
-        # round-robin, so at this trial's turn its peers are typically
-        # at n-1); averages compare over the shared prefix.
+        # A peer is comparable once it has grace_period reports (or
+        # n-1 when this trial itself has fewer): early-stopped peers'
+        # FROZEN histories must stay in the comparison set, or the
+        # truly-worst trial outlives its comparables and runs to
+        # completion once the rule has stopped everyone else.
+        floor = max(1, min(n - 1, self.grace_period))
         others = [t for t, r in self._results.items()
-                  if t != trial_id and len(r) >= max(1, n - 1)]
+                  if t != trial_id and len(r) >= floor]
         if len(others) + 1 < self.min_samples_required:
             return CONTINUE
-        medians = sorted(
-            self._running_avg(t, min(n, len(self._results[t])))
-            for t in others)
-        if not medians:
-            return CONTINUE
-        median = medians[len(medians) // 2]
+        # ONE shared horizon for every average: a running average of a
+        # monotone metric grows with its prefix length, so comparing
+        # this trial's avg-over-k against peers' averages over LONGER
+        # prefixes systematically mis-ranks whichever trial the
+        # controller happened to poll mid-batch (observed: a healthy
+        # trial stopped because a peer's history ran one report
+        # ahead).
         k = min([n] + [len(self._results[t]) for t in others])
+        avgs = sorted(self._running_avg(t, k) for t in others)
+        if not avgs:
+            return CONTINUE
+        # TRUE median: with an even peer count, upper-mid alone would
+        # compare this trial against the BEST of two peers.
+        m = len(avgs)
+        median = (avgs[m // 2] + avgs[(m - 1) // 2]) / 2.0
         if self._running_avg(trial_id, k) < median:
             return STOP if self.hard_stop else CONTINUE
         return CONTINUE

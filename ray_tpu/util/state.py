@@ -32,6 +32,9 @@ def list_tasks(*, include_done: bool = False) -> List[Dict[str, Any]]:
             "kind": ("ACTOR_CREATION" if spec.is_actor_creation else
                      "ACTOR_TASK" if spec.is_actor_task else "TASK"),
             "attempt": spec.attempt_number,
+            # In-flight tasks must be filterable by --trace-id too —
+            # a currently-stuck pass is the query's whole point.
+            "trace_id": spec.trace_id,
         })
     if include_done:
         from ..observability.timeline import export_timeline

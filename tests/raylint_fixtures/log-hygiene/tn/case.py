@@ -1,0 +1,35 @@
+"""True negatives: lazy %-style args on hot paths, eager formatting
+OFF the hot path, prints in CLI entry points, and non-logger calls."""
+
+import logging
+
+logger = logging.getLogger("fixture")
+
+
+class Dispatcher:
+    def handle_request(self, req):
+        logger.info("handling %s", req)           # lazy: fine
+
+    def submit(self, spec):
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug("spec %r depth=%d", spec, 3)
+
+    def describe(self):
+        # NOT a hot-path method name: eager formatting tolerated
+        logger.info(f"dispatcher state: {self!r}")
+
+    def push_frame(self, frame):
+        # a non-logger receiver whose name merely contains text
+        self.catalog.info(f"frame {frame}")
+
+    @property
+    def catalog(self):
+        class _C:
+            def info(self, msg):
+                return msg
+
+        return _C()
+
+
+def main():
+    print("CLI entry points may print")

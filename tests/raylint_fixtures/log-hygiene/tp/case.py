@@ -1,0 +1,24 @@
+"""True positives: eager log formatting on hot paths and bare
+print() in a runtime module."""
+
+import logging
+
+logger = logging.getLogger("fixture")
+_log = logging.getLogger("fixture.other")
+
+
+class Dispatcher:
+    def handle_request(self, req):
+        logger.info(f"handling {req}")            # finding: f-string
+
+    def submit(self, spec):
+        _log.debug("spec {}".format(spec))        # finding: .format
+
+    def on_recv(self, frame):
+        logger.warning("frame %s" % frame)        # finding: % interp
+
+    def push_frame(self, frame):
+        logger.error("bad frame: " + str(frame))  # finding: concat
+
+    def helper(self):
+        print("runtime print")                    # finding: bare print

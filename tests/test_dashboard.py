@@ -77,5 +77,71 @@ def test_dashboard_local_mode(ray_start_regular):
         assert json.loads(body)["tasks"] is not None
         _ctype, body = _get(dash.url + "/api/jobs")
         assert json.loads(body) == []
+        # local-mode /api/logs answers from the process ring
+        import logging
+
+        logging.getLogger("ray_tpu.dash").warning("dash %s", "probe")
+        _ctype, body = _get(dash.url + "/api/logs?level=WARNING"
+                            "&text=dash%20probe")
+        recs = json.loads(body)["records"]
+        assert recs and recs[0]["msg"] == "dash probe"
+        # local-mode /api/profile samples this process
+        _ctype, body = _get(dash.url + "/api/profile?duration=0.3")
+        prof = json.loads(body)
+        assert prof["num_samples"] > 0 and prof["collapsed"]
     finally:
         stop_dashboard()
+
+
+def _post(url: str, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_dashboard_job_submit_rest(tmp_path):
+    """The dashboard is no longer read-only: POST /api/jobs/ submits
+    through the existing supervisor path; status + logs read back over
+    GET (reference: job_head.py:329 REST endpoints)."""
+    import sys
+    import time
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.connect(num_cpus=2)
+    dash = start_dashboard(port=0)
+    try:
+        status, resp = _post(
+            dash.url + "/api/jobs/",
+            {"entrypoint":
+             f"{sys.executable} -c \"print('rest-job-ok')\""})
+        assert status == 200 and resp["job_id"]
+        job_id = resp["job_id"]
+        deadline = time.monotonic() + 60
+        while True:
+            _ctype, body = _get(f"{dash.url}/api/jobs/{job_id}")
+            info = json.loads(body)
+            if info["status"] in ("SUCCEEDED", "FAILED", "STOPPED"):
+                break
+            assert time.monotonic() < deadline, info
+            time.sleep(0.3)
+        assert info["status"] == "SUCCEEDED"
+        ctype, body = _get(f"{dash.url}/api/jobs/{job_id}/logs")
+        assert "text/plain" in ctype
+        assert b"rest-job-ok" in body
+        # the job table shows it too
+        _ctype, body = _get(dash.url + "/api/jobs")
+        assert any(j["job_id"] == job_id for j in json.loads(body))
+        # bad submissions are 400s, not crashes
+        try:
+            _post(dash.url + "/api/jobs/", {})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        stop_dashboard()
+        ray_tpu.shutdown()
+        c.shutdown()

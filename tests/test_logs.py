@@ -1,0 +1,519 @@
+"""Structured log plane + on-demand profiling (reference: the
+log_monitor / dashboard log+reporter modules, grown trace-correlated).
+
+The acceptance scenario lives here: a cross-process compiled-DAG pass
+→ `ray_tpu logs --trace <id>` returns structured records from ≥3
+distinct processes sharing the trace id, the same id filters the
+dashboard's /api/logs, follow mode streams records to the driver, the
+sampling profiler flamegraphs a busy actor, and the stuck detector
+snapshots a chaos-stalled dispatch.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.observability import logs as logs_mod
+from ray_tpu.observability import profiling
+from ray_tpu.observability.timeline import clear as clear_timeline
+
+pytestmark = pytest.mark.logs
+
+
+@pytest.fixture(autouse=True)
+def fresh_buffers():
+    logs_mod.clear()
+    clear_timeline()
+    yield
+    logs_mod.clear()
+    clear_timeline()
+
+
+def _channels_or_skip():
+    from ray_tpu.experimental.channel import channels_available
+
+    if not channels_available():
+        pytest.skip("native channel lib unavailable")
+
+
+# ---------------------------------------------------------------------------
+# The record ring + ring file primitives
+# ---------------------------------------------------------------------------
+
+class TestRecordRing:
+    def test_drain_since_and_drop_oldest(self):
+        logs_mod.set_capacity(5)
+        try:
+            for i in range(8):
+                logs_mod.emit_record({"msg": f"r{i}", "levelno": 20,
+                                      "level": "INFO", "logger": "t"})
+            assert logs_mod.dropped_records() == 3
+            records, cursor = logs_mod.drain_since(0)
+            assert [r["msg"] for r in records] == [
+                f"r{i}" for i in range(3, 8)]
+            # nothing new: empty drain, stable cursor
+            again, cursor2 = logs_mod.drain_since(cursor)
+            assert again == [] and cursor2 == cursor
+        finally:
+            logs_mod.set_capacity(20000)
+
+    def test_disable_no_ops(self):
+        logs_mod.disable()
+        try:
+            logs_mod.emit_record({"msg": "ghost"})
+            logging.getLogger("ray_tpu.t").warning("ghost too")
+        finally:
+            logs_mod.enable()
+        assert logs_mod.query(text="ghost") == []
+
+    def test_filter_records(self):
+        rows = [
+            {"msg": "a", "levelno": 20, "trace_id": "t1",
+             "node": "n1abc", "ts": 1.0, "logger": "x"},
+            {"msg": "b", "levelno": 40, "trace_id": "t2",
+             "node": "n2abc", "ts": 2.0, "logger": "y",
+             "actor": "deadbeef"},
+        ]
+        assert [r["msg"] for r in logs_mod.filter_records(
+            rows, trace_id="t2")] == ["b"]
+        assert [r["msg"] for r in logs_mod.filter_records(
+            rows, node="n1")] == ["a"]
+        assert [r["msg"] for r in logs_mod.filter_records(
+            rows, level="ERROR")] == ["b"]
+        assert [r["msg"] for r in logs_mod.filter_records(
+            rows, actor="dead")] == ["b"]
+        assert [r["msg"] for r in logs_mod.filter_records(
+            rows, since=1.5)] == ["b"]
+        assert [r["msg"] for r in logs_mod.filter_records(
+            rows, limit=1)] == ["b"]  # newest kept
+
+    def test_ring_file_rotation_and_drop_counters(self, tmp_path):
+        path = str(tmp_path / "ring.jsonl")
+        rf = logs_mod.RingFile(path, max_bytes=200)
+        line = json.dumps({"msg": "x" * 40})
+        for _ in range(20):
+            rf.write(line)
+        assert rf.rotations >= 1
+        assert os.path.exists(path + ".1")
+        assert os.path.getsize(path) <= 200 + len(line) + 1
+        # disk still holds the tail of the stream across both segments
+        lines = rf.read_lines()
+        assert lines and all(json.loads(ln)["msg"] == "x" * 40
+                             for ln in lines)
+        rf.close()
+        # a write target that cannot be opened counts drops, not raises
+        bad = logs_mod.RingFile(str(tmp_path), max_bytes=100)  # a dir
+        bad.write("nope")
+        assert bad.dropped == 1
+
+    def test_stdio_tee_emits_records(self):
+        import io
+
+        tee = logs_mod._StreamTee(io.StringIO(), "stdout",
+                                  logging.INFO)
+        tee.write("partial")
+        assert logs_mod.query(text="partial") == []  # no newline yet
+        tee.write(" line\nnext\n")
+        recs = logs_mod.query(logger="stdout")
+        assert [r["msg"] for r in recs] == ["partial line", "next"]
+        assert all(r["stream"] == "stdout" for r in recs)
+
+
+class TestHandlerStamping:
+    def test_task_context_stamps_records(self, ray_start_regular):
+        @ray_tpu.remote
+        def chatty():
+            logging.getLogger("ray_tpu.app").info("inside %s", "task")
+            return 1
+
+        assert ray_tpu.get(chatty.remote()) == 1
+        recs = logs_mod.query(logger="ray_tpu.app")
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["msg"] == "inside task"
+        assert r["trace_id"] and r["span_id"]
+        assert r["task"].endswith("chatty")
+        # The runtime's own per-task record shares the trace id.  It
+        # is emitted in the executor's finally, a hair AFTER get()
+        # unblocks — poll briefly.
+        deadline = time.monotonic() + 5
+        while True:
+            task_recs = logs_mod.query(logger="ray_tpu.task",
+                                       trace_id=r["trace_id"])
+            if task_recs or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        assert task_recs and "chatty" in task_recs[0]["msg"]
+
+    def test_async_actor_interleaved_stamping(self, ray_start_regular):
+        """Identity follows each request across awaits: an async actor
+        interleaving requests on ONE event-loop thread must stamp each
+        request's records with its OWN trace id (the context is a
+        per-asyncio-task ContextVar, not a thread-local the next
+        dispatch overwrites)."""
+        import asyncio
+
+        @ray_tpu.remote
+        class AsyncChatty:
+            async def work(self, tag, delay):
+                logging.getLogger("ray_tpu.app").info("pre %s", tag)
+                await asyncio.sleep(delay)
+                logging.getLogger("ray_tpu.app").info("post %s", tag)
+                return tag
+
+        a = AsyncChatty.options(max_concurrency=8).remote()
+        # Staggered delays force resumption order != dispatch order.
+        refs = [a.work.remote(f"t{i}", 0.2 - i * 0.04)
+                for i in range(5)]
+        assert ray_tpu.get(refs) == [f"t{i}" for i in range(5)]
+        by_msg = {r["msg"]: r
+                  for r in logs_mod.query(logger="ray_tpu.app")}
+        tids = set()
+        for i in range(5):
+            pre = by_msg[f"pre t{i}"]
+            post = by_msg[f"post t{i}"]
+            assert pre["trace_id"] and \
+                pre["trace_id"] == post["trace_id"], (pre, post)
+            tids.add(pre["trace_id"])
+        assert len(tids) == 5
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: cross-process correlation, one command
+# ---------------------------------------------------------------------------
+
+class TestClusterLogPlane:
+    def _cluster(self):
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        c = Cluster()
+        env = {"RAY_TPU_EVENT_FLUSH_S": "0.2"}
+        c.add_node(num_cpus=2, resources={"d0": 10}, env=env)
+        c.add_node(num_cpus=2, resources={"d1": 10}, env=env)
+        c.connect(num_cpus=2)
+        return c
+
+    def test_trace_correlated_query_across_processes(self,
+                                                     shutdown_only):
+        """A 2-worker compiled-DAG pass, then ONE query: records from
+        ≥3 distinct OS processes share the pass's trace id — through
+        the head RPC, the `ray_tpu logs --trace` CLI, the dashboard's
+        /api/logs, and the merged timeline's log instants."""
+        _channels_or_skip()
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+        c = self._cluster()
+        rt = ray_tpu.get_runtime()
+        try:
+            @ray_tpu.remote
+            class Stage:
+                def step(self, x):
+                    logging.getLogger("ray_tpu.app").info(
+                        "stage step %s", x)
+                    return x + 1
+
+            with InputNode() as inp:
+                a = Stage.options(resources={"d0": 1}).bind()
+                b = Stage.options(resources={"d1": 1}).bind()
+                dag = b.step.bind(a.step.bind(inp))
+            compiled = dag.experimental_compile()
+            for i in range(3):
+                assert ray_tpu.get(compiled.execute(i)) == i + 2
+
+            # the driver's own per-pass record carries the trace id
+            driver_recs = logs_mod.query(logger="ray_tpu.dag")
+            assert driver_recs, "driver emitted no dag pass record"
+            tid = driver_recs[-1]["trace_id"]
+
+            deadline = time.monotonic() + 30
+            while True:
+                recs = logs_mod.query_cluster(rt.cluster,
+                                              trace_id=tid)
+                lanes = {r.get("lane") for r in recs}
+                if len(lanes) >= 3:
+                    break
+                assert time.monotonic() < deadline, \
+                    f"only {lanes} shipped: {recs}"
+                time.sleep(0.3)
+            assert all(r["trace_id"] == tid for r in recs)
+            # worker USER records and runtime task records both present
+            assert {"ray_tpu.app", "ray_tpu.task",
+                    "ray_tpu.dag"} <= {r["logger"] for r in recs}
+
+            # the CLI one-liner (fresh process, own connection)
+            out = subprocess.run(
+                [sys.executable, "-m", "ray_tpu", "logs",
+                 "--address", c.head_address, "--trace", tid],
+                capture_output=True, text=True, timeout=120,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            assert out.returncode == 0, out.stderr
+            cli_lines = [ln for ln in out.stdout.splitlines()
+                         if tid in ln]
+            assert len(cli_lines) >= 3, out.stdout
+            nodes_in_cli = {ln.split()[2] for ln in cli_lines}
+            assert len(nodes_in_cli) >= 3  # three distinct processes
+
+            # the same id filters /api/logs
+            dash = start_dashboard(port=0)
+            try:
+                body = urllib.request.urlopen(
+                    f"{dash.url}/api/logs?trace_id={tid}",
+                    timeout=15).read()
+                api = json.loads(body)["records"]
+                assert api and all(r["trace_id"] == tid for r in api)
+                assert len({r.get("lane") for r in api}) >= 3
+            finally:
+                stop_dashboard()
+
+            # and the merged timeline renders them as instant events
+            instants = [e for e in ray_tpu.timeline()
+                        if e["name"].startswith("log:")
+                        and e.get("args", {}).get("trace_id") == tid]
+            assert len(instants) >= 3
+            compiled.teardown()
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+    def test_follow_mode_streams_to_driver(self, shutdown_only):
+        c = self._cluster()
+        rt = ray_tpu.get_runtime()
+        try:
+            got: list = []
+            stop = threading.Event()
+
+            def consume():
+                try:
+                    for rec in logs_mod.follow(
+                            rt.cluster, poll_timeout_s=1.0,
+                            stop=stop, logger="ray_tpu.follow"):
+                        got.append(rec)
+                        return
+                except ConnectionError:
+                    pass
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+
+            @ray_tpu.remote(resources={"d0": 1})
+            def emit():
+                logging.getLogger("ray_tpu.follow").warning(
+                    "follow %s", "me")
+                return 1
+
+            assert ray_tpu.get(emit.remote(), timeout=30) == 1
+            deadline = time.monotonic() + 20
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.2)
+            stop.set()
+            t.join(timeout=15)
+            assert got and got[0]["msg"] == "follow me"
+            assert got[0]["logger"] == "ray_tpu.follow"
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+    def test_worker_stdout_captured_and_correlated(self,
+                                                   shutdown_only):
+        """Bare print() in worker task code lands in the shipped
+        stream with the task's trace id (stdio capture)."""
+        c = self._cluster()
+        rt = ray_tpu.get_runtime()
+        try:
+            @ray_tpu.remote(resources={"d1": 1})
+            def shouty():
+                print("stdout-says-hi")
+                return 1
+
+            assert ray_tpu.get(shouty.remote(), timeout=30) == 1
+            deadline = time.monotonic() + 20
+            while True:
+                recs = logs_mod.query_cluster(
+                    rt.cluster, text="stdout-says-hi")
+                if recs:
+                    break
+                assert time.monotonic() < deadline
+                time.sleep(0.3)
+            assert recs[0]["stream"] == "stdout"
+            assert recs[0]["trace_id"]  # correlated, not just captured
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Profiling + stuck detector
+# ---------------------------------------------------------------------------
+
+class TestProfiling:
+    def test_profiler_flamegraph_of_busy_actor(self, shutdown_only):
+        """`ray_tpu profile --actor` on a live actor yields a
+        non-empty collapsed-stack flamegraph whose hot frame is the
+        actor's busy method."""
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        c = Cluster()
+        c.add_node(num_cpus=2, resources={"p": 10})
+        rt = c.connect(num_cpus=2)
+        try:
+            @ray_tpu.remote(resources={"p": 1})
+            class Burner:
+                def spin(self, seconds):
+                    t0 = time.monotonic()
+                    x = 0
+                    while time.monotonic() - t0 < seconds:
+                        x += 1
+                    return x
+
+            b = Burner.options(name="prof-target").remote()
+            ref = b.spin.remote(30.0)  # keep it busy past the profile
+            time.sleep(0.5)
+            node = [n for n in rt.cluster.list_nodes()
+                    if n["total"].get("p")][0]
+            prof = rt.cluster.pool.get(node["address"]).call(
+                "profile", {"duration_s": 1.0,
+                            "thread_filter": "actor-prof-target"},
+                timeout=40.0)
+            assert prof["num_samples"] > 0
+            assert "spin" in prof["collapsed"]
+            # the chrome rendering reconstructs at least one slice
+            assert any(e["ph"] == "X" and "spin" in e["name"]
+                       for e in prof["chrome"])
+
+            # the CLI command surface (fresh process)
+            out = subprocess.run(
+                [sys.executable, "-m", "ray_tpu", "profile",
+                 "--address", c.head_address,
+                 "--actor", "prof-target", "--duration", "1.0"],
+                capture_output=True, text=True, timeout=120,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            assert out.returncode == 0, out.stderr
+            assert "spin" in out.stdout
+            ray_tpu.cancel(ref, force=True)
+            ray_tpu.kill(b)
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+    def test_chrome_trace_reconstruction(self):
+        prof = {
+            "samples": [
+                (0.00, 1, ("mod.a", "mod.b")),
+                (0.01, 1, ("mod.a", "mod.b")),
+                (0.02, 1, ("mod.a", "mod.c")),
+            ],
+            "threads": {1: "worker"},
+            "interval_s": 0.01,
+        }
+        events = profiling.chrome_trace(prof, pid="test")
+        spans = {e["name"]: e for e in events}
+        assert spans["mod.a"]["dur"] >= 0.02 * 1e6  # spans all samples
+        assert spans["mod.b"]["dur"] >= 0.01 * 1e6
+        assert "mod.c" in spans
+
+    @pytest.mark.chaos
+    def test_stuck_detector_snapshot_under_chaos_stall(
+            self, ray_start_regular):
+        """A chaos-stalled dispatch running STUCK_FACTOR x past its
+        deadline budget auto-captures a stack snapshot (timeline
+        instant + WARNING record + queryable snapshot)."""
+        from ray_tpu.exceptions import DeadlineExceededError
+        from ray_tpu.experimental import chaos
+
+        profiling.clear_stuck_snapshots()
+
+        @ray_tpu.remote
+        class Slow:
+            def work(self):
+                return "done"
+
+        s = Slow.remote()
+        # budget 0.3s, factor 3 → watchdog fires ~0.9s into the 2.5s
+        # injected stall; the shed then returns typed to the caller.
+        sched = chaos.schedule().slow_method("work", 2.5)
+        with sched:
+            with pytest.raises(DeadlineExceededError):
+                ray_tpu.get(
+                    s.work.options(deadline_s=0.3).remote(),
+                    timeout=30)
+        assert sched.fired("actor_slow") == 1
+        snaps = [sn for sn in profiling.stuck_snapshots()
+                 if sn["kind"] == "actor_dispatch"]
+        assert snaps, "no stuck snapshot captured"
+        snap = snaps[0]
+        assert snap["detail"]["method"] == "work"
+        assert snap["stacks"]  # the moment-of-wedge stacks came along
+        from ray_tpu.observability.timeline import export_timeline
+
+        events = [e for e in export_timeline()
+                  if e["name"] == "stuck_detector"]
+        assert events and events[0]["args"]["kind"] == "actor_dispatch"
+        warn = logs_mod.query(logger="ray_tpu.stuck")
+        assert warn and warn[0]["levelno"] >= logging.WARNING
+
+
+# ---------------------------------------------------------------------------
+# State API server-side filtering (satellite)
+# ---------------------------------------------------------------------------
+
+class TestStateFilters:
+    def test_head_filters_actor_listing(self, shutdown_only):
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        c = Cluster()
+        c.add_node(num_cpus=2, resources={"f": 10})
+        rt = c.connect(num_cpus=2)
+        try:
+            @ray_tpu.remote(resources={"f": 1})
+            class A:
+                def ping(self):
+                    return 1
+
+            a = A.options(name="filter-me").remote()
+            assert ray_tpu.get(a.ping.remote(), timeout=30) == 1
+            node = [n for n in rt.cluster.list_nodes()
+                    if n["total"].get("f")][0]["node_id"]
+            rows = rt.cluster.head.call(
+                "list_actors", {"node": node[:8]})
+            assert rows and all(
+                r["node_id"].startswith(node[:8]) for r in rows)
+            rows = rt.cluster.head.call(
+                "list_actors", {"node": "ffffnope"})
+            assert rows == []
+            rows = rt.cluster.head.call(
+                "list_actors", {"state": "RESTARTING"})
+            assert rows == []
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+    def test_node_state_trace_filter(self, ray_start_regular):
+        from ray_tpu.core.util_state_compat import node_state
+
+        @ray_tpu.remote
+        def traced():
+            return 1
+
+        assert ray_tpu.get(traced.remote()) == 1
+        everything = node_state(ray_tpu.get_runtime(), "tasks",
+                                filters={"include_done": True})
+        done = [t for t in everything["pending"]
+                if t.get("trace_id")]
+        assert done, "no finished traced tasks recorded"
+        tid = done[0]["trace_id"]
+        only = node_state(ray_tpu.get_runtime(), "tasks",
+                          filters={"trace_id": tid})
+        assert only["pending"] and all(
+            t["trace_id"] == tid for t in only["pending"])
+        none = node_state(ray_tpu.get_runtime(), "tasks",
+                          filters={"trace_id": "no-such-trace"})
+        assert none["pending"] == []
