@@ -410,13 +410,24 @@ class ClusterClient:
         self._stopped = threading.Event()
         # (expiry, demand) of the last failed spill placement.
         self._spill_noroom = (0.0, {})
-        # Synced cluster resource view (ray_syncer.h:83, hub-routed):
-        # availability piggybacks on every heartbeat reply; totals
-        # arrive when membership changes.  {node_id: {"available",
-        # "total", "alive"}} + a freshness stamp.
+        # Synced cluster resource view (ray_syncer.h:83, hub-routed),
+        # DELTA-COMPRESSED: the head sends only entries that changed
+        # since this node's acked view_seq (full view on first beat or
+        # when too far behind).  {node_id: {"available", "total",
+        # "alive"}} + a freshness stamp.
         self._view: Dict[str, Dict[str, Any]] = {}
-        self._view_version = None
+        self._view_seq = None
         self._view_stamp = 0.0
+        # Lease-fenced liveness (head.py): minted at registration,
+        # renewed by heartbeats; every mutating head RPC carries the
+        # epoch so a zombie write (this node declared dead and not yet
+        # re-attached) is rejected typed instead of landing.
+        self._epoch: Optional[int] = None
+        self._lease_id = ""
+        self._lease_ttl = 10.0
+        # Node-side availability delta: heartbeats resend availability
+        # only when it changed since the last acked beat.
+        self._hb_last_avail: Optional[Dict[str, float]] = None
         # In-flight inbound push-stream sessions (pipelined broadcast):
         # sid -> _PushStreamSession.
         self._push_streams: Dict[str, "_PushStreamSession"] = {}
@@ -445,12 +456,7 @@ class ClusterClient:
         self._labels = {**detect_topology_labels(), **(labels or {})}
         # Idempotent + retried: a chaos-dropped or head-restart-raced
         # registration must neither fail attachment nor double-apply.
-        self.head.call_idempotent("register_node", {
-            "node_id": self.node_id,
-            "address": self.address,
-            "resources": dict(runtime.node_resources.total),
-            "labels": self._labels, "name": node_name,
-        }, deadline_s=30.0)
+        self._register_with_head(deadline_s=30.0)
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name=f"cluster-hb-{self.node_id[:8]}")
@@ -471,28 +477,78 @@ class ClusterClient:
 
         self.shipper = EventShipper(self)
 
+    # ------------------------------------------------- lease / registration
+    def _register_with_head(self, deadline_s: float = 30.0) -> None:
+        """(Re-)register and absorb the minted lease.  Each call mints
+        a NEW epoch at the head — the previous one is fenced, which is
+        exactly the semantics re-attachment needs."""
+        resp = self.head.call_idempotent("register_node", {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources": dict(self.runtime.node_resources.total),
+            "labels": self._labels, "name": self.node_name,
+        }, deadline_s=deadline_s)
+        self._epoch = resp.get("epoch")
+        self._lease_id = resp.get("lease_id", "")
+        self._lease_ttl = float(resp.get("lease_ttl_s") or 10.0)
+        # Fresh lease: resync both delta streams from scratch.
+        self._hb_last_avail = None
+        with self._loc_lock:
+            self._view_seq = None
+
+    @property
+    def epoch(self) -> Optional[int]:
+        """This node's current lease epoch (rides mutating RPCs)."""
+        return self._epoch
+
+    def mut_call(self, method: str, payload: Dict[str, Any], *,
+                 deadline_s: float = 30.0,
+                 timeout: Optional[float] = None) -> Any:
+        """Mutating head RPC: idempotency key + lease epoch.  On
+        ``StaleEpochError`` — the head declared this node dead while
+        we were partitioned — re-register once (minting a fresh epoch)
+        and retry: this process holds live state, it is not a zombie;
+        the typed rejection is for writers that never come back."""
+        from ..exceptions import StaleEpochError
+
+        keyed = {**payload, "epoch": self._epoch,
+                 "epoch_node": self.node_id}
+        try:
+            return self.head.call_idempotent(
+                method, keyed, deadline_s=deadline_s, timeout=timeout)
+        except StaleEpochError:
+            self._register_with_head(deadline_s=deadline_s)
+            keyed["epoch"] = self._epoch
+            return self.head.call_idempotent(
+                method, keyed, deadline_s=deadline_s, timeout=timeout)
+
     # ---------------------------------------------------------- heartbeat
     def _heartbeat_loop(self):
         while not self._stopped.wait(_HEARTBEAT_S):
             try:
-                resp = self.head.call("heartbeat", {
-                    "node_id": self.node_id,
-                    "available": self.runtime.node_resources.available(),
-                    "view_version": self._view_version,
-                }, timeout=5.0)
-                self._absorb_view(resp)
+                p: Dict[str, Any] = {"node_id": self.node_id,
+                                     "epoch": self._epoch,
+                                     "view_seq": self._view_seq}
+                # Node-side delta: availability rides the beat only
+                # when it changed since the last acked report.
+                avail = self.runtime.node_resources.available()
+                if avail != self._hb_last_avail:
+                    p["available"] = avail
+                resp = self.head.call("heartbeat", p, timeout=5.0)
                 if resp.get("reregister"):
-                    # The head restarted and lost (or never had) this
-                    # node: re-attach (reference: raylets re-register
-                    # with a recovered GCS, gcs_init_data replay).
-                    self.head.call("register_node", {
-                        "node_id": self.node_id,
-                        "address": self.address,
-                        "resources": dict(
-                            self.runtime.node_resources.total),
-                        "labels": self._labels,
-                        "name": self.node_name,
-                    }, timeout=5.0)
+                    # The head restarted/lost this node or fenced our
+                    # lease: re-attach with a fresh epoch (reference:
+                    # raylets re-register with a recovered GCS,
+                    # gcs_init_data replay).
+                    self._register_with_head(deadline_s=15.0)
+                    continue
+                if "available" in p and resp.get("ok"):
+                    self._hb_last_avail = avail
+                if resp.get("need_available"):
+                    # Journal-replayed head entry: it has stale
+                    # availability — force a full report next beat.
+                    self._hb_last_avail = None
+                self._absorb_view(resp)
             except (ConnectionError, TimeoutError):
                 if self._stopped.is_set():
                     return
@@ -503,22 +559,23 @@ class ClusterClient:
                 traceback.print_exc()
 
     def _absorb_view(self, resp) -> None:
-        view = resp.get("view")
-        if view is None:
-            return
-        totals = resp.get("view_totals")
+        """Merge the head's view payload: ``view_full`` replaces,
+        ``view_delta``/``view_removed`` patch in place."""
+        if "view_seq" not in resp:
+            return  # one-off call (PG capacity): no view requested
+        full = resp.get("view_full")
+        delta = resp.get("view_delta")
+        removed = resp.get("view_removed")
         with self._loc_lock:
-            fresh = {}
-            for nid, rec in view.items():
-                old = self._view.get(nid, {})
-                fresh[nid] = {
-                    "available": rec["available"],
-                    "alive": rec["alive"],
-                    "total": (totals or {}).get(
-                        nid, old.get("total", {})),
-                }
-            self._view = fresh
-            self._view_version = resp.get("view_version")
+            if full is not None:
+                self._view = {nid: dict(rec)
+                              for nid, rec in full.items()}
+            else:
+                for nid, rec in (delta or {}).items():
+                    self._view[nid] = dict(rec)
+                for nid in removed or ():
+                    self._view.pop(nid, None)
+            self._view_seq = resp.get("view_seq")
             self._view_stamp = time.monotonic()
 
     def resource_view(self, max_age_s: float = 3.0):
@@ -1579,7 +1636,7 @@ class ClusterClient:
             raise RuntimeError(resp.get("error", "actor creation failed"))
         with self._loc_lock:
             self._actor_locations[actor_id] = (node_id, address)
-        self.head.call_idempotent("register_actor", {
+        self.mut_call("register_actor", {
             "actor_id": actor_id.binary(),
             "node_id": node_id, "address": address,
             "name": options.get("name", ""),
@@ -1786,9 +1843,9 @@ class ClusterClient:
                                "no_restart": no_restart}, timeout=30.0)
         except (ConnectionError, TimeoutError):
             pass
-        self.head.call_idempotent(
-            "remove_actor", {"actor_id": actor_id.binary()},
-            deadline_s=15.0)
+        self.mut_call("remove_actor",
+                      {"actor_id": actor_id.binary()},
+                      deadline_s=15.0)
         with self._loc_lock:
             self._actor_locations.pop(actor_id, None)
 
@@ -1806,7 +1863,7 @@ class ClusterClient:
     # ------------------------------------------------------------------ kv
     def kv_put(self, key: str, value, ns: str = "",
                overwrite: bool = True) -> bool:
-        return self.head.call("kv_put", {
+        return self.mut_call("kv_put", {
             "ns": ns, "key": key, "value": value,
             "overwrite": overwrite})["added"]
 
@@ -1815,7 +1872,8 @@ class ClusterClient:
         return resp["value"] if resp["found"] else None
 
     def kv_del(self, key: str, ns: str = "") -> bool:
-        return self.head.call("kv_del", {"ns": ns, "key": key})["deleted"]
+        return self.mut_call("kv_del",
+                             {"ns": ns, "key": key})["deleted"]
 
     def kv_keys(self, prefix: str = "", ns: str = ""):
         return self.head.call("kv_keys", {"ns": ns, "prefix": prefix})

@@ -10,35 +10,104 @@ Differences by design: scheduling here is *capacity-fit placement* — the
 head picks a node whose total resources fit the demand (preferring the
 most currently-available node from heartbeats) and the node's own local
 scheduler gates actual execution.  This mirrors the reference's
-two-level split (GCS/cluster policy picks, raylet local dispatch gates)
-without leases.
+two-level split (GCS/cluster policy picks, raylet local dispatch gates).
+
+Liveness is **lease-fenced** (the classic fencing-token pattern):
+registration mints a ``(lease_id, epoch)`` pair, heartbeats renew the
+lease, and a node declared dead has its epoch fenced — a later
+re-registration mints a strictly newer epoch, and any mutating RPC
+still carrying the old one is rejected typed (``StaleEpochError``)
+instead of silently overwriting live state.
+
+Durability is **journaled** (journal.py): each mutating handler appends
+redo records to a WAL and fsyncs ONCE before its reply ships; a
+background compactor folds the log into a snapshot.  Restart recovery =
+snapshot + journal-tail replay, idempotency cache included, so a
+retried client mutation straddling a head kill -9 still dedups.
+
+Resource sync is **delta-compressed**: nodes send availability only
+when it changed, the head replies with per-entry view deltas against
+the node's last acked ``view_seq`` (lease renewal piggybacks), and
+``heartbeat_batch`` folds many virtual nodes' beats into one RPC
+(tools/vcluster.py rides it).
+
+Hot tables (actors, named actors, KV, PGs) live behind the sharded
+store interface in tables.py — reads take one shard lock, not the
+global mutation lock, and the interface is the unit a replicated head
+would partition (ROADMAP item 5).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from .rpc import (ClientPool, IdempotencyCache, RpcServer,
-                  idempotent_handler)
+from . import journal as journal_mod
+from .rpc import (IDEMPOTENCY_KEY, ClientPool, IdempotencyCache,
+                  RpcServer, _rpc_metrics)
 from .serialization import loads
+from .tables import ShardedTable
 
-_DEAD_AFTER_S = 10.0  # heartbeats missed before a node is declared dead
+# Timing knobs, env-tunable (the vcluster harness compresses time by
+# shrinking these; see docs/fault_tolerance.md).  Module values are the
+# defaults — HeadServer re-reads the environment at construction so a
+# test can set a knob after import.
+_LEASE_TTL_S = 10.0     # lease duration == heartbeats missed before a
+# node is declared dead (was _DEAD_AFTER_S)
+_DEAD_AFTER_S = _LEASE_TTL_S  # legacy alias
 _RESTART_TIMEOUT_S = 300.0
+_RESTART_RETRY_S = 1.0  # restart-loop backoff between failed attempts
+_COMPACT_EVERY_S = 30.0
+_COMPACT_BYTES = 4 << 20
 
 
 _RESERVATION_TTL_S = 2.5  # ≥ 2 heartbeats: by then the placed task is
 # either reflected in the node's reported availability or it never ran
 
 
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _lease_metrics():
+    """Lease/fencing counters (rebuilt after registry resets)."""
+    from ..observability import metrics as _metrics
+
+    return _metrics.metric_group("head_lease", lambda: {
+        "grants": _metrics.Counter(
+            "ray_tpu_lease_grants_total",
+            "leases minted at node (re)registration"),
+        "renewals": _metrics.Counter(
+            "ray_tpu_lease_renewals_total",
+            "lease renewals piggybacked on heartbeats"),
+        "expirations": _metrics.Counter(
+            "ray_tpu_lease_expirations_total",
+            "leases expired by the reaper (node declared dead)"),
+        "stale_rejections": _metrics.Counter(
+            "ray_tpu_lease_stale_epoch_rejections_total",
+            "mutating RPCs rejected with StaleEpochError",
+            tag_keys=("method",)),
+        "stale_heartbeats": _metrics.Counter(
+            "ray_tpu_lease_stale_heartbeats_total",
+            "heartbeats from fenced epochs answered with reregister"),
+    })
+
+
 class NodeEntry:
     __slots__ = ("node_id", "address", "total", "available",
-                 "last_heartbeat", "alive", "labels", "reserved", "name")
+                 "last_heartbeat", "alive", "labels", "reserved", "name",
+                 "lease_id", "epoch", "lease_expires", "view_seq",
+                 "await_avail")
 
     def __init__(self, node_id: str, address: str,
                  total: Dict[str, float], labels: Dict[str, str],
-                 name: str = ""):
+                 name: str = "", lease_id: str = "", epoch: int = 0):
         self.node_id = node_id
         self.address = address
         self.name = name
@@ -47,6 +116,17 @@ class NodeEntry:
         self.last_heartbeat = time.monotonic()
         self.alive = True
         self.labels = labels
+        # Lease-fenced liveness: minted at registration, renewed by
+        # heartbeats; a write carrying an epoch != this one is fenced.
+        self.lease_id = lease_id
+        self.epoch = epoch
+        self.lease_expires = 0.0
+        # Monotonic stamp of the last change to this entry's resource
+        # view (availability/totals/liveness) — the delta-sync cursor.
+        self.view_seq = 0
+        # Set on journal replay: the head has registration-time totals
+        # but no live availability; ask the node for a full report.
+        self.await_avail = False
         # Placement debits not yet visible in a heartbeat:
         # [(expiry, demand)].  Heartbeats report ground truth but lag;
         # without this, two rapid placements both see the same
@@ -71,28 +151,63 @@ class HeadServer:
     """``storage_path`` enables GCS fault tolerance (reference:
     Redis-backed table storage, store_client/redis_store_client.h:106 +
     gcs_init_data.h replay): durable tables (KV, actor registry, named
-    actors, PGs) snapshot to disk on mutation and replay on restart at
-    the same address; nodes reattach through the heartbeat
-    ``reregister`` handshake."""
+    actors, PGs, node leases) journal to a WAL on mutation (snapshot +
+    journal-tail replay on restart at the same address — see
+    journal.py); nodes reattach through the heartbeat ``reregister``
+    handshake.  ``persist_mode`` "journal" (default) appends one
+    fsync'd redo record per mutation; "snapshot" keeps the seed's
+    full-snapshot-per-mutation behavior (the bench's baseline)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 storage_path: Optional[str] = None):
-        self._lock = threading.Lock()
+                 storage_path: Optional[str] = None,
+                 lease_ttl_s: Optional[float] = None,
+                 persist_mode: Optional[str] = None):
+        # RLock: the _mut wrapper holds it across {epoch fence +
+        # handler} so a node cannot be declared dead (epoch fenced)
+        # between the check and the table write — the handlers
+        # re-acquire reentrantly.
+        self._lock = threading.RLock()
+        self._lease_ttl = (lease_ttl_s if lease_ttl_s is not None
+                           else _env_f("RAY_TPU_LEASE_TTL_S",
+                                       _LEASE_TTL_S))
+        self._restart_timeout = _env_f(
+            "RAY_TPU_HEAD_RESTART_TIMEOUT_S", _RESTART_TIMEOUT_S)
+        self._restart_retry = _env_f(
+            "RAY_TPU_HEAD_RESTART_RETRY_S", _RESTART_RETRY_S)
         self._nodes: Dict[str, NodeEntry] = {}
-        # actor_id(bytes) -> {node_id, address, name, namespace, klass}
-        self._actors: Dict[bytes, Dict[str, Any]] = {}
-        self._named: Dict[Tuple[str, str], bytes] = {}
-        self._kv: Dict[Tuple[str, str], Any] = {}
-        # pg_id -> {bundles: [...], nodes: [node_id per bundle]}
-        self._pgs: Dict[str, Dict[str, Any]] = {}
+        # Durable tables behind the sharded-store interface
+        # (tables.py): actor_id(bytes) -> info, (ns, name) -> actor_id,
+        # (ns, key) -> value, pg_id -> {bundles, nodes}.  Reads take a
+        # shard lock only; mutations additionally serialize on
+        # self._lock (journal order == apply order).  Consistency
+        # model, chosen deliberately: reads are READ-COMMITTED against
+        # memory, not against the fsync — a lookup racing a mutation
+        # may observe a value whose journal record has not hit disk
+        # yet, and a crash in that window erases it.  The writer's own
+        # ACK is the durability boundary (it ships only after the
+        # fsync); cross-client read-then-crash anomalies are accepted,
+        # as in the reference GCS's async-replicated Redis backing.
+        self._actors = ShardedTable()
+        self._named = ShardedTable()
+        self._kv = ShardedTable()
+        self._pgs = ShardedTable()
         self._spread_rr = 0
-        # Bumped on node register/death: heartbeat replies resend the
-        # totals half of the resource view when a node is stale.
+        # Delta-compressed resource sync: every entry change stamps a
+        # monotonic view_seq; heartbeat replies carry only entries
+        # newer than the caller's acked seq, plus death tombstones.
+        # Membership-only changes keep the legacy counter for
+        # book-keeping ("how many times did the set change").
+        self._view_seq = 0
+        self._view_floor = 0           # oldest seq tombstones cover
+        self._view_gone: List[Tuple[int, str]] = []  # (seq, node_id)
         self._membership_version = 0
+        # Lease epochs are minted from a counter that must survive
+        # restarts (a zombie fenced before the crash must stay fenced
+        # after replay), so it persists with the node table.
+        self._epoch_counter = 0
         # (monotonic_ts, demand) of recent infeasible placements — the
         # autoscaler's scale-up signal.
         self._unmet_demands: List[Tuple[float, Dict[str, float]]] = []
-        self._storage_path = storage_path
         # Observability plane: per-node task-event stores + latest
         # metric snapshots shipped by the workers' EventShippers
         # (reference: GCS task-event aggregation, gcs_task_manager).
@@ -121,22 +236,95 @@ class HeadServer:
         self._events_lock = threading.Lock()
         self._deque = _collections.deque
         # After a restart, actors replay before their nodes reattach:
-        # give nodes a grace window before declaring them dead.
+        # give nodes one lease of grace before declaring them dead.
         self._replay_grace_until = 0.0
-        if storage_path:
-            self._load_snapshot()
         # Mutating handlers dedup on client-minted idempotency keys:
         # a retried register/remove whose first RESPONSE was lost (rpc
         # chaos, head hiccup) replays the original reply instead of
-        # re-applying (e.g. a spurious "name already taken").
+        # re-applying (e.g. a spurious "name already taken").  The
+        # cache persists through the journal, so the dedup window
+        # spans a head restart.
         self._idem = IdempotencyCache()
+        self._storage_path = storage_path
+        self._persist_mode = (persist_mode or os.environ.get(
+            "RAY_TPU_HEAD_PERSIST_MODE", "journal"))
+        self._legacy_dirty = False
+        self._log: Optional[journal_mod.JournalWriter] = None
+        if storage_path:
+            self._recover()
+            if self._persist_mode == "journal":
+                self._log = journal_mod.JournalWriter(
+                    storage_path, start_seqno=self._recovered_seqno)
+            else:
+                # journal → snapshot mode switch: fold the replayed
+                # tail into a fresh snapshot, then drop the segments —
+                # left behind, a later recovery would replay stale
+                # records on top of newer snapshots.
+                segs = journal_mod.list_segments(storage_path)
+                if segs:
+                    with self._lock:
+                        state = self._state_locked()
+                    journal_mod.write_snapshot(
+                        storage_path, state, self._recovered_seqno)
+                    for _idx, seg_path in segs:
+                        try:
+                            os.unlink(seg_path)
+                        except OSError:
+                            pass
 
         def _mut(fn):
-            return idempotent_handler(fn, self._idem)
+            """Durable-mutation wrapper: idempotency dedup → epoch
+            fence → handler → journal commit barrier (the reply must
+            not ship before its redo records are fsync'd)."""
+
+            def wrapped(payload):
+                key = (payload.pop(IDEMPOTENCY_KEY, None)
+                       if isinstance(payload, dict) else None)
+                if key is None:
+                    # Fence + apply under ONE critical section (RLock;
+                    # the handler re-acquires reentrantly): the reaper
+                    # cannot fence this epoch between the check and
+                    # the write.  The fsync barrier stays outside the
+                    # lock — durability ordering is fixed at append
+                    # time, and an fsync under the table lock would
+                    # stall every heartbeat behind the disk.
+                    with self._lock:
+                        self._fence(payload, fn.__name__)
+                        reply = fn(payload)
+                    self._commit_persist()
+                    return reply
+                while True:
+                    hit, reply = self._idem.get(key)
+                    if hit:
+                        _rpc_metrics()["idem_hits"].inc(
+                            tags={"method": fn.__name__})
+                        return reply
+                    ev, mine = self._idem.claim(key)
+                    if not mine:
+                        # First delivery still executing: wait it out,
+                        # then re-read (a RAISE cached nothing and the
+                        # retry claims the key itself).
+                        ev.wait(timeout=60.0)
+                        continue
+                    try:
+                        with self._lock:
+                            self._fence(payload, fn.__name__)
+                            reply = fn(payload)
+                            self._journal({"op": "idem", "key": key,
+                                           "reply": reply})
+                        self._idem.put(key, reply)
+                        self._commit_persist()
+                        return reply
+                    finally:
+                        self._idem.release(key)
+
+            wrapped.__name__ = getattr(fn, "__name__", "mut")
+            return wrapped
 
         self._server = RpcServer({
             "register_node": _mut(self._register_node),
             "heartbeat": self._heartbeat,
+            "heartbeat_batch": self._heartbeat_batch,
             "drain_node": _mut(self._drain_node),
             "list_nodes": self._list_nodes,
             "place": self._place,
@@ -151,7 +339,10 @@ class HeadServer:
             "list_actors": self._list_actors_rpc,
             "create_pg": _mut(self._create_pg),
             "remove_pg": _mut(self._remove_pg),
-            "report_node_failure": self._report_node_failure,
+            # _mut although liveness-shaped: it retires actor entries
+            # (durable-table writes that must journal + commit before
+            # the reply) and duplicate peer reports dedup for free.
+            "report_node_failure": _mut(self._report_node_failure),
             "pubsub_poll": self._pubsub_poll,
             "pending_demand": self._pending_demand,
             "push_events": self._push_events,
@@ -178,6 +369,11 @@ class HeadServer:
         self._restarter.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        self._compactor: Optional[threading.Thread] = None
+        if self._log is not None:
+            self._compactor = threading.Thread(
+                target=self._compact_loop, daemon=True)
+            self._compactor.start()
         resume = getattr(self, "_resume_restarting", None)
         if resume:
             with self._restart_cond:
@@ -185,121 +381,409 @@ class HeadServer:
                 self._restart_cond.notify_all()
 
     # ---------------------------------------------------- persistence
-    def _mark_dirty(self):
-        """Persist SYNCHRONOUSLY before the mutation's RPC reply: an
-        acknowledged write must survive a crash (the reference Redis
-        store is synchronous on mutation).  Caller holds the lock."""
-        if not self._storage_path:
-            return
-        import pickle
+    def _journal(self, record: Dict[str, Any]) -> None:
+        """Append one redo record at the MUTATION POINT (caller holds
+        self._lock, so journal order == apply order).  Cheap — the
+        durability barrier is the wrapper's ``_commit_persist``."""
+        if self._log is not None:
+            self._log.append(record)
+        elif self._storage_path:
+            self._legacy_dirty = True  # snapshot mode: rewrite on commit
 
-        blob = pickle.dumps({
-            "kv": dict(self._kv),
-            "named": dict(self._named),
+    def _commit_persist(self) -> None:
+        """Durability barrier before a mutation's reply ships: fsync
+        the journal tail (one fsync amortizes every record the RPC
+        produced) — or, in legacy snapshot mode, rewrite the whole
+        snapshot (the seed behavior the bench compares against)."""
+        if self._log is not None:
+            self._log.commit()
+        elif self._storage_path and self._legacy_dirty:
+            with self._lock:
+                state = self._state_locked()
+                self._legacy_dirty = False
+            try:
+                # Stamp the recovery seqno so a later journal-mode
+                # boot never replays pre-switch records on top.
+                journal_mod.write_snapshot(self._storage_path, state,
+                                           self._recovered_seqno)
+            except OSError:
+                pass
+
+    def _fence(self, payload, method: str) -> None:
+        """Reject a mutation carrying a superseded lease epoch.  Only
+        payloads that CARRY an epoch are fenced (raw/legacy callers and
+        head-internal paths don't).  The caller's identity is
+        ``epoch_node`` (falling back to ``node_id`` for node-scoped
+        ops like drain)."""
+        from ..exceptions import StaleEpochError
+
+        if not isinstance(payload, dict):
+            return
+        sent = payload.get("epoch")
+        if sent is None:
+            return
+        nid = payload.get("epoch_node") or payload.get("node_id") or ""
+        with self._lock:
+            entry = self._nodes.get(nid)
+            current = entry.epoch if entry is not None else None
+            ok = (entry is not None and entry.alive
+                  and entry.epoch == sent)
+        if not ok:
+            _lease_metrics()["stale_rejections"].inc(
+                tags={"method": method})
+            raise StaleEpochError(
+                "mutation fenced: lease epoch superseded (node was "
+                "declared dead or never registered; re-register to "
+                "obtain a fresh epoch)",
+                node_id=nid, sent_epoch=sent, current_epoch=current,
+                context={"method": method})
+
+    def _state_locked(self) -> Dict[str, Any]:
+        """Serializable durable state (caller holds self._lock)."""
+        return {
+            "kv": self._kv.snapshot(),
+            "named": self._named.snapshot(),
             "actors": {aid: dict(info)
                        for aid, info in self._actors.items()},
-            "pgs": dict(self._pgs),
-        })
-        tmp = self._storage_path + ".tmp"
-        try:
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            import os
+            "pgs": self._pgs.snapshot(),
+            "nodes": {e.node_id: {
+                "address": e.address, "total": dict(e.total),
+                "labels": dict(e.labels), "name": e.name,
+                "lease_id": e.lease_id, "epoch": e.epoch,
+                "alive": e.alive,
+            } for e in self._nodes.values()},
+            "epoch_counter": self._epoch_counter,
+            "idem": self._idem.export(),
+        }
 
-            os.replace(tmp, self._storage_path)
-        except OSError:
-            pass
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        self._kv.replace_all(state.get("kv") or {})
+        self._named.replace_all(state.get("named") or {})
+        self._actors.replace_all(state.get("actors") or {})
+        self._pgs.replace_all(state.get("pgs") or {})
+        self._epoch_counter = int(state.get("epoch_counter") or 0)
+        self._idem.load(state.get("idem") or {})
+        now = time.monotonic()
+        for nid, rec in (state.get("nodes") or {}).items():
+            entry = NodeEntry(nid, rec["address"], rec["total"],
+                              dict(rec.get("labels") or {}),
+                              rec.get("name", ""),
+                              lease_id=rec.get("lease_id", ""),
+                              epoch=int(rec.get("epoch") or 0))
+            entry.alive = bool(rec.get("alive", True))
+            entry.last_heartbeat = now
+            entry.lease_expires = now + self._lease_ttl
+            entry.await_avail = True
+            self._nodes[nid] = entry
+            self._epoch_counter = max(self._epoch_counter, entry.epoch)
 
-    def _load_snapshot(self):
-        import os
-        import pickle
+    def _apply_record(self, rec: Dict[str, Any]) -> None:
+        """Redo one journal record against the tables (recovery path —
+        no publishes, no re-journaling).  Records are state DELTAS, so
+        replay is deterministic regardless of what the cluster looked
+        like when the original RPC ran."""
+        op = rec.get("op")
+        if op == "kv_put":
+            self._kv.put((rec["ns"], rec["key"]), rec["value"])
+        elif op == "kv_del":
+            self._kv.pop((rec["ns"], rec["key"]))
+        elif op == "actor_put":
+            info = dict(rec["info"])
+            self._actors.put(rec["actor_id"], info)
+            if info.get("name"):
+                self._named.put(
+                    (info.get("namespace", ""), info["name"]),
+                    rec["actor_id"])
+        elif op == "actor_del":
+            info = self._actors.pop(rec["actor_id"])
+            if info and info.get("name"):
+                self._named.pop(
+                    (info.get("namespace", ""), info["name"]))
+        elif op == "pg_put":
+            self._pgs.put(rec["pg_id"], {"bundles": rec["bundles"],
+                                         "nodes": rec["nodes"]})
+        elif op == "pg_del":
+            self._pgs.pop(rec["pg_id"])
+        elif op == "node_put":
+            entry = NodeEntry(rec["node_id"], rec["address"],
+                              rec["resources"],
+                              dict(rec.get("labels") or {}),
+                              rec.get("name", ""),
+                              lease_id=rec.get("lease_id", ""),
+                              epoch=int(rec.get("epoch") or 0))
+            entry.await_avail = True
+            self._nodes[rec["node_id"]] = entry
+            self._epoch_counter = max(self._epoch_counter, entry.epoch)
+        elif op == "node_res":
+            entry = self._nodes.get(rec["node_id"])
+            if entry is not None:
+                for k, v in (rec.get("add") or {}).items():
+                    entry.total[k] = entry.total.get(k, 0) + v
+                    entry.available[k] = entry.available.get(k, 0) + v
+                for k in rec.get("remove") or ():
+                    entry.total.pop(k, None)
+                    entry.available.pop(k, None)
+        elif op == "node_dead":
+            entry = self._nodes.get(rec["node_id"])
+            if entry is not None:
+                entry.alive = False  # epoch stays fenced
+        elif op == "node_del":
+            self._nodes.pop(rec["node_id"], None)
+        elif op == "idem":
+            self._idem.put(rec["key"], rec["reply"])
 
-        if not os.path.exists(self._storage_path):
-            return
-        try:
-            with open(self._storage_path, "rb") as f:
-                blob = pickle.load(f)
-        except Exception:
-            return
-        self._kv = dict(blob.get("kv", {}))
-        self._named = dict(blob.get("named", {}))
-        self._actors = dict(blob.get("actors", {}))
-        self._pgs = dict(blob.get("pgs", {}))
+    def _recover(self) -> None:
+        """Snapshot + journal-tail replay (gcs_init_data.h analogue).
+        A torn last record is discarded by the segment reader — it was
+        never acked.  Replayed nodes get one lease of grace to reattach
+        before the reaper treats them as dead."""
+        state, snap_seq = journal_mod.load_snapshot(self._storage_path)
+        if state:
+            self._load_state(state)
+        last_seq, replayed = snap_seq, 0
+        for _idx, path in journal_mod.list_segments(self._storage_path):
+            for rec in journal_mod.read_segment(path):
+                seq = int(rec.get("seq") or 0)
+                if seq <= snap_seq:
+                    continue  # the snapshot already folded this in
+                self._apply_record(rec)
+                last_seq = max(last_seq, seq)
+                replayed += 1
+        if replayed:
+            journal_mod._journal_metrics()["replayed"].inc(replayed)
+        self._recovered_seqno = last_seq
         self._resume_restarting = []
+        had_any = bool(state) or replayed
         for aid, info in self._actors.items():
             info.pop("restart_deadline", None)
             if info.get("state") == "RESTARTING":
                 # Mid-restart at crash time: re-enqueue once the
                 # restart loop exists (gcs_init_data replay semantics).
                 self._resume_restarting.append(aid)
-        self._replay_grace_until = time.monotonic() + 15.0
+        if had_any:
+            # Lease-derived grace (was a hardcoded 15 s): nodes get
+            # exactly one lease TTL to reattach after a head restart.
+            self._replay_grace_until = (time.monotonic()
+                                        + self._lease_ttl)
+
+    # ---------------------------------------------------- compaction
+    def _compact_loop(self):
+        every = _env_f("RAY_TPU_HEAD_COMPACT_EVERY_S", _COMPACT_EVERY_S)
+        max_bytes = int(_env_f("RAY_TPU_HEAD_COMPACT_BYTES",
+                               _COMPACT_BYTES))
+        last = time.monotonic()
+        while not self._stop.wait(min(1.0, every / 4)):
+            due = (time.monotonic() - last >= every
+                   or self._log.bytes_since_rotate >= max_bytes)
+            if not due:
+                continue
+            try:
+                self.compact()
+            except OSError:
+                pass  # disk hiccup: the journal still has everything
+            last = time.monotonic()
+
+    def compact(self) -> int:
+        """Fold the journal into a snapshot; returns the snapshot's
+        seqno.  Safe against concurrent mutations: state + seqno are
+        captured and the journal rotated under the table lock, so
+        every record racing the snapshot lands in the NEW segment with
+        a seqno the snapshot doesn't cover, and replay applies it on
+        top."""
+        if self._log is None:
+            raise RuntimeError("compaction requires journal mode")
+        with self._lock:
+            state = self._state_locked()
+            seqno = self._log.seqno
+            new_segment = self._log.rotate()
+        journal_mod.write_snapshot(self._storage_path, state, seqno)
+        self._log.drop_segments_before(new_segment)
+        journal_mod._journal_metrics()["compactions"].inc()
+        return seqno
 
     # ------------------------------------------------------------- nodes
+    def _next_view_seq(self) -> int:
+        self._view_seq += 1
+        return self._view_seq
+
     def _register_node(self, p):
-        entry = NodeEntry(p["node_id"], p["address"], p["resources"],
-                          p.get("labels", {}), p.get("name", ""))
+        """Mint a lease: (lease_id, epoch).  A RE-registration (same
+        node_id — zombie reattach, post-restart handshake) supersedes
+        the previous lease: the new epoch is strictly newer and every
+        write still carrying the old one is fenced."""
         with self._lock:
+            self._epoch_counter += 1
+            epoch = self._epoch_counter
+            lease_id = uuid.uuid4().hex
+            entry = NodeEntry(p["node_id"], p["address"],
+                              p["resources"], p.get("labels", {}),
+                              p.get("name", ""),
+                              lease_id=lease_id, epoch=epoch)
+            entry.lease_expires = time.monotonic() + self._lease_ttl
+            entry.view_seq = self._next_view_seq()
             self._nodes[p["node_id"]] = entry
             self._membership_version += 1
-        return {"ok": True, "num_nodes": len(self._nodes)}
+            self._journal({"op": "node_put", "node_id": p["node_id"],
+                           "address": p["address"],
+                           "resources": dict(p["resources"]),
+                           "labels": dict(p.get("labels") or {}),
+                           "name": p.get("name", ""),
+                           "lease_id": lease_id, "epoch": epoch})
+        _lease_metrics()["grants"].inc()
+        return {"ok": True, "num_nodes": len(self._nodes),
+                "lease_id": lease_id, "epoch": epoch,
+                "lease_ttl_s": self._lease_ttl}
+
+    def _heartbeat_one(self, p) -> Dict[str, Any]:
+        """One node's beat: lease renewal + availability delta absorb.
+        Caller holds self._lock.  Replies {"ok": False, "reregister":
+        True} for unknown nodes, fenced epochs, and revoked leases —
+        the client re-registers and mints a fresh epoch."""
+        entry = self._nodes.get(p["node_id"])
+        if entry is None:
+            return {"ok": False, "reregister": True}
+        sent_epoch = p.get("epoch")
+        if sent_epoch is not None and sent_epoch != entry.epoch:
+            _lease_metrics()["stale_heartbeats"].inc()
+            return {"ok": False, "reregister": True}
+        if not entry.alive:
+            # Declared dead = lease revoked.  No resurrect-in-place
+            # (the seed behavior): the node must re-register so its
+            # old epoch stays fenced — zombie writes in flight get
+            # StaleEpochError instead of landing.
+            if sent_epoch is not None:
+                _lease_metrics()["stale_heartbeats"].inc()
+            return {"ok": False, "reregister": True}
+        now = time.monotonic()
+        entry.last_heartbeat = now
+        entry.lease_expires = now + self._lease_ttl
+        _lease_metrics()["renewals"].inc()
+        if "available" in p:
+            if p["available"] != entry.available:
+                entry.available = dict(p["available"])
+                entry.view_seq = self._next_view_seq()
+            entry.await_avail = False
+        if "add_resources" in p:
+            for k, v in p["add_resources"].items():
+                entry.total[k] = entry.total.get(k, 0) + v
+                entry.available[k] = entry.available.get(k, 0) + v
+            # Totals changed: stale cached views must refetch them.
+            self._membership_version += 1
+            entry.view_seq = self._next_view_seq()
+            # Dynamic totals (PG synthetic capacity) are DURABLE
+            # state riding the heartbeat path: journal them, or a
+            # head restart replays registration-time totals and every
+            # bundle-resource placement goes infeasible forever.
+            self._journal({"op": "node_res", "node_id": p["node_id"],
+                           "add": dict(p["add_resources"])})
+        if "remove_resources" in p:
+            for k in p["remove_resources"]:
+                entry.total.pop(k, None)
+                entry.available.pop(k, None)
+            self._membership_version += 1
+            entry.view_seq = self._next_view_seq()
+            self._journal({"op": "node_res", "node_id": p["node_id"],
+                           "remove": list(p["remove_resources"])})
+        reply = {"ok": True, "epoch": entry.epoch,
+                 "lease_ttl_s": self._lease_ttl}
+        if entry.await_avail:
+            # Journal-replayed entry: the head has registration-time
+            # totals but no live availability — ask for a full report.
+            reply["need_available"] = True
+        return reply
+
+    def _view_payload_locked(self, client_seq) -> Dict[str, Any]:
+        """Resource-view sync, hub-routed and DELTA-COMPRESSED
+        (reference: ray_syncer.h:83 — per-node views fan out through
+        the GCS hub).  ``client_seq`` None (or older than the tombstone
+        ring covers) gets the full view; otherwise only entries whose
+        view_seq advanced past it, plus death tombstones.  Dead nodes
+        are excluded from views — they'd grow the payload forever
+        under churn."""
+        out: Dict[str, Any] = {"view_seq": self._view_seq}
+
+        def rec(e: NodeEntry) -> Dict[str, Any]:
+            return {"available": dict(e.available),
+                    "total": dict(e.total), "alive": True}
+
+        if client_seq is None or client_seq < self._view_floor:
+            out["view_full"] = {e.node_id: rec(e)
+                                for e in self._nodes.values() if e.alive}
+            return out
+        delta = {e.node_id: rec(e) for e in self._nodes.values()
+                 if e.alive and e.view_seq > client_seq}
+        if delta:
+            out["view_delta"] = delta
+        removed = [nid for seq, nid in self._view_gone
+                   if seq > client_seq
+                   and not (nid in self._nodes
+                            and self._nodes[nid].alive)]
+        if removed:
+            out["view_removed"] = removed
+        return out
+
+    def _tombstone_locked(self, node_id: str) -> None:
+        """Record a death for delta sync; clients behind the ring's
+        floor fall back to a full view."""
+        seq = self._next_view_seq()
+        self._view_gone.append((seq, node_id))
+        while len(self._view_gone) > 1024:
+            floor_seq, _nid = self._view_gone.pop(0)
+            self._view_floor = floor_seq
 
     def _heartbeat(self, p):
         with self._lock:
-            entry = self._nodes.get(p["node_id"])
-            if entry is None:
-                return {"ok": False, "reregister": True}
-            entry.last_heartbeat = time.monotonic()
-            entry.alive = True
-            if "available" in p:
-                entry.available = dict(p["available"])
-            if "add_resources" in p:
-                for k, v in p["add_resources"].items():
-                    entry.total[k] = entry.total.get(k, 0) + v
-                    entry.available[k] = entry.available.get(k, 0) + v
-                # Totals changed: stale cached views must refetch them.
-                self._membership_version += 1
-            if "remove_resources" in p:
-                for k in p["remove_resources"]:
-                    entry.total.pop(k, None)
-                    entry.available.pop(k, None)
-                self._membership_version += 1
-            # Resource-view sync, hub-routed (reference: ray_syncer —
-            # per-node resource views fan out through the GCS hub,
-            # ray_syncer.h:83).  Availability piggybacks on every
-            # periodic reply (the one-off PG-capacity calls carry no
-            # view_version and skip the assembly); totals only when
-            # membership/totals changed since the node's cached
-            # version.  Dead nodes are excluded — they'd otherwise
-            # grow the payload forever under churn.
-            reply = {"ok": True}
-            if "view_version" in p:
-                reply["view"] = {
-                    e.node_id: {"available": dict(e.available),
-                                "alive": True}
-                    for e in self._nodes.values() if e.alive}
-                reply["view_version"] = self._membership_version
-                if p.get("view_version") != self._membership_version:
-                    reply["view_totals"] = {
-                        e.node_id: dict(e.total)
-                        for e in self._nodes.values() if e.alive}
+            reply = self._heartbeat_one(p)
+            # The one-off PG-capacity calls carry no view_seq field
+            # and skip the view assembly entirely (seed behavior).
+            if reply.get("ok") and "view_seq" in p:
+                reply.update(self._view_payload_locked(p.get("view_seq")))
+        # No-op unless the beat journaled a resource delta.
+        self._commit_persist()
         return reply
+
+    def _heartbeat_batch(self, p):
+        """Many nodes' beats in ONE RPC (the vcluster harness
+        multiplexes hundreds of virtual nodes per process): per-node
+        replies plus a single shared view payload — at 300 nodes this
+        collapses 300 round-trips and 300 view assemblies per interval
+        into one of each."""
+        replies = []
+        with self._lock:
+            for beat in p.get("beats") or ():
+                replies.append(self._heartbeat_one(beat))
+            out: Dict[str, Any] = {"ok": True, "replies": replies}
+            if "view_seq" in p:
+                out.update(self._view_payload_locked(p.get("view_seq")))
+        self._commit_persist()
+        return out
 
     def _drain_node(self, p):
         with self._lock:
             entry = self._nodes.pop(p["node_id"], None)
+            if entry is not None:
+                self._journal({"op": "node_del",
+                               "node_id": p["node_id"]})
+                self._tombstone_locked(p["node_id"])
             self._forget_actors_on(p["node_id"])
         if entry is not None:
             self._publish_node_death(p["node_id"], entry.address)
         return {"ok": entry is not None}
 
     def _report_node_failure(self, p):
-        """A peer observed a broken connection to this node."""
+        """A peer observed a broken connection to this node.  Marking
+        it dead revokes its lease (fences its epoch): the node can only
+        come back through re-registration, and writes carrying the old
+        epoch are rejected typed."""
         with self._lock:
             entry = self._nodes.get(p["node_id"])
             was_alive = entry is not None and entry.alive
-            if entry is not None:
+            if was_alive:
                 entry.alive = False
                 self._membership_version += 1
+                self._journal({"op": "node_dead",
+                               "node_id": p["node_id"]})
+                self._tombstone_locked(p["node_id"])
             dead_actors = self._forget_actors_on(p["node_id"])
         if was_alive:
             self._publish_node_death(p["node_id"], entry.address)
@@ -459,21 +943,25 @@ class HeadServer:
                 info.get("state", "ALIVE") == "ALIVE"]
         gone = []
         for aid in dead:
-            info = self._actors[aid]
+            info = self._actors.get(aid)
             mr = info.get("max_restarts", 0)
             if (info.get("spec") is not None
                     and (mr < 0  # max_restarts=-1: infinite budget
                          or info.get("restarts_used", 0) < mr)):
                 info["state"] = "RESTARTING"
+                self._journal({"op": "actor_put", "actor_id": aid,
+                               "info": {k: v for k, v in info.items()
+                                        if k != "restart_deadline"}})
                 self._restart_pending.append(aid)
                 self._restart_cond.notify_all()
                 self._publisher.publish("actor_state", {
                     "actor_id": aid, "state": "RESTARTING"})
             else:
                 self._actors.pop(aid)
+                self._journal({"op": "actor_del", "actor_id": aid})
                 if info.get("name"):
                     self._named.pop(
-                        (info.get("namespace", ""), info["name"]), None)
+                        (info.get("namespace", ""), info["name"]))
                 gone.append(aid)
         return gone
 
@@ -495,7 +983,7 @@ class HeadServer:
                     continue
                 if "restart_deadline" not in info:
                     info["restart_deadline"] = (
-                        time.monotonic() + _RESTART_TIMEOUT_S)
+                        time.monotonic() + self._restart_timeout)
                 spec = info["spec"]
                 demand = dict(info.get("resources") or {})
                 dead_node = info["node_id"]
@@ -530,7 +1018,8 @@ class HeadServer:
                         info.get("restarts_used", 0) + 1
                     info["state"] = "ALIVE"
                     info.pop("restart_deadline", None)
-                    self._mark_dirty()
+                    self._journal({"op": "actor_put", "actor_id": aid,
+                                   "info": dict(info)})
                     self._publisher.publish("actor_state", {
                         "actor_id": aid, "state": "ALIVE",
                         "node_id": placed["node_id"],
@@ -541,11 +1030,12 @@ class HeadServer:
                     # budget remains, it doesn't drop on first miss.
                     self._restart_pending.append(aid)
                 else:
-                    self._actors.pop(aid, None)
+                    self._actors.pop(aid)
+                    self._journal({"op": "actor_del", "actor_id": aid})
                     if info.get("name"):
                         self._named.pop(
-                            (info.get("namespace", ""), info["name"]),
-                            None)
+                            (info.get("namespace", ""), info["name"]))
+            self._commit_persist()
             if kill_leaked:
                 try:
                     self._pool.get(placed["address"]).call(
@@ -558,7 +1048,7 @@ class HeadServer:
             if info is None:
                 continue
             if not ok:
-                self._stop.wait(1.0)
+                self._stop.wait(self._restart_retry)
 
     def _list_nodes(self, _p):
         with self._lock:
@@ -570,18 +1060,28 @@ class HeadServer:
             } for e in self._nodes.values()]
 
     def _reap_loop(self):
-        while not self._stop.wait(_DEAD_AFTER_S / 4):
-            cutoff = time.monotonic() - _DEAD_AFTER_S
+        """Lease expiry: a node whose lease ran out (no heartbeat
+        renewal for one TTL) is declared dead and its epoch FENCED —
+        it can only come back through re-registration, which mints a
+        strictly newer epoch."""
+        while not self._stop.wait(self._lease_ttl / 4):
+            now = time.monotonic()
             with self._lock:
+                in_grace = (self._replay_grace_until
+                            and now <= self._replay_grace_until)
                 dead = []
-                for e in self._nodes.values():
-                    if e.alive and e.last_heartbeat < cutoff:
-                        e.alive = False
-                        self._membership_version += 1
-                        self._forget_actors_on(e.node_id)
-                        dead.append((e.node_id, e.address))
+                if not in_grace:
+                    for e in self._nodes.values():
+                        if e.alive and e.lease_expires < now:
+                            e.alive = False
+                            self._membership_version += 1
+                            self._journal({"op": "node_dead",
+                                           "node_id": e.node_id})
+                            self._tombstone_locked(e.node_id)
+                            self._forget_actors_on(e.node_id)
+                            dead.append((e.node_id, e.address))
                 if (self._replay_grace_until
-                        and time.monotonic() > self._replay_grace_until):
+                        and now > self._replay_grace_until):
                     # Post-restart sweep: replayed actors whose node
                     # never reattached get the node-death treatment
                     # (restart on a survivor or drop).
@@ -594,6 +1094,9 @@ class HeadServer:
                         and info.get("state", "ALIVE") == "ALIVE"}
                     for nid in orphan_nodes:
                         self._forget_actors_on(nid)
+            if dead:
+                _lease_metrics()["expirations"].inc(len(dead))
+            self._commit_persist()
             for nid, addr in dead:
                 self._publish_node_death(nid, addr)
 
@@ -697,38 +1200,42 @@ class HeadServer:
     def _kv_put(self, p):
         key = (p.get("ns", ""), p["key"])
         with self._lock:
-            exists = key in self._kv
+            exists = self._kv.contains(key)
             if p.get("overwrite", True) or not exists:
-                self._kv[key] = p["value"]
-                self._mark_dirty()
+                self._kv.put(key, p["value"])
+                self._journal({"op": "kv_put", "ns": key[0],
+                               "key": key[1], "value": p["value"]})
                 return {"ok": True, "added": not exists}
         return {"ok": True, "added": False}
 
     def _kv_get(self, p):
-        with self._lock:
-            key = (p.get("ns", ""), p["key"])
-            return {"found": key in self._kv,
-                    "value": self._kv.get(key)}
+        # Lock-free read: one shard lock, no contention with mutations.
+        key = (p.get("ns", ""), p["key"])
+        sentinel = object()
+        value = self._kv.get(key, sentinel)
+        if value is sentinel:
+            return {"found": False, "value": None}
+        return {"found": True, "value": value}
 
     def _kv_del(self, p):
+        key = (p.get("ns", ""), p["key"])
         with self._lock:
-            deleted = self._kv.pop(
-                (p.get("ns", ""), p["key"]), None) is not None
+            deleted = self._kv.pop(key, None) is not None
             if deleted:
-                self._mark_dirty()
+                self._journal({"op": "kv_del", "ns": key[0],
+                               "key": key[1]})
             return {"deleted": deleted}
 
     def _kv_keys(self, p):
         prefix = p.get("prefix", "")
         ns = p.get("ns", "")
-        with self._lock:
-            return [k for (n, k) in self._kv if n == ns
-                    and k.startswith(prefix)]
+        return [k for (n, k) in self._kv.keys() if n == ns
+                and k.startswith(prefix)]
 
     # ------------------------------------------------------------- actors
     def _register_actor(self, p):
         with self._lock:
-            self._actors[p["actor_id"]] = {
+            info = {
                 "node_id": p["node_id"], "address": p["address"],
                 "name": p.get("name", ""),
                 "namespace": p.get("namespace", ""),
@@ -744,15 +1251,17 @@ class HeadServer:
             }
             if p.get("name"):
                 key = (p.get("namespace", ""), p["name"])
-                if key in self._named:
-                    existing = self._named[key]
-                    if existing != p["actor_id"]:
-                        return {"ok": False,
-                                "error": f"actor name {p['name']!r} "
-                                         "already taken",
-                                "existing": existing}
-                self._named[key] = p["actor_id"]
-            self._mark_dirty()
+                existing = self._named.get(key)
+                if existing is not None and existing != p["actor_id"]:
+                    return {"ok": False,
+                            "error": f"actor name {p['name']!r} "
+                                     "already taken",
+                            "existing": existing}
+                self._named.put(key, p["actor_id"])
+            self._actors.put(p["actor_id"], info)
+            self._journal({"op": "actor_put",
+                           "actor_id": p["actor_id"],
+                           "info": dict(info)})
         return {"ok": True}
 
     @staticmethod
@@ -761,17 +1270,16 @@ class HeadServer:
         return {k: v for k, v in info.items() if k != "spec"}
 
     def _lookup_actor(self, p):
-        with self._lock:
-            info = self._actors.get(p["actor_id"])
+        # Lock-free read through the sharded store.
+        info = self._actors.get(p["actor_id"])
         if info is None:
             return {"found": False}
         return {"found": True, **self._actor_view(info)}
 
     def _lookup_named_actor(self, p):
         key = (p.get("namespace", ""), p["name"])
-        with self._lock:
-            aid = self._named.get(key)
-            info = self._actors.get(aid) if aid else None
+        aid = self._named.get(key)
+        info = self._actors.get(aid) if aid else None
         if info is None:
             return {"found": False}
         return {"found": True, "actor_id": aid, **self._actor_view(info)}
@@ -783,7 +1291,8 @@ class HeadServer:
                 self._named.pop(
                     (info.get("namespace", ""), info["name"]), None)
             if info is not None:
-                self._mark_dirty()
+                self._journal({"op": "actor_del",
+                               "actor_id": p["actor_id"]})
         return {"ok": info is not None}
 
     def _list_actors_rpc(self, p):
@@ -795,15 +1304,14 @@ class HeadServer:
         # Same normalization as the task path (node_state uppercases):
         # `--state alive` must not silently match zero actors.
         state = state.upper() if isinstance(state, str) else state
-        with self._lock:
-            return [{"actor_id": aid, "node_id": i["node_id"],
-                     "name": i["name"],
-                     "state": i.get("state", "ALIVE")}
-                    for aid, i in self._actors.items()
-                    if (node is None
-                        or str(i["node_id"]).startswith(node))
-                    and (state is None
-                         or i.get("state", "ALIVE") == state)]
+        return [{"actor_id": aid, "node_id": i["node_id"],
+                 "name": i["name"],
+                 "state": i.get("state", "ALIVE")}
+                for aid, i in self._actors.items()
+                if (node is None
+                    or str(i["node_id"]).startswith(node))
+                and (state is None
+                     or i.get("state", "ALIVE") == state)]
 
     # ---------------------------------------------------------------- pgs
     def _create_pg(self, p):
@@ -824,9 +1332,11 @@ class HeadServer:
                 if not result.get("ok"):
                     return result
                 assignment = result["nodes"]
-                self._pgs[pg_id] = {"bundles": bundles,
-                                    "nodes": assignment}
-                self._mark_dirty()
+                self._pgs.put(pg_id, {"bundles": bundles,
+                                      "nodes": assignment})
+                self._journal({"op": "pg_put", "pg_id": pg_id,
+                               "bundles": bundles,
+                               "nodes": assignment})
                 addr = {e.node_id: e.address for e in alive}
                 return {"ok": True, "nodes": assignment,
                         "addresses": [addr[n] for n in assignment]}
@@ -858,8 +1368,10 @@ class HeadServer:
                             "error": f"bundle {bundle} does not fit "
                                      f"any node (strategy={strategy})"}
                 assignment.append(placed)
-            self._pgs[pg_id] = {"bundles": bundles, "nodes": assignment}
-            self._mark_dirty()
+            self._pgs.put(pg_id, {"bundles": bundles,
+                                  "nodes": assignment})
+            self._journal({"op": "pg_put", "pg_id": pg_id,
+                           "bundles": bundles, "nodes": assignment})
             addr = {e.node_id: e.address for e in alive}
         return {"ok": True, "nodes": assignment,
                 "addresses": [addr[n] for n in assignment]}
@@ -960,7 +1472,7 @@ class HeadServer:
         with self._lock:
             removed = self._pgs.pop(p["pg_id"], None) is not None
             if removed:
-                self._mark_dirty()
+                self._journal({"op": "pg_del", "pg_id": p["pg_id"]})
             return {"ok": removed}
 
     def shutdown(self):
@@ -971,6 +1483,10 @@ class HeadServer:
         self._pool.close_all()
         self._restarter.join(timeout=2.0)
         self._reaper.join(timeout=2.0)
+        if self._compactor is not None:
+            self._compactor.join(timeout=2.0)
+        if self._log is not None:
+            self._log.close()
 
 
 def main():  # pragma: no cover - exercised via subprocess in tests
@@ -980,8 +1496,11 @@ def main():  # pragma: no cover - exercised via subprocess in tests
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--storage", default=None,
+                    help="durable-table path (journal + snapshot); "
+                         "restart at the same port replays state")
     args = ap.parse_args()
-    head = HeadServer(args.host, args.port)
+    head = HeadServer(args.host, args.port, storage_path=args.storage)
     print(f"RAY_TPU_HEAD_ADDRESS={head.address}", flush=True)
     try:
         while True:

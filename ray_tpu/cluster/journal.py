@@ -1,0 +1,276 @@
+"""Write-ahead journal for the head's durable tables.
+
+Reference analogue: the GCS's Redis-backed table storage
+(store_client/redis_store_client.h:106) is synchronous-on-mutation;
+this module gives the file-backed head the same acked-write guarantee
+WITHOUT rewriting the whole snapshot per mutation (the seed behavior,
+O(tables) per op): mutations append fixed-overhead redo records to a
+segment file and fsync ONCE per RPC before the reply ships, and a
+background compactor periodically folds the log into a snapshot.
+
+Layout on disk, for a head constructed with ``storage_path=BASE``:
+
+- ``BASE``          — the snapshot: pickled ``{"state": ..., "seqno": N,
+  "format": 2}`` written atomically (tmp + fsync + rename).  Format 1
+  (the seed's bare table dict, no seqno) still loads.
+- ``BASE.wal.<KKKKKKKK>`` — journal segments.  Each record is framed
+  ``[u32 len][u32 crc32][pickle bytes]``; records carry a monotonic
+  ``seq`` so replay can skip anything the snapshot already folded in.
+
+Recovery = load snapshot, then replay every segment's records with
+``seq > snapshot.seqno`` in segment order.  A torn tail — the crash
+landed mid-append — is DISCARDED, not fatal: a record that never
+finished its fsync was never acked to any client, so dropping it
+loses nothing acknowledged.  Anything after the first bad frame in a
+segment is ignored (the framing is unrecoverable past a tear).
+
+Compaction protocol (``HeadServer._compact_loop`` drives it):
+
+1. under the table lock: serialize state, note ``seqno``, ``rotate()``
+   the journal to a fresh segment;
+2. outside the lock: write the snapshot atomically;
+3. ``drop_segments_before(rotated)`` deletes the folded-in segments.
+
+Mutations racing the compaction keep appending to the NEW segment with
+``seq > snapshot.seqno``; replay applies them on top of the snapshot.
+A crash between (1) and (2) is safe: the old snapshot plus ALL
+segments (the rotated-out one included, not yet deleted) still covers
+every acked record.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+_FRAME = struct.Struct(">II")  # (payload_len, crc32)
+_SNAPSHOT_FORMAT = 2
+
+
+def _journal_metrics():
+    """Head durability counters (rebuilt after registry resets)."""
+    from ..observability import metrics as _metrics
+
+    return _metrics.metric_group("head_journal", lambda: {
+        "appends": _metrics.Counter(
+            "ray_tpu_head_journal_appends_total",
+            "redo records appended to the head's WAL"),
+        "bytes": _metrics.Counter(
+            "ray_tpu_head_journal_bytes_total",
+            "bytes appended to the head's WAL"),
+        "commits": _metrics.Counter(
+            "ray_tpu_head_journal_commits_total",
+            "fsync barriers (one per acked mutation batch)"),
+        "commit_seconds": _metrics.Histogram(
+            "ray_tpu_head_journal_commit_seconds",
+            "flush+fsync latency per commit barrier",
+            boundaries=(1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0)),
+        "compactions": _metrics.Counter(
+            "ray_tpu_head_journal_compactions_total",
+            "journal-into-snapshot compactions"),
+        "replayed": _metrics.Counter(
+            "ray_tpu_head_journal_replayed_total",
+            "records replayed from the journal tail at recovery"),
+        "torn_discarded": _metrics.Counter(
+            "ray_tpu_head_journal_torn_discarded_total",
+            "torn/corrupt tail frames discarded at recovery"),
+    })
+
+
+class JournalWriter:
+    """Append-only segmented redo log.
+
+    ``append`` frames + buffers a record (cheap, no fsync); ``commit``
+    is the durability barrier — flush + fsync once, amortizing every
+    record the current RPC produced.  Thread-safe: appends serialize on
+    an internal lock so the on-disk order matches the order callers
+    appended in (the head appends while holding its table lock, which
+    is what makes replay order == apply order).
+    """
+
+    def __init__(self, base_path: str, *, start_seqno: int = 0,
+                 fsync: Optional[bool] = None):
+        self._base = base_path
+        self._lock = threading.Lock()
+        self._seqno = int(start_seqno)
+        self._dirty = False
+        if fsync is None:
+            fsync = os.environ.get(
+                "RAY_TPU_HEAD_JOURNAL_FSYNC", "1") != "0"
+        self._fsync = bool(fsync)
+        existing = list_segments(base_path)
+        next_idx = (existing[-1][0] + 1) if existing else 0
+        self._segment_idx = next_idx
+        self._file = open(segment_path(base_path, next_idx), "ab")
+        self.bytes_since_rotate = 0
+
+    @property
+    def seqno(self) -> int:
+        return self._seqno
+
+    def append(self, record: Dict[str, Any]) -> int:
+        """Frame + write one redo record; returns its seqno.  NOT yet
+        durable — pair with ``commit()`` before acking a client."""
+        with self._lock:
+            self._seqno += 1
+            record = dict(record)
+            record["seq"] = self._seqno
+            blob = pickle.dumps(record,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            self._file.write(_FRAME.pack(len(blob),
+                                         zlib.crc32(blob)))
+            self._file.write(blob)
+            self._dirty = True
+            self.bytes_since_rotate += _FRAME.size + len(blob)
+            m = _journal_metrics()
+            m["appends"].inc()
+            m["bytes"].inc(_FRAME.size + len(blob))
+            return self._seqno
+
+    def commit(self) -> None:
+        """Durability barrier: flush + fsync everything appended since
+        the last commit.  No-op when nothing is pending."""
+        with self._lock:
+            if not self._dirty:
+                return
+            t0 = time.perf_counter()
+            self._file.flush()
+            if self._fsync:
+                os.fsync(self._file.fileno())
+            self._dirty = False
+            m = _journal_metrics()
+            m["commits"].inc()
+            m["commit_seconds"].observe(time.perf_counter() - t0)
+
+    def rotate(self) -> int:
+        """Start a fresh segment; returns the index of the NEW segment
+        (callers snapshotting state at rotation time later delete
+        every segment with index < returned value)."""
+        with self._lock:
+            self._file.flush()
+            if self._fsync:
+                os.fsync(self._file.fileno())
+            self._file.close()
+            self._segment_idx += 1
+            self._file = open(
+                segment_path(self._base, self._segment_idx), "ab")
+            self._dirty = False
+            self.bytes_since_rotate = 0
+            return self._segment_idx
+
+    def drop_segments_before(self, idx: int) -> None:
+        for seg_idx, path in list_segments(self._base):
+            if seg_idx < idx:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._file.flush()
+                if self._fsync:
+                    os.fsync(self._file.fileno())
+            except (OSError, ValueError):
+                pass
+            try:
+                self._file.close()
+            except OSError:
+                pass
+
+
+def segment_path(base: str, idx: int) -> str:
+    return f"{base}.wal.{idx:08d}"
+
+
+def list_segments(base: str) -> List[Tuple[int, str]]:
+    """Existing (index, path) segments for ``base``, index-sorted."""
+    d = os.path.dirname(base) or "."
+    prefix = os.path.basename(base) + ".wal."
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(prefix):
+            continue
+        try:
+            idx = int(name[len(prefix):])
+        except ValueError:
+            continue
+        out.append((idx, os.path.join(d, name)))
+    out.sort()
+    return out
+
+
+def read_segment(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield records until EOF or the first torn/corrupt frame.  A
+    tear (short header, short payload, crc mismatch, unpicklable
+    payload) ends the segment silently — by construction nothing past
+    it was ever acked."""
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return
+    try:
+        while True:
+            header = f.read(_FRAME.size)
+            if len(header) < _FRAME.size:
+                if header:
+                    _journal_metrics()["torn_discarded"].inc()
+                return
+            length, crc = _FRAME.unpack(header)
+            blob = f.read(length)
+            if len(blob) < length or zlib.crc32(blob) != crc:
+                _journal_metrics()["torn_discarded"].inc()
+                return
+            try:
+                rec = pickle.loads(blob)
+            except Exception:
+                _journal_metrics()["torn_discarded"].inc()
+                return
+            yield rec
+    finally:
+        f.close()
+
+
+def write_snapshot(base: str, state: Dict[str, Any],
+                   seqno: int) -> None:
+    """Atomic snapshot write: tmp + fsync + rename, so a crash
+    mid-write leaves the previous snapshot intact."""
+    blob = pickle.dumps({"format": _SNAPSHOT_FORMAT, "state": state,
+                         "seqno": int(seqno)},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    tmp = base + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, base)
+
+
+def load_snapshot(base: str) -> Tuple[Optional[Dict[str, Any]], int]:
+    """(state, seqno) from the snapshot, or (None, 0) when absent or
+    unreadable.  Format-1 snapshots (the seed's bare table dict) load
+    as state with seqno 0."""
+    if not os.path.exists(base):
+        return None, 0
+    try:
+        with open(base, "rb") as f:
+            blob = pickle.load(f)
+    except Exception:
+        return None, 0
+    if isinstance(blob, dict) and blob.get("format") == _SNAPSHOT_FORMAT:
+        return blob.get("state") or {}, int(blob.get("seqno") or 0)
+    if isinstance(blob, dict):
+        return blob, 0  # format 1: the dict IS the state
+    return None, 0
+
+

@@ -170,6 +170,19 @@ class IdempotencyCache:
                     self._replies.pop(self._order.pop(0), None)
             self._replies[key] = reply
 
+    def export(self) -> Dict[str, Any]:
+        """Insertion-ordered {key: reply} copy — persisted with the
+        head's durable tables so the dedup window spans a restart (a
+        client retrying a mutation whose ack raced a head kill -9
+        replays the first reply instead of double-applying)."""
+        with self._lock:
+            return {k: self._replies[k] for k in self._order
+                    if k in self._replies}
+
+    def load(self, entries: Dict[str, Any]) -> None:
+        for key, reply in (entries or {}).items():
+            self.put(key, reply)
+
 
 class DeserializationError(RuntimeError):
     """A message payload failed ``pickle.loads`` on the receiver.
@@ -466,8 +479,12 @@ class RpcClient:
         self.address = address
         # Legacy env-var chaos budget (per client, so subprocess
         # workers inherit faults); the programmable schedule is
-        # consulted globally in call_async.
+        # consulted globally in call_async.  ``chaos_tag`` names the
+        # logical caller for targeted fault rules (partition_node):
+        # vcluster sets it to the virtual node's id; it defaults to
+        # the peer address.
         self._chaos = _chaos.env_rpc_budget()
+        self.chaos_tag = ""
         self._lock = threading.Lock()      # connection state
         self._wlock = threading.Lock()     # socket writes
         self._pending: Dict[str, _PendingCall] = {}
@@ -557,7 +574,7 @@ class RpcClient:
     def call_async(self, method: str, payload: Any = None,
                    callback: Optional[Callable[[Any, bool], None]] = None,
                    deadline: Optional[float] = None) -> "_PendingCall":
-        _chaos.on_rpc(method)
+        _chaos.on_rpc(method, self.chaos_tag or self.address)
         self._chaos.maybe_fail(method)
         req_id = uuid.uuid4().hex
         call = _PendingCall(method, callback)
@@ -651,7 +668,17 @@ class ReconnectingClient:
         self._lock = threading.Lock()
         self._closed = False
         self._no_redial_until = 0.0
+        self._chaos_tag = ""
         self._client = RpcClient(address, connect_timeout)
+
+    @property
+    def chaos_tag(self) -> str:
+        return self._chaos_tag
+
+    @chaos_tag.setter
+    def chaos_tag(self, tag: str) -> None:
+        self._chaos_tag = tag
+        self._client.chaos_tag = tag
 
     def _reconnect(self) -> RpcClient:
         with self._lock:
@@ -692,6 +719,7 @@ class ReconnectingClient:
                 fresh.close()
                 raise ConnectionError(
                     f"client to {self.address} is closed")
+            fresh.chaos_tag = self._chaos_tag
             self._client = fresh
             return self._client
 

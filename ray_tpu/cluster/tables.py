@@ -1,0 +1,114 @@
+"""Sharded in-memory stores for the head's hot tables.
+
+Reference analogue: the GCS's per-table storage interface
+(gcs_table_storage.h) — every table goes through one narrow store API
+so the backing implementation can change without touching handler
+code.  Here the tables shard by key hash with a lock per shard:
+
+- **reads scale**: ``lookup_actor`` / ``kv_get`` / named-actor
+  resolution take ONE shard lock instead of the head's global mutation
+  lock, so a thousand nodes polling lookups don't convoy behind a
+  placement or registration in flight;
+- **replication-ready**: the interface is the unit a replicated head
+  would partition or mirror — handlers never touch a raw dict, so a
+  Raft-backed or remote-shard store can slot in behind the same calls
+  (ROADMAP item 5's explicit ask).
+
+Mutations stay serialized by the head's commit lock (journal ordering
+needs a total order anyway — see journal.py); the shard locks make
+each individual read/write atomic without it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class ShardedTable:
+    """Dict-like store partitioned over ``n_shards`` lock-guarded
+    shards.  Iteration helpers (``items``/``keys``/``values``/
+    ``snapshot``) copy shard-by-shard — consistent per shard, not
+    globally, which is exactly the consistency a lookup/list RPC needs
+    (the authoritative order lives in the journal)."""
+
+    __slots__ = ("_shards", "_locks", "_n")
+
+    def __init__(self, n_shards: int = 16):
+        self._n = max(1, int(n_shards))
+        self._shards: List[Dict[Any, Any]] = [
+            {} for _ in range(self._n)]
+        self._locks = [threading.Lock() for _ in range(self._n)]
+
+    def shard_of(self, key) -> int:
+        return hash(key) % self._n
+
+    # ------------------------------------------------------------ point ops
+    def get(self, key, default=None):
+        i = self.shard_of(key)
+        with self._locks[i]:
+            return self._shards[i].get(key, default)
+
+    def put(self, key, value) -> None:
+        i = self.shard_of(key)
+        with self._locks[i]:
+            self._shards[i][key] = value
+
+    def setdefault(self, key, value):
+        i = self.shard_of(key)
+        with self._locks[i]:
+            return self._shards[i].setdefault(key, value)
+
+    def pop(self, key, default=None):
+        i = self.shard_of(key)
+        with self._locks[i]:
+            return self._shards[i].pop(key, default)
+
+    def contains(self, key) -> bool:
+        i = self.shard_of(key)
+        with self._locks[i]:
+            return key in self._shards[i]
+
+    __contains__ = contains
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    # -------------------------------------------------------- bulk/iterate
+    def items(self) -> List[Tuple[Any, Any]]:
+        out: List[Tuple[Any, Any]] = []
+        for i in range(self._n):
+            with self._locks[i]:
+                out.extend(self._shards[i].items())
+        return out
+
+    def keys(self) -> List[Any]:
+        return [k for k, _v in self.items()]
+
+    def values(self) -> List[Any]:
+        return [v for _k, v in self.items()]
+
+    def snapshot(self) -> Dict[Any, Any]:
+        """A plain-dict copy (compaction/persistence input)."""
+        return dict(self.items())
+
+    def replace_all(self, data: Dict[Any, Any]) -> None:
+        """Recovery path: drop everything, load ``data``."""
+        fresh: List[Dict[Any, Any]] = [{} for _ in range(self._n)]
+        for k, v in (data or {}).items():
+            fresh[self.shard_of(k)][k] = v
+        for i in range(self._n):
+            with self._locks[i]:
+                self._shards[i] = fresh[i]
+
+    def clear(self) -> None:
+        self.replace_all({})
+
+    def for_each_shard(self, fn: Callable[[int, Dict[Any, Any]], None]
+                       ) -> None:
+        """Run ``fn(shard_index, shard_dict)`` under each shard's lock
+        in turn — the migration/replication hook (a replicated head
+        ships shards, not whole tables)."""
+        for i in range(self._n):
+            with self._locks[i]:
+                fn(i, self._shards[i])

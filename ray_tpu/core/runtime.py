@@ -947,7 +947,7 @@ class Runtime:
             # location through the head, named or not.
             from ..cluster.serialization import dumps as _dumps
 
-            self.cluster.head.call_idempotent("register_actor", {
+            self.cluster.mut_call("register_actor", {
                 "actor_id": actor_id.binary(),
                 "node_id": self.cluster.node_id,
                 "address": self.cluster.address,
@@ -1189,7 +1189,7 @@ class Runtime:
             from ..cluster.rpc import TRANSPORT_ERRORS as _TRANSPORT_ERRORS
 
             try:
-                self.cluster.head.call_idempotent(
+                self.cluster.mut_call(
                     "remove_actor", {"actor_id": actor_id.binary()},
                     deadline_s=10.0)
             except _TRANSPORT_ERRORS:

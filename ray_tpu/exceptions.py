@@ -222,6 +222,51 @@ class NodeDiedError(RayTpuError):
     pass
 
 
+class StaleEpochError(RayTpuError):
+    """A mutating control-plane RPC carried a fenced lease epoch.
+
+    The head minted the caller a ``(lease_id, epoch)`` pair at node
+    registration; declaring the node dead fences that epoch, and a
+    later re-registration mints a strictly newer one.  A write arriving
+    with a superseded epoch is a ZOMBIE — a node that was declared dead
+    and never re-attached (partition heal, paused VM, delayed packet) —
+    and is rejected typed instead of silently overwriting live state
+    (the classic lease-fencing pattern; reference: GCS node-death
+    fencing via raylet restarts + the fencing-token literature).
+
+    The fix on the caller's side is always the same: re-register (the
+    heartbeat loop does this automatically on its next beat) and replay
+    intent against the CURRENT cluster state, which may have moved on.
+    """
+
+    def __init__(self, reason: str = "stale lease epoch", *,
+                 node_id: str = "", sent_epoch=None,
+                 current_epoch=None, context=None):
+        self.reason = reason
+        self.node_id = node_id
+        self.sent_epoch = sent_epoch
+        self.current_epoch = current_epoch
+        self.context = dict(context or {})
+        ctx = dict(self.context)
+        if node_id:
+            ctx.setdefault("node_id", node_id[:12])
+        if sent_epoch is not None:
+            ctx.setdefault("sent_epoch", sent_epoch)
+        if current_epoch is not None:
+            ctx.setdefault("current_epoch", current_epoch)
+        super().__init__(reason + _format_context(ctx))
+
+    def __reduce__(self):
+        return (_rebuild_stale_epoch,
+                (self.reason, self.node_id, self.sent_epoch,
+                 self.current_epoch, self.context))
+
+
+def _rebuild_stale_epoch(reason, node_id, sent, cur, context):
+    return StaleEpochError(reason, node_id=node_id, sent_epoch=sent,
+                           current_epoch=cur, context=context)
+
+
 class OutOfMemoryError(RayTpuError):
     """Worker killed by the memory monitor (reference: OOM killer, N22)."""
 

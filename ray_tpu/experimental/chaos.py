@@ -46,6 +46,10 @@ __all__ = [
     "ChaosSchedule", "ChaosKill", "schedule", "active", "current",
     "on_rpc", "ring_write_action", "actor_task_action",
     "env_rpc_budget", "EnvRpcBudget",
+    # Control-plane chaos (PR 8): head kill -9, node partitions,
+    # heartbeat loss — the vcluster soak's fault vocabulary.
+    "kill_head", "register_head_process", "partition_node",
+    "drop_heartbeats", "reset",
 ]
 
 
@@ -63,12 +67,13 @@ class ChaosKill(BaseException):
 
 class _Rule:
     __slots__ = ("kind", "target", "nth", "count", "delay_s", "prob",
-                 "no_restart", "exc_type", "jitter_s", "hits", "fires")
+                 "no_restart", "exc_type", "jitter_s", "hits", "fires",
+                 "until")
 
     def __init__(self, kind: str, target: str, *, nth: int = 1,
                  count: int = 1, delay_s: float = 0.0, prob: float = 1.0,
                  no_restart: bool = True, exc_type: type = RuntimeError,
-                 jitter_s: float = 0.0):
+                 jitter_s: float = 0.0, until: float = 0.0):
         self.kind = kind
         self.target = target
         self.nth = max(1, int(nth))
@@ -78,6 +83,7 @@ class _Rule:
         self.no_restart = bool(no_restart)
         self.exc_type = exc_type
         self.jitter_s = float(jitter_s)
+        self.until = float(until)  # monotonic window end (0 = no window)
         self.hits = 0    # matching hook invocations seen
         self.fires = 0   # faults actually injected
 
@@ -173,6 +179,40 @@ class ChaosSchedule:
                                  count=count, delay_s=stall_s))
         return self
 
+    # Control-plane chaos (PR 8): the vcluster soak's fault model —
+    # node↔head partitions and heartbeat loss on the RPC layer (the
+    # head kill -9 is the imperative module-level kill_head()).
+    def partition_node(self, substr: str,
+                       duration_s: float) -> "ChaosSchedule":
+        """Drop EVERY outgoing RPC whose caller tag (RpcClient
+        .chaos_tag, defaulting to the peer address) contains
+        ``substr``, for ``duration_s`` starting NOW — a symmetric
+        network partition as seen from this process.  The node misses
+        lease renewals, the head declares it dead, and any write it
+        had in flight comes back ``StaleEpochError`` once the
+        partition heals."""
+        self._rules.append(_Rule(
+            "rpc_partition", substr, count=1 << 30,
+            until=time.monotonic() + float(duration_s)))
+        return self
+
+    def drop_heartbeats(self, frac: float, *,
+                        duration_s: float = 0.0) -> "ChaosSchedule":
+        """Drop each ``heartbeat`` RPC with probability ``frac``
+        (drawn from the schedule's seeded RNG) — degraded-fabric lease
+        renewal.  Matches the method EXACTLY: the vcluster pump runs
+        this hook once per virtual node before batching, so matching
+        the ``heartbeat_batch`` wire call too would drop whole
+        connections' batches on top of the per-node losses (~2x the
+        asked-for fraction, correlated).  ``duration_s`` bounds the
+        window (0 = until the schedule deactivates)."""
+        until = (time.monotonic() + float(duration_s)
+                 if duration_s else 0.0)
+        self._rules.append(_Rule("rpc_dropfrac", "heartbeat",
+                                 count=1 << 30, prob=float(frac),
+                                 until=until))
+        return self
+
     # ----------------------------------------------------------- queries
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -243,7 +283,30 @@ class ChaosSchedule:
                 return rule
         return None
 
-    def rpc_hook(self, method: str) -> None:
+    def rpc_hook(self, method: str, tag: str = "") -> None:
+        # Windowed control-plane faults first: partitions match the
+        # CALLER tag (substring), heartbeat loss matches the method
+        # family probabilistically.
+        now = time.monotonic()
+        with self._lock:
+            for rule in self._rules:
+                if rule.until and now >= rule.until:
+                    continue
+                if rule.kind == "rpc_partition" and rule.target in tag:
+                    rule.hits += 1
+                    self._record(rule, {"method": method, "tag": tag})
+                    raise ConnectionError(
+                        f"[chaos] partition: rpc {method!r} from "
+                        f"{tag!r} dropped")
+                if rule.kind == "rpc_dropfrac" and \
+                        method == rule.target:
+                    rule.hits += 1
+                    if self._rng.random() < rule.prob:
+                        self._record(rule, {"method": method,
+                                            "tag": tag})
+                        raise ConnectionError(
+                            f"[chaos] heartbeat dropped "
+                            f"({method!r}, hit {rule.hits})")
         rule = self._match(("rpc_drop", "rpc_delay"), method)
         if rule is None:
             return
@@ -344,15 +407,87 @@ def current() -> Optional[ChaosSchedule]:
     return _active
 
 
+def _ensure_active(seed: int = 0) -> ChaosSchedule:
+    """The active schedule, installing a fresh one process-wide if
+    none is active — the imperative chaos API (partition_node /
+    drop_heartbeats called as functions, soak-harness style) rides
+    this.  Pair with :func:`reset` in teardown."""
+    global _active
+    with _active_lock:
+        if _active is None:
+            _active = ChaosSchedule(seed)
+        return _active
+
+
+def reset() -> None:
+    """Deactivate whatever schedule is installed (test teardown for
+    the imperative API; the context-manager API self-uninstalls)."""
+    global _active
+    with _active_lock:
+        _active = None
+
+
+def partition_node(substr: str, duration_s: float) -> ChaosSchedule:
+    """Imperative form of :meth:`ChaosSchedule.partition_node`: start
+    dropping RPCs from callers tagged ``substr`` NOW, for
+    ``duration_s``, on the active (or a freshly installed) schedule."""
+    return _ensure_active().partition_node(substr, duration_s)
+
+
+def drop_heartbeats(frac: float, *,
+                    duration_s: float = 0.0) -> ChaosSchedule:
+    """Imperative form of :meth:`ChaosSchedule.drop_heartbeats`."""
+    return _ensure_active().drop_heartbeats(frac,
+                                            duration_s=duration_s)
+
+
+# ---------------------------------------------------------------------------
+# Head kill -9 (the control-plane chaos the vcluster soak is built on)
+# ---------------------------------------------------------------------------
+
+_head_proc = None
+_head_proc_lock = threading.Lock()
+
+
+def register_head_process(proc) -> None:
+    """Tell chaos which subprocess is the head (cluster_utils /
+    vcluster call this when they spawn one); ``kill_head()`` targets
+    it."""
+    global _head_proc
+    with _head_proc_lock:
+        _head_proc = proc
+
+
+def kill_head(sig: Optional[int] = None):
+    """SIGKILL the registered head process — a true kill -9: no
+    snapshot flush, no socket teardown, journal possibly torn
+    mid-record.  Returns the killed process object.  Raises
+    RuntimeError when no head subprocess was registered (an in-process
+    head cannot be kill -9'd without taking the test down too)."""
+    import signal as _signal
+
+    with _head_proc_lock:
+        proc = _head_proc
+    if proc is None or proc.poll() is not None:
+        raise RuntimeError(
+            "chaos.kill_head: no live head subprocess registered "
+            "(spawn the head via tools.vcluster or register it with "
+            "chaos.register_head_process)")
+    proc.send_signal(_signal.SIGKILL if sig is None else sig)
+    proc.wait(timeout=10.0)
+    return proc
+
+
 # ---------------------------------------------------------------------------
 # Hook points (called by the runtime; near-zero cost when inactive)
 # ---------------------------------------------------------------------------
 
-def on_rpc(method: str) -> None:
-    """cluster/rpc.py: may raise ConnectionError (drop) or stall."""
+def on_rpc(method: str, tag: str = "") -> None:
+    """cluster/rpc.py: may raise ConnectionError (drop) or stall.
+    ``tag`` names the caller for targeted rules (partition_node)."""
     sched = _active
     if sched is not None:
-        sched.rpc_hook(method)
+        sched.rpc_hook(method, tag)
 
 
 def ring_write_action(path: str, seq: int) -> Optional[Tuple]:

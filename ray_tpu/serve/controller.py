@@ -39,6 +39,68 @@ class ServeController:
         self._autoscaler = threading.Thread(
             target=self._autoscale_loop, daemon=True)
         self._autoscaler.start()
+        # Controller recovery (PR 8): deployment specs checkpoint to
+        # the head's durable KV (journaled — survives a head kill -9).
+        # A FRESH controller (this actor restarted on a survivor after
+        # its node died) redeploys everything the checkpoint names; on
+        # first boot the checkpoint is absent and this is a no-op.
+        self._recover_from_checkpoint()
+
+    # ------------------------------------------------------ checkpointing
+    _CKPT_KEY = "controller_deployments"
+    _CKPT_NS = "serve"
+
+    @staticmethod
+    def _head_kv():
+        """The durable KV, or None outside cluster mode (single-node
+        serve keeps everything in-process — nothing survives the
+        process anyway)."""
+        import ray_tpu
+
+        try:
+            rt = ray_tpu.get_runtime()
+        except RuntimeError:
+            return None
+        return rt.cluster
+
+    def _checkpoint(self):
+        kv = self._head_kv()
+        if kv is None:
+            return
+        from ..cluster.serialization import dumps
+
+        with self._lock:
+            specs = {name: dumps({
+                "callable": d["callable"],
+                "init_args": d["init_args"],
+                "init_kwargs": d["init_kwargs"],
+                "config": d["config"],
+            }) for name, d in self._deployments.items()}
+        try:
+            kv.kv_put(self._CKPT_KEY, specs, ns=self._CKPT_NS)
+        except Exception:  # raylint: disable=ft-exception-swallow -- checkpointing is best-effort: a head outage mid-deploy must not fail the deploy (the next deploy/delete re-checkpoints)
+            pass
+
+    def _recover_from_checkpoint(self):
+        kv = self._head_kv()
+        if kv is None:
+            return
+        from ..cluster.serialization import loads
+
+        try:
+            specs = kv.kv_get(self._CKPT_KEY, ns=self._CKPT_NS)
+        except Exception:  # raylint: disable=ft-exception-swallow -- recovery is opportunistic at construction; an unreachable head means there is nothing to recover yet
+            return
+        for name, blob in (specs or {}).items():
+            if name in self._deployments:
+                continue
+            try:
+                spec = loads(blob)
+                self.deploy(name, spec["callable"],
+                            spec["init_args"], spec["init_kwargs"],
+                            spec["config"])
+            except Exception:  # raylint: disable=ft-exception-swallow -- one unrecoverable deployment (its class no longer imports, its resources are gone) must not block the rest of the recovery
+                pass
 
     # ------------------------------------------------------------ deploy
     def deploy(self, name: str, callable_def, init_args: Tuple,
@@ -55,8 +117,10 @@ class ServeController:
             # is the invariant: two racing deploys of one deployment
             # must serialize end to end.  No RPC handler or other
             # deployment ever contends on this lock.
-            return self._deploy_locked(name, callable_def, init_args,  # raylint: disable=blocking-under-lock -- per-deployment rollout serialization is this lock's purpose
-                                       init_kwargs, config)
+            out = self._deploy_locked(name, callable_def, init_args,  # raylint: disable=blocking-under-lock -- per-deployment rollout serialization is this lock's purpose
+                                      init_kwargs, config)
+        self._checkpoint()
+        return out
 
     def _deploy_locked(self, name, callable_def, init_args,
                        init_kwargs, config):
@@ -370,6 +434,7 @@ class ServeController:
             d = self._deployments.pop(name, None)
         if d:
             self._stop_replicas(d["replicas"])
+            self._checkpoint()
         return d is not None
 
     def shutdown(self):

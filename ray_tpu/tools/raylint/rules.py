@@ -942,6 +942,119 @@ def rule_suppression_syntax(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: journaled-mutation
+# --------------------------------------------------------------------------
+
+# The head's durable tables (cluster/head.py): any RPC handler that
+# writes one must ride the _mut wrapper, which journals + fsyncs the
+# redo records BEFORE the reply ships.  An unwrapped writer acks
+# mutations that a head kill -9 silently loses.
+_DURABLE_TABLES = {"_kv", "_actors", "_named", "_pgs"}
+_TABLE_WRITE_METHODS = {"put", "pop", "clear", "replace_all",
+                        "setdefault", "update"}
+_JOURNAL_TRANSITIVE_DEPTH = 3
+
+
+def _durable_attr(expr: ast.AST) -> Optional[str]:
+    """'self._kv' -> '_kv' when it names a durable table."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id == "self" and expr.attr in _DURABLE_TABLES:
+        return expr.attr
+    return None
+
+
+def _durable_write_in(model: ProjectModel, fi: FuncInfo,
+                      depth: int = _JOURNAL_TRANSITIVE_DEPTH,
+                      seen: Optional[set] = None) -> Optional[str]:
+    """Name of the durable table ``fi`` writes — directly
+    (``self._kv[...] = v``, ``del self._kv[...]``, ``self._kv.put/
+    pop/...``) or through self-method calls up to ``depth`` — else
+    None."""
+    seen = set() if seen is None else seen
+    if fi.qualname in seen:
+        return None
+    seen.add(fi.qualname)
+    for node in model.walk_own(fi.node):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                hit = _durable_attr(t.value)
+                if hit:
+                    return hit
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _TABLE_WRITE_METHODS:
+            hit = _durable_attr(node.func.value)
+            if hit:
+                return hit
+    if depth <= 0:
+        return None
+    prefix = fi.qualname.rsplit(".", 1)[0]
+    for node in model.walk_own(fi.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            sub = model.functions.get(f"{prefix}.{node.func.attr}")
+            if sub is not None:
+                hit = _durable_write_in(model, sub, depth - 1, seen)
+                if hit:
+                    return hit
+    return None
+
+
+def rule_journaled_mutation(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "journaled-mutation")
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        prefix = fi.qualname.rsplit(".", 1)[0]
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            entries: List[Tuple[str, ast.AST, int]] = []
+            if name == "RpcServer" and node.args and \
+                    isinstance(node.args[0], ast.Dict):
+                for key, value in zip(node.args[0].keys,
+                                      node.args[0].values):
+                    if isinstance(key, ast.Constant) and \
+                            isinstance(key.value, str):
+                        entries.append((key.value, value, key.lineno))
+            elif name == "add_handler" and len(node.args) >= 2 and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                entries.append((node.args[0].value, node.args[1],
+                                node.lineno))
+            for hname, value, line in entries:
+                if _is_wrapped(value):
+                    continue
+                if not (isinstance(value, ast.Attribute)
+                        and isinstance(value.value, ast.Name)
+                        and value.value.id == "self"):
+                    continue
+                target = model.functions.get(f"{prefix}.{value.attr}")
+                if target is None:
+                    continue
+                table = _durable_write_in(model, target)
+                if table:
+                    out.add(info, line, fi.qualname,
+                            f"handler {hname!r} writes durable table "
+                            f"{table!r} but is registered without the "
+                            f"_mut/journal wrapper — a head kill -9 "
+                            f"loses its acked mutations")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -955,6 +1068,7 @@ RULES = {
     "unbounded-mailbox": rule_unbounded_mailbox,
     "log-hygiene": rule_log_hygiene,
     "suppression-syntax": rule_suppression_syntax,
+    "journaled-mutation": rule_journaled_mutation,
 }
 
 RULE_DOCS = {
@@ -1007,4 +1121,12 @@ RULE_DOCS = {
         "raylint disables must name real rules and carry a "
         "'-- reason'; a reasonless or typo'd disable does not "
         "suppress anything."),
+    "journaled-mutation": (
+        "Any RPC handler that writes a durable head table (_kv, "
+        "_actors, _named, _pgs — directly or through self-method "
+        "calls) must be registered through the _mut/journal wrapper: "
+        "it journals + fsyncs the redo records before the reply "
+        "ships.  An unwrapped writer acks mutations a head kill -9 "
+        "silently loses, and skips idempotency dedup and epoch "
+        "fencing besides."),
 }

@@ -197,7 +197,7 @@ def _reserve_cluster(rt, pg: PlacementGroup) -> None:
     bundle's synthetic resources against its real capacity (the
     two-phase prepare/commit of SURVEY A.13, collapsed to assign+mint
     with per-node rollback on failure)."""
-    resp = rt.cluster.head.call("create_pg", {
+    resp = rt.cluster.mut_call("create_pg", {
         "pg_id": pg.id.hex(), "bundles": pg.bundles,
         "strategy": pg.strategy}, timeout=30.0)
     if not resp.get("ok"):
@@ -223,7 +223,7 @@ def _reserve_cluster(rt, pg: PlacementGroup) -> None:
                          "bundles": by_addr[done]}, timeout=30.0)
                 except TRANSPORT_ERRORS:
                     pass  # rollback target died: its capacity died too
-            rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
+            rt.cluster.mut_call("remove_pg", {"pg_id": pg.id.hex()})
             return
         minted.append(addr)
     pg._cluster_assignment = {"nodes": nodes, "addresses": addrs}
@@ -242,7 +242,7 @@ def _reserve_cluster(rt, pg: PlacementGroup) -> None:
         except TRANSPORT_ERRORS:
             pass  # node gone: nothing left to unmint
     try:
-        rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
+        rt.cluster.mut_call("remove_pg", {"pg_id": pg.id.hex()})
     except TRANSPORT_ERRORS:
         pass  # head unreachable: the PG table entry dies with it
 
@@ -276,7 +276,7 @@ def remove_placement_group(pg: PlacementGroup):
                 except TRANSPORT_ERRORS:
                     pass  # node gone: nothing left to unmint
             try:
-                rt.cluster.head.call("remove_pg", {"pg_id": pg.id.hex()})
+                rt.cluster.mut_call("remove_pg", {"pg_id": pg.id.hex()})
             except TRANSPORT_ERRORS:
                 pass  # head unreachable: the PG table entry dies with it
         else:

@@ -40,15 +40,27 @@ class ChaosHangGuardTimeout(BaseException):
     retry, and SIGALRM is one-shot."""
 
 
+def pytest_collection_modifyitems(config, items):
+    # ``stress`` implies ``slow``: the virtual-cluster soaks run
+    # hundreds of simulated nodes for tens of seconds — tier-1
+    # (-m 'not slow') must skip them without every soak needing two
+    # markers by hand.
+    for item in items:
+        if item.get_closest_marker("stress") is not None:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True)
 def _chaos_hang_guard(request):
-    # overload and net tests share the guard: their failure mode is
-    # ALSO a hang (a shed point that never fires leaves waiters queued
-    # forever under sustained load; a wedged collective ring blocks
-    # every member on a recv that never lands).
+    # overload, net, and stress tests share the guard: their failure
+    # mode is ALSO a hang (a shed point that never fires leaves
+    # waiters queued forever under sustained load; a wedged collective
+    # ring blocks every member on a recv that never lands; a vcluster
+    # soak whose head never recovers blocks every load thread).
     if request.node.get_closest_marker("chaos") is None and \
             request.node.get_closest_marker("overload") is None and \
-            request.node.get_closest_marker("net") is None:
+            request.node.get_closest_marker("net") is None and \
+            request.node.get_closest_marker("stress") is None:
         yield
         return
     import signal

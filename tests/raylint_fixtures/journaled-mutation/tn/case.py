@@ -1,0 +1,51 @@
+"""True negative: every durable-table writer rides the _mut/journal
+wrapper; read-only handlers and soft-state writers stay raw."""
+
+
+def idempotent_handler(fn, cache):
+    return fn
+
+
+class RpcServer:
+    def __init__(self, handlers, host="127.0.0.1", port=0):
+        self.handlers = handlers
+
+    def add_handler(self, method, fn):
+        self.handlers[method] = fn
+
+
+class Head:
+    def __init__(self):
+        self._kv = {}
+        self._actors = {}
+        self._idem = object()
+        self._nodes = {}  # soft state: NOT a durable table
+
+    def _sync_view(self, p):
+        self._kv[(p["ns"], p["key"])] = p["value"]
+        return {"ok": True}
+
+    def _retire_entries(self, p):
+        self._actors.pop(p["actor_id"], None)
+        return {"ok": True}
+
+    def _read_view(self, p):
+        return dict(self._kv)
+
+    def _touch_node(self, p):
+        # Writes SOFT state only (heartbeat-shaped): raw is fine.
+        self._nodes[p["node_id"]] = p
+        return {"ok": True}
+
+    def build(self):
+        def _mut(fn):
+            return idempotent_handler(fn, self._idem)
+
+        server = RpcServer({
+            "sync_view": _mut(self._sync_view),
+            "retire_entries": _mut(self._retire_entries),
+            "read_view": self._read_view,
+            "touch_node": self._touch_node,
+        })
+        server.add_handler("late_sync", _mut(self._sync_view))
+        return server

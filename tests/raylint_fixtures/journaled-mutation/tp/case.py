@@ -1,0 +1,47 @@
+"""True positive: handlers that write durable head tables registered
+WITHOUT the _mut/journal wrapper — their acked mutations vanish on a
+head kill -9 (no redo record ever hits the WAL)."""
+
+
+class RpcServer:
+    def __init__(self, handlers, host="127.0.0.1", port=0):
+        self.handlers = handlers
+
+    def add_handler(self, method, fn):
+        self.handlers[method] = fn
+
+
+class Head:
+    def __init__(self):
+        self._kv = {}
+        self._actors = {}
+        self._named = {}
+
+    def _sync_view(self, p):
+        # Direct subscript write to a durable table.
+        self._kv[(p["ns"], p["key"])] = p["value"]
+        return {"ok": True}
+
+    def _retire_entries(self, p):
+        # Transitive: the handler delegates to a helper that writes.
+        self._drop_actor(p["actor_id"])
+        return {"ok": True}
+
+    def _drop_actor(self, aid):
+        info = self._actors.pop(aid, None)
+        if info and info.get("name"):
+            del self._named[info["name"]]
+        return info
+
+    def _read_view(self, p):
+        # Read-only: must NOT be flagged.
+        return dict(self._kv)
+
+    def build(self):
+        server = RpcServer({
+            "sync_view": self._sync_view,
+            "retire_entries": self._retire_entries,
+            "read_view": self._read_view,
+        })
+        server.add_handler("late_sync", self._sync_view)
+        return server

@@ -67,6 +67,19 @@ def test_handler_idempotency_names_the_handler():
     assert not any("'list_nodes'" in m for m in msgs)  # read-only
 
 
+def test_journaled_mutation_direct_transitive_and_exemptions():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("journaled-mutation", "tp"), "journaled-mutation")]
+    # Direct subscript write, table named in the message.
+    assert any("'sync_view'" in m and "'_kv'" in m for m in msgs)
+    # Transitive: handler -> self._drop_actor -> _actors.pop.
+    assert any("'retire_entries'" in m for m in msgs)
+    # The add_handler registration form.
+    assert any("'late_sync'" in m for m in msgs)
+    # Read-only handlers stay clean.
+    assert not any("'read_view'" in m for m in msgs)
+
+
 def test_trace_propagation_subchecks():
     msgs = [f.message for f in of_rule(
         lint_fixture("trace-propagation", "tp"), "trace-propagation")]

@@ -1,0 +1,163 @@
+"""Virtual-cluster harness: control-plane scale + chaos soaks.
+
+The smoke (tier-1, 25 nodes) proves the full kill -9 story fast; the
+``stress``-marked soak is the PR-8 acceptance run — 300 virtual nodes
+under sustained placement load, head killed -9 mid-load, zero lost
+acked mutations, zero stale-epoch writes accepted, goodput
+reconverges.  ``stress`` implies ``slow`` (conftest), so tier-1 skips
+the soak but the hang guard arms for both.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.experimental import chaos
+from tools.vcluster import VCluster
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def vcluster(tmp_path):
+    made = []
+
+    def factory(n_nodes, **kw):
+        kw.setdefault("storage", str(tmp_path / "head.bin"))
+        kw.setdefault("lease_ttl_s", 1.5)
+        kw.setdefault("hb_interval_s", 0.25)
+        vc = VCluster(n_nodes, **kw)
+        made.append(vc)
+        return vc
+
+    yield factory
+    chaos.reset()
+    for vc in made:
+        vc.stop()
+
+
+def test_vcluster_smoke_kill_head_mid_load(vcluster):
+    """25 virtual nodes, mixed load, head kill -9 + restart mid-load:
+    every acked mutation survives, the fleet reconverges, and no
+    stale-epoch write lands.  Fast enough for tier-1 — the 300-node
+    version below is the stress soak."""
+    vc = vcluster(25)
+    vc.start()
+    assert vc.alive_nodes() == 25
+    vc.load(4.0, threads=4)
+    time.sleep(1.2)
+    vc.kill_head()
+    assert not vc.head_alive()
+    time.sleep(0.3)
+    vc.restart_head()
+    vc.join_load(timeout_s=60.0)
+    vc.wait_converged(timeout_s=30.0)
+    report = vc.verify()
+    assert report["checked"] > 50, "load produced too few mutations"
+    assert report["missing"] == [], \
+        f"lost acked mutations: {report['missing'][:5]}"
+    assert report["stale_epoch_accepted"] == 0
+    stats = vc.stats()
+    assert stats["placement_p99_ms"] is not None
+
+
+def test_vcluster_partition_fences_and_reattaches(vcluster):
+    """chaos.partition_node: the partitioned node misses renewals past
+    its lease, is declared dead (fencing its epoch), then reattaches
+    with a NEW epoch once the partition heals — and a zombie write
+    with the old epoch is rejected typed."""
+    vc = vcluster(8)
+    vc.start()
+    victim = vc.nodes[0]
+    old_epoch = victim.epoch
+    # Partition for 2 lease TTLs: expiry is guaranteed.
+    chaos.partition_node(victim.node_id, duration_s=3.0)
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if vc.alive_nodes() <= 7:
+            break
+        time.sleep(0.3)
+    else:
+        raise AssertionError("partitioned node never declared dead")
+    # Zombie write with the fenced epoch: typed rejection.
+    assert vc.zombie_write_check(victim, old_epoch)
+    # Partition heals: the pump's next beat gets "reregister" and the
+    # node comes back with a strictly newer epoch.
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if vc.alive_nodes() >= 8 and victim.epoch != old_epoch:
+            break
+        time.sleep(0.3)
+    else:
+        raise AssertionError("node never reattached after partition")
+    assert victim.epoch > old_epoch
+    assert victim.reregistrations >= 1
+    assert vc.stale_epoch_accepted == 0
+
+
+def test_vcluster_drop_heartbeats_survivable(vcluster):
+    """chaos.drop_heartbeats(0.5): lossy renewal keeps leases alive
+    (interval ≪ TTL gives several tries per lease) — degraded fabric
+    is survivable, only a full partition fences."""
+    vc = vcluster(8)
+    vc.start()
+    sched = chaos.drop_heartbeats(0.5, duration_s=2.0)
+    time.sleep(2.4)
+    assert sched.fired("rpc_dropfrac") > 0, "no heartbeats dropped"
+    assert vc.alive_nodes() == 8, \
+        "50% heartbeat loss must not expire leases at 6 beats/TTL"
+
+
+@pytest.mark.stress
+def test_vcluster_soak_300_nodes_kill_head(vcluster):
+    """PR-8 acceptance soak: 300 virtual nodes at sustained placement
+    load, kill -9 mid-load → snapshot+journal replay loses zero acked
+    mutations, a node fenced during the outage cannot write with its
+    old epoch, and goodput reconverges to at least half its pre-kill
+    rate."""
+    vc = vcluster(300, n_conns=8)
+    t0 = time.monotonic()
+    vc.start()
+    assert vc.alive_nodes() == 300
+    startup_s = time.monotonic() - t0
+
+    vc.load(14.0, threads=8)
+    time.sleep(4.0)
+    # Partition one node just before the kill so it expires while the
+    # head is down/recovering — the zombie-fencing invariant under the
+    # worst interleaving.
+    victim = vc.nodes[7]
+    old_epoch = victim.epoch
+    chaos.partition_node(victim.node_id, duration_s=6.0)
+    vc.kill_head()
+    time.sleep(1.0)
+    vc.restart_head()
+    vc.join_load(timeout_s=120.0)
+    vc.wait_converged(timeout_s=60.0, target=299)
+
+    # Lease expiry for the victim may land before or after the kill;
+    # either way its old epoch must be fenced by now.
+    deadline = time.monotonic() + 20.0
+    while victim.epoch == old_epoch and time.monotonic() < deadline:
+        time.sleep(0.4)
+    assert vc.zombie_write_check(victim, old_epoch), \
+        "stale-epoch write was accepted"
+
+    report = vc.verify()
+    assert report["checked"] > 200
+    assert report["missing"] == [], \
+        f"lost {len(report['missing'])} acked mutations"
+    assert report["stale_epoch_accepted"] == 0
+
+    # Goodput reconverges: the last full bucket recovers to ≥50% of
+    # the best pre-kill bucket.
+    series = vc.goodput(bucket_s=2.0)
+    assert len(series) >= 4, f"goodput series too short: {series}"
+    pre = max(rate for _t, rate in series[:2])
+    post = max(rate for _t, rate in series[-2:])
+    assert post >= 0.5 * pre, \
+        f"goodput did not reconverge: pre={pre:.0f} post={post:.0f} " \
+        f"series={series}"
+    stats = vc.stats()
+    assert stats["placement_p99_ms"] is not None
+    print(f"\nsoak: startup {startup_s:.1f}s, stats {stats}")

@@ -1,0 +1,2 @@
+"""Repo-level tooling: the virtual-cluster stress harness
+(vcluster.py) and static-analysis baselines."""
