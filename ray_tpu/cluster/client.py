@@ -819,8 +819,14 @@ class ClusterClient:
     def _report_node_failure(self, node_id: str,
                              address: Optional[str] = None):
         try:
-            self.head.call("report_node_failure", {"node_id": node_id},
-                           timeout=5.0)
+            # mut_call, not plain call: report_node_failure retires
+            # durable actor entries on the head (_mut handler), so the
+            # report must carry OUR lease epoch — a fenced zombie node
+            # must not be able to declare its peers dead — and an
+            # idempotency key, so a retried report whose first reply
+            # was lost doesn't double-publish the death.
+            self.mut_call("report_node_failure", {"node_id": node_id},
+                          deadline_s=5.0, timeout=5.0)
         except Exception:  # raylint: disable=ft-exception-swallow -- runs inside task-completion callbacks: ANY escape here would abort the callback before complete_error seals the task's refs (owner hangs); the heartbeat reaper covers a missed report
             pass
         with self._loc_lock:
@@ -1893,6 +1899,7 @@ class ClusterClient:
         try:
             # Raw connection, no re-dial: a farewell to a head that is
             # already gone must fail fast, not burn a connect budget.
+            # raylint: disable=rpc-protocol -- deliberate plain-call farewell: detach must not retry/re-register against a possibly-dead head; a lost drain is re-covered by the lease reaper, and double-draining is a no-op
             self.head._client.call("drain_node",
                                    {"node_id": self.node_id},
                                    timeout=2.0)
@@ -2053,7 +2060,7 @@ class NodeServer:
             "tail_log": self._tail_log,
             "node_state": self._node_state,
             "profile": self._profile,
-            "ping": lambda p: "pong",
+            "ping": lambda p: "pong",  # raylint: disable=rpc-protocol -- liveness probe for out-of-package callers (tests, ops tooling, channel peer probing)
         }, ordered={"actor_call"})
         self.address = self._server.address
         # Raw object-stream side channel: chunk pulls AND inbound push

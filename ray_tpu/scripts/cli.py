@@ -14,7 +14,8 @@ Commands:
   timeline --address H:P -o trace.json          Chrome-trace export
   memory --address H:P                          object-store stats
   job (submit|status|logs|stop|list) ...        job control
-  lint [PATH] [--json] [--update-baseline]      raylint static analysis
+  lint [PATH] [--format json|sarif] [--changed] [--lock-graph dot|json]
+       [--update-baseline]                      raylint static analysis
 """
 
 from __future__ import annotations

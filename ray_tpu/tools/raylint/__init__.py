@@ -18,6 +18,18 @@ distributed-runtime invariants the test suite can only sample:
 - ``log-hygiene``           lazy %-args on hot-path logger calls; no
                             bare print() in runtime modules
 - ``suppression-syntax``    disables must name real rules + a reason
+- ``journaled-mutation``    durable-table handlers ride the journal/
+                            _mut wrapper
+- ``lock-order-inversion``  ABBA cycles in the global lock-order
+                            graph (interprocedural lock-set model)
+- ``wait-holding-foreign-lock``  Condition.wait with a different
+                            lock held (locally or via callers)
+- ``rpc-protocol``          string-keyed RPC plane closed: no
+                            unregistered/dead handlers, mutations
+                            ride the fenced path, dispatch loops
+                            re-install the envelope
+- ``exception-contract``    typed FT errors caught typed where a
+                            typed handler exists for the callee
 
 Suppress a finding in place::
 

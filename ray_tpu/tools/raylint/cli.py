@@ -1,21 +1,30 @@
 """``ray_tpu lint`` — the raylint command-line front end.
 
 Exit codes: 0 = clean (no non-baselined findings), 1 = findings,
-2 = usage error.  ``--json`` emits a machine-readable report for CI
-gating; ``--update-baseline`` grandfathers the current findings.
+2 = usage error.  ``--format json`` (alias ``--json``) emits a
+machine-readable report for CI gating; ``--format sarif`` emits SARIF
+2.1.0 for code-scanning upload (inline PR annotations);
+``--update-baseline`` grandfathers the current findings;
+``--changed`` scopes REPORTING to git-changed files (the analysis
+stays whole-program — interprocedural rules need every file);
+``--lock-graph dot|json`` dumps the global lock-order graph.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from . import (RULE_DOCS, RULES, default_baseline_path,
                default_package_root, run_lint)
 from . import baseline as baseline_mod
+
+_SARIF_URI_BASE = "SRCROOT"
 
 
 def add_lint_parser(sub) -> None:
@@ -36,8 +45,22 @@ def add_lint_parser(sub) -> None:
     p.add_argument("--update-baseline", action="store_true",
                    help="write the current findings as the new "
                         "baseline and exit 0")
+    p.add_argument("--format", default=None, dest="format",
+                   choices=("text", "json", "sarif"),
+                   help="report format (default text)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-readable JSON report on stdout")
+                   help="alias for --format json")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="report only findings in files changed vs "
+                        "REF (default HEAD) per git, plus untracked "
+                        "files; the analysis itself stays "
+                        "whole-program")
+    p.add_argument("--lock-graph", default=None, dest="lock_graph",
+                   choices=("dot", "json"),
+                   help="dump the global lock-acquisition-order "
+                        "graph (nodes, edges with witness sites, "
+                        "cycles) and exit")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print grandfathered findings")
     p.add_argument("--list-rules", action="store_true",
@@ -45,11 +68,113 @@ def add_lint_parser(sub) -> None:
     p.set_defaults(fn=cmd_lint)
 
 
+def _changed_files(root: str, ref: str) -> Optional[Set[str]]:
+    """Project-root-relative paths changed vs ``ref`` (tracked diff +
+    untracked), or None when git is unusable (caller errors out)."""
+    project_dir = os.path.dirname(os.path.abspath(root)) or "."
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=project_dir, capture_output=True, text=True,
+            timeout=30)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=project_dir, capture_output=True, text=True,
+            timeout=30)
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=project_dir, capture_output=True, text=True,
+            timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out: Set[str] = set()
+    # `git diff --name-only` prints repo-TOPLEVEL-relative paths;
+    # findings are project-root relative — rebase when the two
+    # differ (monorepo: the package parent need not be the toplevel).
+    repo_root = top.stdout.strip() if top.returncode == 0 else ""
+    for name in diff.stdout.splitlines():
+        if not name:
+            continue
+        path = os.path.join(repo_root, name) if repo_root else name
+        out.add(os.path.relpath(os.path.abspath(path), project_dir))
+    # `git ls-files --others` prints CWD-relative paths, and we ran
+    # it with cwd=project_dir: they are already in finding shape.
+    for name in untracked.stdout.splitlines():
+        if name:
+            out.add(os.path.normpath(name))
+    return out
+
+
+def _severity(rule: str) -> str:
+    """SARIF level: the deadlock/durability classes are errors, the
+    hygiene classes warnings."""
+    return "error" if rule in (
+        "lock-order-inversion", "blocking-under-lock",
+        "journaled-mutation", "wait-holding-foreign-lock") \
+        else "warning"
+
+
+def to_sarif(findings, root: str) -> dict:
+    """SARIF 2.1.0 (the subset GitHub code scanning renders as inline
+    annotations).  ``partialFingerprints`` reuses the baseline
+    fingerprint so alert identity survives line shifts, mirroring the
+    baseline semantics."""
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": _severity(f.rule),
+            "message": {"text": f"{f.symbol}: {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/"),
+                        "uriBaseId": _SARIF_URI_BASE,
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {
+                "raylint/v1": f.fingerprint,
+            },
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                    ".json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "raylint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": [{
+                    "id": name,
+                    "shortDescription": {"text": name},
+                    "fullDescription": {
+                        "text": RULE_DOCS.get(name, "")},
+                    "defaultConfiguration": {
+                        "level": _severity(name)},
+                } for name in sorted(RULES)],
+            }},
+            "originalUriBaseIds": {
+                _SARIF_URI_BASE: {
+                    "uri": ("file://"
+                            + os.path.dirname(os.path.abspath(root))
+                            + "/")}},
+            "results": results,
+        }],
+    }
+
+
 def cmd_lint(args) -> int:
     if args.list_rules:
         for name in RULES:
             print(f"{name}\n    {RULE_DOCS.get(name, '')}")
         return 0
+    fmt = args.format or ("json" if args.as_json else "text")
     if args.update_baseline and args.select:
         # A partial-rule run must never rewrite the whole baseline:
         # it would silently drop every unselected rule's grandfathered
@@ -58,9 +183,34 @@ def cmd_lint(args) -> int:
               "--select (a partial run would drop the other rules' "
               "baseline entries)", file=sys.stderr)
         return 2
+    if args.update_baseline and args.changed is not None:
+        print("raylint: --update-baseline cannot be combined with "
+              "--changed (a file-scoped run would drop every other "
+              "file's baseline entries)", file=sys.stderr)
+        return 2
     root = args.path or default_package_root()
     baseline_path = args.baseline or default_baseline_path(root)
     select = [s.strip() for s in args.select.split(",") if s.strip()]
+
+    if args.lock_graph:
+        from .model import ProjectModel
+
+        la = ProjectModel(root).lock_analysis()
+        if args.lock_graph == "dot":
+            sys.stdout.write(la.to_dot())
+        else:
+            json.dump(la.to_json(), sys.stdout, indent=1)
+            sys.stdout.write("\n")
+        return 0
+
+    scope: Optional[Set[str]] = None
+    if args.changed is not None:
+        scope = _changed_files(root, args.changed)
+        if scope is None:
+            print(f"raylint: --changed {args.changed}: git diff "
+                  f"failed (not a repo, or bad ref)", file=sys.stderr)
+            return 2
+
     t0 = time.monotonic()
     try:
         findings = run_lint(root, select=select or None,
@@ -77,9 +227,12 @@ def cmd_lint(args) -> int:
         print(f"raylint: baselined {n} finding(s) -> {baseline_path}")
         return 0
 
+    if scope is not None:
+        findings = [f for f in findings if f.path in scope]
+
     fresh = [f for f in findings if not f.baselined]
     old = [f for f in findings if f.baselined]
-    if args.as_json:
+    if fmt == "json":
         json.dump({
             "root": root,
             "elapsed_s": round(elapsed, 3),
@@ -88,14 +241,19 @@ def cmd_lint(args) -> int:
         }, sys.stdout, indent=1)
         sys.stdout.write("\n")
         return 1 if fresh else 0
+    if fmt == "sarif":
+        json.dump(to_sarif(fresh, root), sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 1 if fresh else 0
 
     for f in fresh:
         print(f.render())
     if args.show_baselined:
         for f in old:
             print(f"{f.render()}  [baselined]")
+    scoped = "" if scope is None else f" ({len(scope)} changed files)"
     status = (f"raylint: {len(fresh)} finding(s)"
-              f" ({len(old)} baselined) over {root}"
+              f" ({len(old)} baselined) over {root}{scoped}"
               f" in {elapsed:.2f}s")
     print(status, file=sys.stderr if fresh else sys.stdout)
     return 1 if fresh else 0

@@ -22,6 +22,7 @@ this framework actually depends on.
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import re
 from dataclasses import dataclass, field
@@ -70,6 +71,25 @@ class ClassInfo:
     methods: Dict[str, str] = field(default_factory=dict)  # name->func qn
     lock_attrs: Set[str] = field(default_factory=set)
     cond_attrs: Set[str] = field(default_factory=set)
+    # cond attr -> the lock attr it WRAPS ("self._cond =
+    # threading.Condition(self._lock)"): the condition IS that lock
+    # for ordering purposes — acquiring one while holding the other
+    # is reentrant, not an inversion.
+    cond_alias: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class CallEdge:
+    """One call-graph edge with its resolution confidence.  ``kind``:
+    "self" (self.method), "local" (sibling/nested def), "module"
+    (module-local function or alias.func into a project module),
+    "import" (imported project symbol), "init" (class -> __init__),
+    "fallback" (unique-method-name guess — class-blind, the edge the
+    lock-set propagation must NOT trust)."""
+    target: str
+    line: int
+    via: str
+    kind: str
 
 
 @dataclass
@@ -95,15 +115,39 @@ class ProjectModel:
         # bare function/method name -> qualnames defining it
         self.by_name: Dict[str, List[str]] = {}
         # call graph: func qualname -> [(callee qualname, line, via)]
+        # (legacy 3-tuple view; call_edges carries the resolution kind)
         self.calls: Dict[str, List[Tuple[str, int, str]]] = {}
+        self.call_edges: Dict[str, List[CallEdge]] = {}
         self.parse_errors: List[Tuple[str, str]] = []
         self._own_cache: Dict[int, List[ast.AST]] = {}
+        # (call-node id, enclosing fn qualname) -> resolved
+        # (target, kind) | None.  Resolution (inheritance walks,
+        # import chasing) is re-requested for the same Call node by
+        # the call-graph build, the lock-set scan, the raise
+        # inference, and the try indexing — memoize it.  Node ids
+        # stay valid for the model's lifetime (ModuleInfo pins every
+        # tree); the qualname qualifier matters because the parse
+        # memo SHARES one AST between byte-identical files, so the
+        # same node resolves under different modules' import/class
+        # contexts.
+        self._edge_cache: Dict[Tuple[int, str],
+                               Optional[Tuple[str, str]]] = {}
+        self._locks: Optional[LockAnalysis] = None
         self._load()
         self._index()
         self._build_call_graph()
 
+    def lock_analysis(self) -> "LockAnalysis":
+        """The interprocedural lock-set model, built once on demand
+        (the lock-order and wait rules share it, and the CLI dumps
+        its graph)."""
+        if self._locks is None:
+            self._locks = LockAnalysis(self)
+        return self._locks
+
     # ------------------------------------------------------------ loading
     def _load(self) -> None:
+        cache = _ParseCache.open(self.project_dir)
         for dirpath, dirnames, filenames in os.walk(self.root):
             dirnames[:] = sorted(d for d in dirnames
                                  if d not in ("__pycache__",))
@@ -114,9 +158,13 @@ class ProjectModel:
                 rel = os.path.relpath(path, self.project_dir)
                 modname = self._modname(path)
                 try:
-                    with open(path, "r", encoding="utf-8") as f:
-                        src = f.read()
-                    tree = ast.parse(src, filename=path)
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                    src = raw.decode("utf-8")
+                    tree = cache.get(raw)
+                    if tree is None:
+                        tree = ast.parse(src, filename=path)
+                        cache.put(raw, tree)
                 except (SyntaxError, UnicodeDecodeError, OSError) as e:
                     self.parse_errors.append((rel, str(e)))
                     continue
@@ -126,6 +174,7 @@ class ProjectModel:
                 self._scan_suppressions(info)
                 self._scan_imports(info)
                 self.modules[modname] = info
+        cache.save()
 
     def _modname(self, path: str) -> str:
         rel = os.path.relpath(path, os.path.dirname(self.root))
@@ -242,6 +291,12 @@ class ProjectModel:
                     elif self._is_factory(info, sub.value,
                                           _COND_FACTORIES):
                         ci.cond_attrs.add(t.attr)
+                        arg = (sub.value.args[0]
+                               if sub.value.args else None)
+                        if isinstance(arg, ast.Attribute) and \
+                                isinstance(arg.value, ast.Name) and \
+                                arg.value.id == "self":
+                            ci.cond_alias[t.attr] = arg.attr
 
     def _index_func(self, info: ModuleInfo, node, cls: Optional[str],
                     prefix: str = "") -> FuncInfo:
@@ -296,16 +351,19 @@ class ProjectModel:
     # --------------------------------------------------------- call graph
     def _build_call_graph(self) -> None:
         for fi in list(self.functions.values()):
-            edges: List[Tuple[str, int, str]] = []
+            edges: List[CallEdge] = []
             info = self.modules[fi.module]
             for node in self.walk_own(fi.node):
                 if not isinstance(node, ast.Call):
                     continue
-                target = self._resolve_call(info, fi, node)
-                if target is not None:
-                    edges.append((target, node.lineno,
-                                  call_desc(node)))
-            self.calls[fi.qualname] = edges
+                hit = self._resolve_call_edge(info, fi, node)
+                if hit is not None:
+                    target, kind = hit
+                    edges.append(CallEdge(target, node.lineno,
+                                          call_desc(node), kind))
+            self.call_edges[fi.qualname] = edges
+            self.calls[fi.qualname] = [(e.target, e.line, e.via)
+                                       for e in edges]
 
     def walk_own(self, func_node):
         """All nodes of a function body WITHOUT descending into nested
@@ -329,16 +387,33 @@ class ProjectModel:
 
     def _resolve_call(self, info: ModuleInfo, fi: FuncInfo,
                       call: ast.Call) -> Optional[str]:
+        hit = self._resolve_call_edge(info, fi, call)
+        return hit[0] if hit is not None else None
+
+    def _resolve_call_edge(self, info: ModuleInfo, fi: FuncInfo,
+                           call: ast.Call
+                           ) -> Optional[Tuple[str, str]]:
+        """(callee qualname, edge kind) — see CallEdge for kinds."""
+        key = (id(call), fi.qualname)
+        if key in self._edge_cache:
+            return self._edge_cache[key]
+        out = self._resolve_call_edge_uncached(info, fi, call)
+        self._edge_cache[key] = out
+        return out
+
+    def _resolve_call_edge_uncached(self, info: ModuleInfo,
+                                    fi: FuncInfo, call: ast.Call
+                                    ) -> Optional[Tuple[str, str]]:
         f = call.func
         if isinstance(f, ast.Name):
-            return self._resolve_name(info, fi, f.id)
+            return self._resolve_name_kind(info, fi, f.id)
         if isinstance(f, ast.Attribute):
             # self.method(...)
             if isinstance(f.value, ast.Name) and f.value.id == "self" \
                     and fi.cls is not None:
                 qn = self._method_on(info.name, fi.cls, f.attr)
                 if qn is not None:
-                    return qn
+                    return qn, "self"
             # module_alias.func(...)
             if isinstance(f.value, ast.Name):
                 target = info.imports.get(f.value.id)
@@ -346,12 +421,12 @@ class ProjectModel:
                     mod = self.modules[target]
                     qn = f"{mod.name}:{f.attr}"
                     if qn in self.functions:
-                        return qn
+                        return qn, "module"
             # unique-method fallback: exactly one project definition of
             # this name -> conservative (class-blind) edge
             cands = self.by_name.get(f.attr, ())
             if len(cands) == 1:
-                return cands[0]
+                return cands[0], "fallback"
         return None
 
     def _method_on(self, module: str, cls: str,
@@ -380,27 +455,33 @@ class ProjectModel:
 
     def _resolve_name(self, info: ModuleInfo, fi: FuncInfo,
                       name: str) -> Optional[str]:
+        hit = self._resolve_name_kind(info, fi, name)
+        return hit[0] if hit is not None else None
+
+    def _resolve_name_kind(self, info: ModuleInfo, fi: FuncInfo,
+                           name: str) -> Optional[Tuple[str, str]]:
         # sibling nested function first (shares the enclosing prefix)
         prefix = fi.qualname.rsplit(".", 1)[0]
-        for cand in (f"{prefix}.{name}", f"{fi.qualname}.{name}",
-                     f"{info.name}:{name}"):
+        for cand, kind in ((f"{prefix}.{name}", "local"),
+                           (f"{fi.qualname}.{name}", "local"),
+                           (f"{info.name}:{name}", "module")):
             if cand in self.functions:
-                return cand
+                return cand, kind
         imported = info.imports.get(name)
         if imported:
             # imported function...
             mod, _, sym = imported.rpartition(".")
             qn = f"{mod}:{sym}"
             if qn in self.functions:
-                return qn
+                return qn, "import"
             # ...or imported project class -> its __init__
             ci = self.classes.get(qn)
             if ci and "__init__" in ci.methods:
-                return ci.methods["__init__"]
+                return ci.methods["__init__"], "init"
         # class defined in this module -> __init__
         ci = self.classes.get(f"{info.name}:{name}")
         if ci and "__init__" in ci.methods:
-            return ci.methods["__init__"]
+            return ci.methods["__init__"], "init"
         return None
 
     # --------------------------------------------------------- utilities
@@ -444,3 +525,505 @@ def call_desc(call: ast.Call) -> str:
         return ast.unparse(call.func)
     except Exception:
         return "<call>"
+
+
+# --------------------------------------------------------------------------
+# parse cache: content-hash-keyed ASTs
+# --------------------------------------------------------------------------
+
+class _ParseCache:
+    """Content-hash-keyed AST memo, PROCESS-LOCAL by design.
+
+    ``ast.parse`` dominates a cold model build, and the tier-1 lint
+    gate builds the model repeatedly in one process (fixture corpora,
+    the whole-package self-lint, the model unit tests): an unchanged
+    file re-parses identically every time, so trees are memoized by
+    ``sha1(file bytes)`` — an edit anywhere in a file misses only that
+    file.  Sharing tree objects across ProjectModel instances is safe:
+    nothing mutates them, and the per-model node caches key by id().
+
+    Deliberately NOT persisted to disk: pickling ASTs was measured
+    SLOWER to load than re-parsing (~1.6 s pickle.loads vs ~1.1 s
+    ast.parse for the whole package on CPython 3.10 — generic
+    attribute-by-attribute object reconstruction loses to the C
+    parser), so a cross-process cache would be a pessimization
+    wearing a cache's name.  ``RAY_TPU_RAYLINT_CACHE=0`` disables the
+    memo (debugging, memory-constrained runs)."""
+
+    _memo: Dict[str, ast.Module] = {}
+    _MAX_ENTRIES = 4096  # ~40 MiB worst case; clear-all on overflow
+
+    def __init__(self, enabled: bool):
+        self._enabled = enabled
+
+    @classmethod
+    def open(cls, root: str) -> "_ParseCache":
+        return cls(os.environ.get("RAY_TPU_RAYLINT_CACHE", "") != "0")
+
+    @staticmethod
+    def _key(raw: bytes) -> str:
+        return hashlib.sha1(raw).hexdigest()
+
+    def get(self, raw: bytes) -> Optional[ast.Module]:
+        if not self._enabled:
+            return None
+        return self._memo.get(self._key(raw))
+
+    def put(self, raw: bytes, tree: ast.Module) -> None:
+        if not self._enabled:
+            return
+        if len(self._memo) >= self._MAX_ENTRIES:
+            self._memo.clear()
+        self._memo[self._key(raw)] = tree
+
+    def save(self) -> None:
+        pass  # process-local: nothing to flush
+
+
+# --------------------------------------------------------------------------
+# interprocedural lock-set analysis
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LockToken:
+    """Canonical lock identity.  ``key`` merges aliases (a
+    ``Condition(self._lock)`` IS its lock for ordering); ``is_cond``
+    remembers the syntactic shape for the wait rules; ``global_`` is
+    False for bare-name locals/params whose identity can't be
+    canonicalized across functions (they stay out of the global
+    graph)."""
+    key: str
+    is_cond: bool
+    global_: bool
+
+    def short(self) -> str:
+        mod, _, rest = self.key.partition(":")
+        return f"{mod.rsplit('.', 1)[-1]}.{rest}"
+
+
+@dataclass
+class LockAcquire:
+    token: LockToken
+    line: int
+    held: Tuple[LockToken, ...]    # locks already held at this site
+
+
+@dataclass
+class LockWait:
+    token: LockToken               # the lock/condition being waited on
+    line: int
+    held: Tuple[LockToken, ...]
+    timeouted: bool
+    desc: str
+
+
+@dataclass
+class FuncLockFacts:
+    acquires: List[LockAcquire] = field(default_factory=list)
+    # (callee qualname, line, edge kind, held tokens at the call)
+    calls: List[Tuple[str, int, str, Tuple[LockToken, ...]]] = \
+        field(default_factory=list)
+    waits: List[LockWait] = field(default_factory=list)
+
+
+class LockAnalysis:
+    """For every function: which locks may be HELD when it runs —
+    locally (enclosing ``with`` regions) and interprocedurally (the
+    union over callers, propagated to a fixpoint over the call graph's
+    confident edges; the class-blind unique-name fallback edges are
+    excluded so one guessed edge can't smear a lock set across the
+    package).  From the per-function facts it assembles the global
+    lock-acquisition-order graph: an edge A -> B for every site that
+    acquires B while A may be held, each edge carrying witnesses
+    (function, file, line, whether A came in through the entry set).
+    Cycles in that graph are the ABBA deadlock candidates
+    ``lock-order-inversion`` reports."""
+
+    _PROPAGATE_KINDS = ("self", "local", "module", "import", "init")
+    _MAX_WITNESSES = 3
+
+    def __init__(self, model: ProjectModel):
+        self.model = model
+        self.facts: Dict[str, FuncLockFacts] = {}
+        # fn qualname -> tokens possibly held on entry (strings = keys)
+        self.entry: Dict[str, Set[str]] = {}
+        # (fn, token key) -> (caller, line, caller_held_locally)
+        self.entry_why: Dict[Tuple[str, str],
+                             Tuple[str, int, bool]] = {}
+        # (held key, acquired key) -> [(fn, relpath, line, via_entry)]
+        self.edges: Dict[Tuple[str, str],
+                         List[Tuple[str, str, int, bool]]] = {}
+        self._token_cache: Dict[Tuple[str, str, str],
+                                Optional[LockToken]] = {}
+        for qn in sorted(model.functions):
+            fi = model.functions[qn]
+            info = model.modules[fi.module]
+            self.facts[qn] = self._scan_func(info, fi)
+        self._propagate()
+        self._build_graph()
+
+    # ------------------------------------------------- token resolution
+    def _class_lock_owner(self, module: str, cls: str,
+                          attr: str) -> Optional[Tuple[str, str, bool]]:
+        """(owner class qualname, canonical attr, is_cond) for a
+        ``self.<attr>`` lock/condition, following project-local bases
+        and the Condition->lock alias chain."""
+        seen: Set[str] = set()
+        stack = [f"{module}:{cls}"]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            ci = self.model.classes.get(key)
+            if ci is None:
+                continue
+            if attr in ci.cond_attrs:
+                canon = attr
+                hops = 0
+                while canon in ci.cond_alias and hops < 4:
+                    canon = ci.cond_alias[canon]
+                    hops += 1
+                return ci.qualname, canon, True
+            if attr in ci.lock_attrs:
+                return ci.qualname, attr, False
+            for base in ci.bases:
+                if f"{ci.module}:{base}" in self.model.classes:
+                    stack.append(f"{ci.module}:{base}")
+                else:
+                    stack.extend(k for k in self.model.classes
+                                 if k.endswith(f":{base}"))
+        return None
+
+    def token_for(self, info: ModuleInfo, fi: FuncInfo,
+                  expr: ast.AST) -> Optional[LockToken]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and fi.cls is not None:
+            ck = (fi.module, fi.cls, expr.attr)
+            if ck in self._token_cache:
+                return self._token_cache[ck]
+            owner = self._class_lock_owner(fi.module, fi.cls, expr.attr)
+            if owner is not None:
+                cls_qn, canon, is_cond = owner
+                tok = LockToken(f"{cls_qn}.{canon}", is_cond, True)
+            else:
+                hit = _lock_by_name(expr.attr)
+                tok = None
+                if hit is not None:
+                    # Heuristic self-attr: same class + attr is the
+                    # same lock in practice, so it joins the graph.
+                    tok = LockToken(f"{fi.module}:{fi.cls}.{expr.attr}",
+                                    hit[1], True)
+            self._token_cache[ck] = tok
+            return tok
+        if isinstance(expr, ast.Name):
+            if expr.id in info.locks:
+                return LockToken(f"{info.name}:{expr.id}", False, True)
+            if expr.id in info.conds:
+                return LockToken(f"{info.name}:{expr.id}", True, True)
+            hit = _lock_by_name(expr.id)
+            if hit is not None:
+                # A local/parameter lock: real for THIS function's
+                # waits, meaningless as a global identity.
+                return LockToken(f"{fi.qualname}:{expr.id}",
+                                 hit[1], False)
+        return None
+
+    # ----------------------------------------------------- local facts
+    def _scan_func(self, info: ModuleInfo,
+                   fi: FuncInfo) -> FuncLockFacts:
+        # Fast path: no with-statements and no .wait() calls means no
+        # acquisitions, no waits, and an empty held-set at every call
+        # — take the calls straight from the prebuilt graph instead
+        # of re-walking the body (the vast majority of functions).
+        interesting = False
+        for node in self.model.walk_own(fi.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)) or (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"):
+                interesting = True
+                break
+        if not interesting:
+            return FuncLockFacts(calls=[
+                (e.target, e.line, e.kind, ())
+                for e in self.model.call_edges.get(fi.qualname, ())])
+        facts = FuncLockFacts()
+        self._scan_stmts(info, fi, fi.node.body, (), facts)
+        return facts
+
+    def _scan_stmts(self, info, fi, stmts, held, facts) -> None:
+        for st in stmts:
+            self._scan_node(info, fi, st, held, facts)
+
+    def _scan_node(self, info, fi, node, held, facts) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                # the context expression evaluates BEFORE acquisition
+                self._scan_node(info, fi, item.context_expr,
+                                tuple(inner), facts)
+                tok = self.token_for(info, fi, item.context_expr)
+                if tok is not None:
+                    facts.acquires.append(LockAcquire(
+                        tok, node.lineno, tuple(inner)))
+                    if tok.key not in {t.key for t in inner}:
+                        inner.append(tok)
+            self._scan_stmts(info, fi, node.body, tuple(inner), facts)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(info, fi, node, held, facts)
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(info, fi, child, held, facts)
+
+    def _record_call(self, info, fi, call: ast.Call, held,
+                     facts) -> None:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "wait":
+            tok = self.token_for(info, fi, f.value)
+            if tok is not None:
+                timeouted = bool(call.args) or any(
+                    kw.arg in ("timeout", "timeout_s")
+                    for kw in call.keywords)
+                facts.waits.append(LockWait(
+                    tok, call.lineno, tuple(held), timeouted,
+                    call_desc(call)))
+        hit = self.model._resolve_call_edge(info, fi, call)
+        if hit is not None:
+            target, kind = hit
+            facts.calls.append((target, call.lineno, kind,
+                                tuple(t for t in held if t.global_)))
+
+    # ----------------------------------------------------- propagation
+    def _propagate(self) -> None:
+        """Fixpoint: entry(callee) ⊇ entry(caller) ∪ held-at-call for
+        every confident edge.  Deterministic: functions and tokens are
+        visited sorted, and the first witness for a (fn, token) entry
+        is kept — chains render identically across runs and
+        interpreters."""
+        entry = self.entry
+        for qn in self.facts:
+            entry.setdefault(qn, set())
+        changed = True
+        while changed:
+            changed = False
+            for qn in sorted(self.facts):
+                base = entry[qn]
+                for target, line, kind, held in self.facts[qn].calls:
+                    if kind not in self._PROPAGATE_KINDS:
+                        continue
+                    if target == qn or target not in entry:
+                        continue
+                    held_keys = {t.key for t in held}
+                    contrib = base | held_keys
+                    fresh = contrib - entry[target]
+                    if not fresh:
+                        continue
+                    entry[target] |= fresh
+                    for tkey in sorted(fresh):
+                        self.entry_why.setdefault(
+                            (target, tkey),
+                            (qn, line, tkey in held_keys))
+                    changed = True
+
+    def chain(self, qn: str, token_key: str) -> List[str]:
+        """Printable caller hops explaining how ``qn`` may run with
+        ``token_key`` held: root (the function that actually acquires
+        it) first.  Line-number-free so finding messages stay
+        baseline-stable."""
+        hops = [qn]
+        seen = {qn}
+        cur = qn
+        while True:
+            why = self.entry_why.get((cur, token_key))
+            if why is None:
+                break
+            caller, _line, local = why
+            if caller in seen:
+                break
+            hops.append(caller)
+            seen.add(caller)
+            cur = caller
+            if local:
+                break
+        return [_short_fn(h) for h in reversed(hops)]
+
+    # ----------------------------------------------------------- graph
+    def _build_graph(self) -> None:
+        for qn in sorted(self.facts):
+            entry_keys = sorted(self.entry.get(qn, ()))
+            fi = self.model.functions[qn]
+            rel = self.model.modules[fi.module].relpath
+            for acq in self.facts[qn].acquires:
+                if not acq.token.global_:
+                    continue
+                local_keys = {t.key for t in acq.held if t.global_}
+                for lkey in sorted(set(entry_keys) | local_keys):
+                    if lkey == acq.token.key:
+                        continue
+                    wl = self.edges.setdefault(
+                        (lkey, acq.token.key), [])
+                    if len(wl) < self._MAX_WITNESSES:
+                        wl.append((qn, rel, acq.line,
+                                   lkey not in local_keys))
+
+    def cycles(self, max_cycles: int = 64) -> List[List[str]]:
+        """Simple cycles in the lock-order graph, each a token list
+        ``[t0, .., tk]`` meaning t0->t1->..->tk->t0.  Deterministic:
+        SCCs found over sorted adjacency, one shortest cycle per
+        in-SCC edge, deduped by node set.  Self-loops (reentrant
+        RLock) are not cycles."""
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            if a == b:
+                continue
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for k in adj:
+            adj[k] = sorted(set(adj[k]))
+        out: List[List[str]] = []
+        seen_sets: Set[frozenset] = set()
+        for scc in _tarjan_sccs(adj):
+            if len(scc) < 2:
+                continue
+            nodes = set(scc)
+            for a in sorted(nodes):
+                for b in adj[a]:
+                    if b not in nodes:
+                        continue
+                    back = _shortest_path(adj, b, a, nodes)
+                    if back is None:
+                        continue
+                    cyc = [a] + back[:-1]
+                    key = frozenset(cyc)
+                    if key in seen_sets:
+                        continue
+                    seen_sets.add(key)
+                    out.append(cyc)
+                    if len(out) >= max_cycles:
+                        return out
+        return out
+
+    # ------------------------------------------------------------ dumps
+    def to_json(self) -> Dict:
+        """The global lock-order graph, offline-inspection shape
+        (``ray_tpu lint --lock-graph json``)."""
+        nodes = sorted({k for e in self.edges for k in e})
+        return {
+            "nodes": nodes,
+            "edges": [{
+                "from": a, "to": b,
+                "witnesses": [{"function": fn, "path": rel,
+                               "line": line, "via_entry": ve}
+                              for fn, rel, line, ve in wits],
+            } for (a, b), wits in sorted(self.edges.items())],
+            "cycles": self.cycles(),
+        }
+
+    def to_dot(self) -> str:
+        cyc_nodes = {t for cyc in self.cycles() for t in cyc}
+        lines = ["digraph lock_order {",
+                 '  rankdir=LR; node [shape=box, fontsize=10];']
+        for tok in sorted({k for e in self.edges for k in e}):
+            style = ', color=red, penwidth=2' if tok in cyc_nodes \
+                else ''
+            lines.append(f'  "{tok}" [label="{_short_key(tok)}"'
+                         f'{style}];')
+        for (a, b), wits in sorted(self.edges.items()):
+            fn, rel, line, _ve = wits[0]
+            lines.append(f'  "{a}" -> "{b}" '
+                         f'[label="{_short_fn(fn)}:{line}", '
+                         f'fontsize=8];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+def _short_fn(qualname: str) -> str:
+    """'pkg.mod:Cls.meth' -> 'mod:Cls.meth' (message-stable)."""
+    mod, _, rest = qualname.partition(":")
+    return f"{mod.rsplit('.', 1)[-1]}:{rest}"
+
+
+def _short_key(token_key: str) -> str:
+    mod, _, rest = token_key.partition(":")
+    return f"{mod.rsplit('.', 1)[-1]}.{rest}"
+
+
+def _tarjan_sccs(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan over sorted nodes/neighbors (deterministic,
+    recursion-free — lock graphs are small but cycles in them are
+    exactly when a recursive walk would go deep)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(adj[nxt])))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(scc))
+    return sccs
+
+
+def _shortest_path(adj: Dict[str, List[str]], src: str, dst: str,
+                   allowed: Set[str]) -> Optional[List[str]]:
+    """BFS path src..dst (inclusive) within ``allowed``; sorted
+    neighbor order keeps the chosen path deterministic."""
+    if src == dst:
+        return [src]
+    prev: Dict[str, str] = {src: src}
+    frontier = [src]
+    while frontier:
+        nxt_frontier = []
+        for node in frontier:
+            for nxt in adj.get(node, ()):
+                if nxt not in allowed or nxt in prev:
+                    continue
+                prev[nxt] = node
+                if nxt == dst:
+                    path = [dst]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    return list(reversed(path))
+                nxt_frontier.append(nxt)
+        frontier = nxt_frontier
+    return None

@@ -1,0 +1,456 @@
+"""RPC-protocol and exception-contract indexes.
+
+The control plane is STRING-KEYED: ``RpcServer({"name": fn, ...})``
+tables on the servers, ``client.call("name", ...)`` (and the
+``call_retry`` / ``call_idempotent`` / ``mut_call`` wrappers) on the
+callers.  Nothing ties the two ends together at runtime until a call
+fails with ``no rpc method`` — and nothing at all notices a handler
+nobody calls, or a mutating handler invoked through the plain
+non-idempotent path.  This module builds the whole-program index both
+ends share:
+
+- every registered handler (name, wrapper, resolved target function,
+  registration site), across every server table in the package;
+- every string-literal call site (name, calling wrapper, site);
+- per-function TYPED-FT-RAISE sets: which of the typed fault-tolerance
+  errors (``StaleEpochError``, ``DeadlineExceededError``,
+  ``ChannelError``, ``ActorDiedError``, ``BackPressureError``) a
+  function can raise — directly, through confident call-graph edges,
+  and THROUGH the RPC boundary (a ``.call("m")`` site can raise
+  whatever the handler for ``m`` raises, since server errors re-raise
+  at ``result()``); calls inside a ``try`` that catches a type do not
+  propagate it.
+
+The ``rpc-protocol`` and ``exception-contract`` rules in rules.py are
+thin reporters over this index.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .model import FuncInfo, ModuleInfo, ProjectModel
+
+# Client-side attribute methods that take the rpc method name as their
+# first positional argument.
+CALL_ATTRS = {"call", "call_async", "call_with_retry", "call_retry",
+              "call_idempotent", "mut_call"}
+# Wrappers that give a call idempotency (and, for mut_call, epoch
+# fencing): safe paths for a mutating handler.
+MUTATION_SAFE_KINDS = {"call_idempotent", "mut_call"}
+# Registration-side wrappers that mark a handler MUTATING (journaled /
+# idempotency-deduped): calls to it must ride a MUTATION_SAFE kind.
+MUTATING_WRAPPERS = {"_mut", "idempotent_handler"}
+# Value-transport wrappers that do not change call semantics.
+TRANSPARENT_WRAPPERS = {"_sealed"}
+
+# The typed FT errors of exceptions.py, with every PARENT class a
+# catch clause could use instead (catching the parent loses the typed
+# dispatch the recovery paths key on).
+FT_TYPED_ERRORS: Dict[str, FrozenSet[str]] = {
+    "ActorDiedError": frozenset({"ActorError", "RayTpuError",
+                                 "Exception", "BaseException"}),
+    "BackPressureError": frozenset({"RayTpuError", "Exception",
+                                    "BaseException"}),
+    "ChannelError": frozenset({"RayTpuError", "Exception",
+                               "BaseException"}),
+    "DeadlineExceededError": frozenset({"RayTpuError", "TimeoutError",
+                                        "Exception", "BaseException"}),
+    "StaleEpochError": frozenset({"RayTpuError", "Exception",
+                                  "BaseException"}),
+}
+
+_RAISE_DEPTH_KINDS = ("self", "local", "module", "import", "init")
+
+
+@dataclass
+class HandlerReg:
+    name: str
+    wrapper: str                  # "" | "_mut" | "idempotent_handler" | ...
+    target: Optional[str]         # resolved handler function qualname
+    module: str
+    line: int
+    symbol: str                   # enclosing function qualname
+
+    @property
+    def mutating(self) -> bool:
+        return self.wrapper in MUTATING_WRAPPERS
+
+
+@dataclass
+class CallSite:
+    name: str
+    kind: str                     # one of CALL_ATTRS or "retry_call"
+    module: str
+    line: int
+    symbol: str
+
+
+@dataclass
+class TrySite:
+    """One try-statement wrapping RPC/FT-capable calls: which callees
+    its body reaches and what its except clauses catch."""
+    module: str
+    line: int                     # the try's line
+    symbol: str
+    callees: List[Tuple[str, int]] = field(default_factory=list)
+    # per handler: (line, caught names, body is a bare re-raise)
+    handlers: List[Tuple[int, FrozenSet[str], bool]] = \
+        field(default_factory=list)
+
+
+class ProtocolIndex:
+    """Built once per lint run (``ProtocolIndex.of(model)``)."""
+
+    def __init__(self, model: ProjectModel):
+        self.model = model
+        self.handlers: Dict[str, List[HandlerReg]] = {}
+        self.call_sites: Dict[str, List[CallSite]] = {}
+        # function qualname -> typed FT errors it may raise
+        self.raises: Dict[str, FrozenSet[str]] = {}
+        # (callee key, typed error) -> try-sites that catch it TYPED;
+        # callee key is a function qualname or "rpc:<method>"
+        self.typed_catches: Dict[Tuple[str, str], List[TrySite]] = {}
+        self.try_sites: List[TrySite] = []
+        self._scan_registrations()
+        self._scan_call_sites()
+        self._infer_raises()
+        self._scan_tries()
+
+    @classmethod
+    def of(cls, model: ProjectModel) -> "ProtocolIndex":
+        idx = getattr(model, "_protocol_index", None)
+        if idx is None:
+            idx = cls(model)
+            model._protocol_index = idx
+        return idx
+
+    # -------------------------------------------------- registrations
+    def _scan_registrations(self) -> None:
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            info = self.model.modules[fi.module]
+            for node in self.model.walk_own(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else "")
+                if name == "RpcServer" and node.args and \
+                        isinstance(node.args[0], ast.Dict):
+                    table = node.args[0]
+                    for key, value in zip(table.keys, table.values):
+                        if isinstance(key, ast.Constant) and \
+                                isinstance(key.value, str):
+                            self._add_handler(info, fi, key.value,
+                                              value, key.lineno)
+                elif name == "add_handler" and len(node.args) >= 2 and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    self._add_handler(info, fi, node.args[0].value,
+                                      node.args[1], node.lineno)
+
+    def _add_handler(self, info: ModuleInfo, fi: FuncInfo, name: str,
+                     value: ast.AST, line: int) -> None:
+        wrapper = ""
+        inner = value
+        hops = 0
+        while isinstance(inner, ast.Call) and hops < 3:
+            wf = inner.func
+            wname = wf.id if isinstance(wf, ast.Name) else (
+                wf.attr if isinstance(wf, ast.Attribute) else "")
+            if wname in MUTATING_WRAPPERS:
+                wrapper = wname
+            elif wname in TRANSPARENT_WRAPPERS:
+                pass
+            else:
+                break
+            inner = inner.args[0] if inner.args else None
+            hops += 1
+        target = None
+        if isinstance(inner, ast.Attribute) and \
+                isinstance(inner.value, ast.Name) and \
+                inner.value.id == "self" and fi.cls is not None:
+            target = self.model._method_on(fi.module, fi.cls,
+                                           inner.attr)
+        elif isinstance(inner, ast.Name):
+            target = self.model._resolve_name(info, fi, inner.id)
+        self.handlers.setdefault(name, []).append(HandlerReg(
+            name=name, wrapper=wrapper, target=target,
+            module=fi.module, line=line, symbol=fi.qualname))
+
+    # ----------------------------------------------------- call sites
+    def _scan_call_sites(self) -> None:
+        self._find_forwarders()
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            for node in self.model.walk_own(fi.node):
+                site = self._call_site_of(fi, node)
+                if site is not None:
+                    self.call_sites.setdefault(site.name,
+                                               []).append(site)
+
+    def _find_forwarders(self) -> None:
+        """Methods that forward their own parameter as the rpc method
+        name (``def _call(self, method, ...): ...
+        self._rpc.call(method, ...)``): call sites of such a
+        trampoline with a literal first argument are RPC call sites
+        too — the thin-client/`mut_call` shape.  A forwarder is
+        mutation-safe only if EVERY inner path it forwards to is."""
+        self.forwarders: Dict[str, Set[str]] = {}
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            fnode = fi.node
+            params = [a.arg for a in (list(fnode.args.posonlyargs)
+                                      + list(fnode.args.args))
+                      if a.arg != "self"]
+            if not params:
+                continue
+            first = params[0]
+            for node in self.model.walk_own(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                inner = ""
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in CALL_ATTRS and node.args and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id == first:
+                    inner = f.attr
+                elif isinstance(f, ast.Name) and \
+                        f.id == "retry_call" and \
+                        len(node.args) >= 2 and \
+                        isinstance(node.args[1], ast.Name) and \
+                        node.args[1].id == first:
+                    inner = "retry_call"
+                if inner:
+                    self.forwarders.setdefault(fi.name,
+                                               set()).add(inner)
+        self.safe_kinds: Set[str] = set(MUTATION_SAFE_KINDS)
+        for name, inners in self.forwarders.items():
+            if name in CALL_ATTRS:
+                continue  # the primitives keep their own semantics
+            if inners <= MUTATION_SAFE_KINDS:
+                self.safe_kinds.add(name)
+
+    def _call_site_of(self, fi: FuncInfo,
+                      node: ast.AST) -> Optional[CallSite]:
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        attrs = CALL_ATTRS | set(getattr(self, "forwarders", ()))
+        if isinstance(f, ast.Attribute) and f.attr in attrs and \
+                node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return CallSite(node.args[0].value, f.attr, fi.module,
+                            node.lineno, fi.qualname)
+        if isinstance(f, ast.Name):
+            if f.id == "retry_call" and len(node.args) >= 2 and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    isinstance(node.args[1].value, str):
+                return CallSite(node.args[1].value, "retry_call",
+                                fi.module, node.lineno, fi.qualname)
+            if f.id in getattr(self, "forwarders", ()) and \
+                    node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                return CallSite(node.args[0].value, f.id, fi.module,
+                                node.lineno, fi.qualname)
+        return None
+
+    def rpc_raises(self, method: str) -> FrozenSet[str]:
+        """Typed errors a call to rpc ``method`` can re-raise at the
+        caller: the handler target's raise set, plus StaleEpochError
+        for _mut-registered handlers (the fence rejects superseded
+        epochs before the handler runs)."""
+        out: Set[str] = set()
+        for reg in self.handlers.get(method, ()):
+            if reg.target:
+                out |= self.raises.get(reg.target, frozenset())
+            if reg.wrapper == "_mut":
+                out.add("StaleEpochError")
+        return frozenset(out)
+
+    # -------------------------------------------------- typed raises
+    def _infer_raises(self) -> None:
+        """Fixpoint over the confident call graph, catch-aware: a call
+        inside a ``try`` whose handlers catch T (typed or via parent)
+        does not propagate T to this function's raise set."""
+        direct: Dict[str, Set[str]] = {}
+        # per function: [(callee qn | "rpc:m", caught-name frozenset)]
+        prop_calls: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            info = self.model.modules[fi.module]
+            d, calls = self._scan_raises(info, fi)
+            direct[qn] = d
+            prop_calls[qn] = calls
+        raises: Dict[str, Set[str]] = {qn: set(d)
+                                       for qn, d in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for qn in sorted(prop_calls):
+                cur = raises[qn]
+                for callee, caught in prop_calls[qn]:
+                    if callee.startswith("rpc:"):
+                        sub: Set[str] = set()
+                        method = callee[4:]
+                        for reg in self.handlers.get(method, ()):
+                            if reg.target:
+                                sub |= raises.get(reg.target, set())
+                            if reg.wrapper == "_mut":
+                                sub.add("StaleEpochError")
+                    else:
+                        sub = raises.get(callee, set())
+                    for t in sub:
+                        if t in cur:
+                            continue
+                        if t in caught or \
+                                FT_TYPED_ERRORS[t] & caught:
+                            continue
+                        cur.add(t)
+                        changed = True
+        self.raises = {qn: frozenset(s) for qn, s in raises.items()}
+
+    def _scan_raises(self, info: ModuleInfo, fi: FuncInfo
+                     ) -> Tuple[Set[str],
+                                List[Tuple[str, FrozenSet[str]]]]:
+        direct: Set[str] = set()
+        calls: List[Tuple[str, FrozenSet[str]]] = []
+        # Fast path: without a try-statement the caught-set is empty
+        # everywhere — raises and call edges come straight off the
+        # (cached) flat walk, no recursive descent.
+        has_try = any(isinstance(n, ast.Try)
+                      for n in self.model.walk_own(fi.node))
+        if not has_try:
+            empty: FrozenSet[str] = frozenset()
+            for node in self.model.walk_own(fi.node):
+                if isinstance(node, ast.Raise) and \
+                        node.exc is not None:
+                    exc = node.exc
+                    f = exc.func if isinstance(exc, ast.Call) else exc
+                    ename = f.id if isinstance(f, ast.Name) else \
+                        getattr(f, "attr", "")
+                    if ename in FT_TYPED_ERRORS:
+                        direct.add(ename)
+                elif isinstance(node, ast.Call):
+                    site = self._call_site_of(fi, node)
+                    if site is not None:
+                        calls.append((f"rpc:{site.name}", empty))
+                    hit = self.model._resolve_call_edge(info, fi,
+                                                        node)
+                    if hit is not None and \
+                            hit[1] in _RAISE_DEPTH_KINDS:
+                        calls.append((hit[0], empty))
+            return direct, calls
+
+        def scan(nodes, caught: FrozenSet[str]):
+            for node in nodes:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                if isinstance(node, ast.Try):
+                    body_caught = caught | frozenset(
+                        n for h in node.handlers
+                        for n in _handler_names(h))
+                    scan(node.body, body_caught)
+                    for h in node.handlers:
+                        scan(h.body, caught)
+                    scan(node.orelse, caught)
+                    scan(node.finalbody, caught)
+                    continue
+                if isinstance(node, ast.Raise) and node.exc is not None:
+                    exc = node.exc
+                    f = exc.func if isinstance(exc, ast.Call) else exc
+                    ename = f.id if isinstance(f, ast.Name) else \
+                        getattr(f, "attr", "")
+                    if ename in FT_TYPED_ERRORS and \
+                            ename not in caught and \
+                            not (FT_TYPED_ERRORS[ename] & caught):
+                        direct.add(ename)
+                if isinstance(node, ast.Call):
+                    site = self._call_site_of(fi, node)
+                    if site is not None:
+                        calls.append((f"rpc:{site.name}", caught))
+                    hit = self.model._resolve_call_edge(info, fi, node)
+                    if hit is not None and \
+                            hit[1] in _RAISE_DEPTH_KINDS:
+                        calls.append((hit[0], caught))
+                scan(ast.iter_child_nodes(node), caught)
+
+        scan(fi.node.body, frozenset())
+        return direct, calls
+
+    # ------------------------------------------------------ try sites
+    def _scan_tries(self) -> None:
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            info = self.model.modules[fi.module]
+            for node in self.model.walk_own(fi.node):
+                if not isinstance(node, ast.Try) or not node.handlers:
+                    continue
+                site = TrySite(module=fi.module, line=node.lineno,
+                               symbol=fi.qualname)
+                # Calls under a NESTED try with its own except clauses
+                # belong to that inner site, not this one.
+                for sub in _walk_no_defs(node.body, skip_tries=True):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    cs = self._call_site_of(fi, sub)
+                    if cs is not None:
+                        site.callees.append((f"rpc:{cs.name}",
+                                             sub.lineno))
+                    hit = self.model._resolve_call_edge(info, fi, sub)
+                    if hit is not None and \
+                            hit[1] in _RAISE_DEPTH_KINDS:
+                        site.callees.append((hit[0], sub.lineno))
+                if not site.callees:
+                    continue
+                for h in node.handlers:
+                    names = frozenset(_handler_names(h))
+                    bare = (len(h.body) == 1
+                            and isinstance(h.body[0], ast.Raise)
+                            and h.body[0].exc is None)
+                    site.handlers.append((h.lineno, names, bare))
+                self.try_sites.append(site)
+                for callee, _line in site.callees:
+                    for _hl, names, _bare in site.handlers:
+                        for t in names & set(FT_TYPED_ERRORS):
+                            self.typed_catches.setdefault(
+                                (callee, t), []).append(site)
+
+    def callee_raises(self, callee_key: str) -> FrozenSet[str]:
+        if callee_key.startswith("rpc:"):
+            return self.rpc_raises(callee_key[4:])
+        return self.raises.get(callee_key, frozenset())
+
+
+def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    if t is None:
+        return ["BaseException"]
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _walk_no_defs(stmts, skip_tries: bool = False):
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if skip_tries and isinstance(node, ast.Try) and node.handlers:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

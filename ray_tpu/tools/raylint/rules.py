@@ -14,7 +14,9 @@ import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .model import FuncInfo, ModuleInfo, ProjectModel, call_desc
+from .model import (FuncInfo, ModuleInfo, ProjectModel, call_desc,
+                    _short_fn, _short_key)
+from .protocol import FT_TYPED_ERRORS, ProtocolIndex
 
 # --------------------------------------------------------------------------
 # findings
@@ -1055,6 +1057,309 @@ def rule_journaled_mutation(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: lock-order-inversion
+# --------------------------------------------------------------------------
+
+def _edge_witness_line(model: ProjectModel, la, a: str, b: str):
+    """(ModuleInfo, line, symbol) of the first witness of edge a->b."""
+    wits = la.edges.get((a, b))
+    if not wits:
+        return None
+    fn, rel, line, _ve = wits[0]
+    fi = model.functions[fn]
+    return model.modules[fi.module], line, fn
+
+
+def _render_edge_chain(la, a: str, b: str) -> str:
+    """'mod:Cls.fn acquires 'B' while holding 'A' (held via f -> g)'
+    — line-number-free for baseline-stable fingerprints."""
+    wits = la.edges.get((a, b), ())
+    if not wits:
+        return f"{_short_key(a)} -> {_short_key(b)}"
+    fn, _rel, _line, via_entry = wits[0]
+    msg = (f"{_short_fn(fn)} acquires {_short_key(b)!r} while "
+           f"holding {_short_key(a)!r}")
+    if via_entry:
+        hops = la.chain(fn, a)
+        if len(hops) > 1:
+            msg += f" (entered holding it via {' -> '.join(hops)})"
+    return msg
+
+
+def rule_lock_order_inversion(model: ProjectModel) -> List[Finding]:
+    """Cycles in the global lock-acquisition-order graph: two code
+    paths that take the same pair of locks in opposite orders can
+    deadlock the moment two threads interleave (the classic ABBA —
+    lockdep's central check).  Each finding cites the full cycle with
+    one acquisition chain per edge."""
+    out = _Collector(model, "lock-order-inversion")
+    la = model.lock_analysis()
+    for cyc in la.cycles():
+        edges = list(zip(cyc, cyc[1:] + cyc[:1]))
+        anchor = _edge_witness_line(model, la, *edges[0])
+        if anchor is None:
+            continue
+        info, line, symbol = anchor
+        ring = " -> ".join(_short_key(t) for t in cyc + cyc[:1])
+        chains = "; ".join(_render_edge_chain(la, a, b)
+                           for a, b in edges)
+        out.add(info, line, symbol,
+                f"lock-order cycle {ring} (potential ABBA "
+                f"deadlock): {chains}")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: wait-holding-foreign-lock
+# --------------------------------------------------------------------------
+
+def rule_wait_holding_foreign_lock(model: ProjectModel) -> List[Finding]:
+    """``Condition.wait`` releases ONLY the condition's own lock.  Any
+    *other* lock held across the wait — taken in this function or
+    anywhere up the call chain — stays held for the full wait (and
+    with a retry loop, indefinitely): every other thread needing that
+    lock stalls behind a sleeper.  Timeouts don't excuse it; they just
+    cap each stall."""
+    out = _Collector(model, "wait-holding-foreign-lock")
+    la = model.lock_analysis()
+    for qn in sorted(la.facts):
+        fi = model.functions[qn]
+        info = model.modules[fi.module]
+        entry = la.entry.get(qn, set())
+        for w in la.facts[qn].waits:
+            if not w.token.is_cond:
+                continue  # plain .wait() objects (events, futures)
+                #           are blocking-under-lock's jurisdiction
+            held_keys = {t.key for t in w.held if t.global_}
+            foreign = sorted((held_keys | set(entry))
+                             - {w.token.key})
+            if not foreign:
+                continue
+            fdesc = ", ".join(repr(_short_key(k)) for k in foreign)
+            how = []
+            for k in foreign:
+                if k not in held_keys:
+                    hops = la.chain(qn, k)
+                    if len(hops) > 1:
+                        how.append(f"{_short_key(k)!r} held via "
+                                   f"{' -> '.join(hops)}")
+            suffix = f" ({'; '.join(how)})" if how else ""
+            out.add(info, w.line, qn,
+                    f"{w.desc}(...) waits on condition "
+                    f"{_short_key(w.token.key)!r} while a different "
+                    f"lock is held: {fdesc} — wait releases only its "
+                    f"own lock{suffix}")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: rpc-protocol
+# --------------------------------------------------------------------------
+
+def rule_rpc_protocol(model: ProjectModel) -> List[Finding]:
+    """The string-keyed RPC plane, statically closed: every call names
+    a registered handler, every handler has a caller, mutating
+    (_mut-registered) handlers are reached only through the
+    idempotent/fenced wrappers, and every dispatch loop re-installs
+    the request envelope."""
+    out = _Collector(model, "rpc-protocol")
+    idx = ProtocolIndex.of(model)
+    # (a) calls to unregistered handlers — the typo'd method name that
+    # otherwise surfaces as a runtime AttributeError on the server.
+    if idx.handlers:
+        for name in sorted(idx.call_sites):
+            if name in idx.handlers:
+                continue
+            for site in idx.call_sites[name]:
+                info = model.modules[site.module]
+                out.add(info, site.line, site.symbol,
+                        f"rpc call names handler {name!r} which no "
+                        f"server table registers")
+    # (b) registered handlers nobody calls — dead protocol surface
+    # (or externally driven: say so with a reasoned disable).
+    for name in sorted(idx.handlers):
+        if name in idx.call_sites:
+            continue
+        for reg in idx.handlers[name]:
+            info = model.modules[reg.module]
+            out.add(info, reg.line, reg.symbol,
+                    f"handler {name!r} is never called from the "
+                    f"package (dead protocol surface, or an external "
+                    f"caller that deserves a reasoned disable)")
+    # (c) mutating handlers invoked through the plain call path:
+    # bypasses idempotency dedup AND lease-epoch fencing.
+    for name in sorted(idx.handlers):
+        regs = idx.handlers[name]
+        if not any(r.mutating for r in regs):
+            continue
+        for site in idx.call_sites.get(name, ()):
+            if site.kind in idx.safe_kinds:
+                continue
+            info = model.modules[site.module]
+            out.add(info, site.line, site.symbol,
+                    f"mutating handler {name!r} invoked via plain "
+                    f"{site.kind!r} — bypasses idempotency dedup and "
+                    f"epoch fencing (use mut_call/call_idempotent)")
+    # (d) a dispatch loop that decodes envelopes and invokes handlers
+    # must re-install the caller's trace + deadline scopes, or every
+    # request it serves falls out of the merged timeline and sheds
+    # nothing.
+    for cls_qn in sorted(model.classes):
+        ci = model.classes[cls_qn]
+        if not _class_owns_handlers(model, ci):
+            continue
+        recv_fns = [qn for qn in sorted(ci.methods.values())
+                    if _calls_named(model, qn, "_recv_msg")]
+        if not recv_fns:
+            continue
+        installs_trace = installs_deadline = False
+        for mqn in ci.methods.values():
+            t, d = _scope_installs(model, mqn, depth=2)
+            installs_trace |= t
+            installs_deadline |= d
+        if installs_trace and installs_deadline:
+            continue
+        missing = []
+        if not installs_trace:
+            missing.append("tracing.scope_from")
+        if not installs_deadline:
+            missing.append("deadlines.scope")
+        qn = recv_fns[0]
+        fi = model.functions[qn]
+        info = model.modules[fi.module]
+        out.add(info, fi.line, qn,
+                f"rpc dispatch path of class {ci.name!r} never "
+                f"re-installs the request envelope "
+                f"({' + '.join(missing)} missing): handlers run "
+                f"without the caller's trace and deadline context")
+    return out.findings
+
+
+def _class_owns_handlers(model: ProjectModel, ci) -> bool:
+    for mqn in ci.methods.values():
+        for node in model.walk_own(model.functions[mqn].node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "handlers" and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        return True
+    return False
+
+
+def _calls_named(model: ProjectModel, qn: str, name: str) -> bool:
+    fi = model.functions.get(qn)
+    if fi is None:
+        return False
+    for node in model.walk_own(fi.node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            cname = f.id if isinstance(f, ast.Name) else \
+                getattr(f, "attr", "")
+            if cname == name:
+                return True
+    return False
+
+
+def _scope_installs(model: ProjectModel, qn: str,
+                    depth: int) -> Tuple[bool, bool]:
+    """(installs tracing scope, installs deadline scope) within
+    ``depth`` confident call hops of ``qn``."""
+    trace = dead = False
+    fi = model.functions.get(qn)
+    if fi is None:
+        return False, False
+    for node in model.walk_own(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else "")
+        if attr == "scope_from":
+            trace = True
+        elif attr == "scope":
+            recv = ""
+            if isinstance(f, ast.Attribute):
+                if isinstance(f.value, ast.Name):
+                    recv = f.value.id
+                elif isinstance(f.value, ast.Attribute):
+                    recv = f.value.attr
+            if "deadline" in recv.lower():
+                dead = True
+    if (trace and dead) or depth <= 0:
+        return trace, dead
+    for edge in model.call_edges.get(qn, ()):
+        if edge.kind == "fallback":
+            continue
+        t, d = _scope_installs(model, edge.target, depth - 1)
+        trace |= t
+        dead |= d
+        if trace and dead:
+            break
+    return trace, dead
+
+
+# --------------------------------------------------------------------------
+# rule: exception-contract
+# --------------------------------------------------------------------------
+
+# Findings are scoped to the user-facing layers the ISSUE names: a
+# typed FT error swallowed into a parent catch there loses the
+# recovery dispatch (retry-elsewhere vs re-register vs back-off)
+# that some OTHER call site of the same callee implements.
+_CONTRACT_SEGMENTS = {"serve", "train", "dag"}
+
+
+def rule_exception_contract(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "exception-contract")
+    idx = ProtocolIndex.of(model)
+    for site in idx.try_sites:
+        segs = set(site.module.split("."))
+        if not (segs & _CONTRACT_SEGMENTS):
+            continue
+        fi = model.functions[site.symbol]
+        info = model.modules[fi.module]
+        seen_callees = set()
+        for callee, _cline in site.callees:
+            if callee in seen_callees:
+                continue
+            seen_callees.add(callee)
+            for t in sorted(idx.callee_raises(callee)):
+                # typed clause present -> contract honored
+                if any(t in names for _l, names, _b in site.handlers):
+                    continue
+                peers = [s for s in idx.typed_catches.get(
+                    (callee, t), ()) if s is not site]
+                if not peers:
+                    continue  # nobody handles it typed: no contract
+                relevant = [(hl, names, bare)
+                            for hl, names, bare in site.handlers
+                            if names & FT_TYPED_ERRORS[t]]
+                if any(bare for _hl, _n, bare in relevant):
+                    continue  # bare re-raise preserves the type
+                parent_h = relevant[0][:2] if relevant else None
+                peer = peers[0]
+                cdesc = callee[4:] + " (rpc)" \
+                    if callee.startswith("rpc:") else _short_fn(callee)
+                if parent_h is not None:
+                    hline, names = parent_h
+                    out.add(info, hline, site.symbol,
+                            f"call to {cdesc} can raise {t}, but this "
+                            f"except catches only the parent "
+                            f"({', '.join(sorted(names))}) — "
+                            f"{_short_fn(peer.symbol)} handles {t} "
+                            f"typed for the same callee")
+                else:
+                    out.add(info, site.line, site.symbol,
+                            f"call to {cdesc} can raise {t}, which "
+                            f"escapes every except clause here — "
+                            f"{_short_fn(peer.symbol)} handles {t} "
+                            f"typed for the same callee")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -1069,6 +1374,10 @@ RULES = {
     "log-hygiene": rule_log_hygiene,
     "suppression-syntax": rule_suppression_syntax,
     "journaled-mutation": rule_journaled_mutation,
+    "lock-order-inversion": rule_lock_order_inversion,
+    "wait-holding-foreign-lock": rule_wait_holding_foreign_lock,
+    "rpc-protocol": rule_rpc_protocol,
+    "exception-contract": rule_exception_contract,
 }
 
 RULE_DOCS = {
@@ -1129,4 +1438,36 @@ RULE_DOCS = {
         "ships.  An unwrapped writer acks mutations a head kill -9 "
         "silently loses, and skips idempotency dedup and epoch "
         "fencing besides."),
+    "lock-order-inversion": (
+        "Cycles in the global lock-acquisition-order graph (built "
+        "from the interprocedural lock-set analysis: which locks may "
+        "be held when each function runs, propagated over the call "
+        "graph).  Two paths taking the same locks in opposite orders "
+        "deadlock the moment two threads interleave — lockdep's ABBA "
+        "check, at lint time.  Each finding cites the full cycle "
+        "with one acquisition chain per edge."),
+    "wait-holding-foreign-lock": (
+        "Condition.wait releases ONLY the condition's own lock; any "
+        "other lock held across the wait — locally or anywhere up "
+        "the call chain — stays held for the full wait, stalling "
+        "every other thread that needs it.  Timeouts cap each stall, "
+        "they don't excuse it."),
+    "rpc-protocol": (
+        "The string-keyed RPC plane statically closed: every "
+        ".call/mut_call/call_idempotent site must name a registered "
+        "handler, every registered handler needs a caller (dead "
+        "protocol otherwise), _mut-registered mutating handlers must "
+        "be reached via mut_call/call_idempotent (plain call skips "
+        "idempotency dedup and epoch fencing), and a handler "
+        "dispatch loop must re-install the envelope's trace + "
+        "deadline scopes."),
+    "exception-contract": (
+        "Typed-FT-error contracts at the user-facing layers (serve/"
+        "train/dag): if a callee can raise a typed error "
+        "(StaleEpochError, DeadlineExceededError, ChannelError, "
+        "ActorDiedError, BackPressureError — inferred over the call "
+        "graph AND through the RPC boundary) and some other call "
+        "site handles it typed, a try here that catches only a "
+        "parent class (or lets it escape its clauses) silently "
+        "drops the recovery dispatch the typed handler implements."),
 }

@@ -1,0 +1,51 @@
+"""True negatives: typed clauses everywhere a contract exists, a
+bare re-raise that preserves the type, and a parent catch with no
+typed peer (no contract to violate)."""
+
+
+class ChannelError(Exception):
+    pass
+
+
+class BackPressureError(Exception):
+    pass
+
+
+def read_frame():
+    raise ChannelError("ring severed")
+
+
+def enqueue():
+    raise BackPressureError("queue full")
+
+
+def consumer_a():
+    try:
+        return read_frame()
+    except ChannelError:
+        return None
+
+
+def consumer_b():
+    try:
+        return read_frame()
+    except ChannelError:
+        return None
+
+
+def consumer_reraise():
+    # Catching the parent but re-raising bare: the typed error
+    # propagates unchanged — the contract is preserved.
+    try:
+        return read_frame()
+    except Exception:
+        raise
+
+
+def shed_no_contract():
+    # Nobody in the project handles BackPressureError typed for this
+    # callee: a broad catch is a style question, not a contract break.
+    try:
+        return enqueue()
+    except Exception:
+        return None

@@ -1,0 +1,49 @@
+"""True positives: callees whose typed FT errors some OTHER site
+handles typed, caught here only via a parent class — or escaping the
+except clauses entirely."""
+
+
+class ChannelError(Exception):
+    pass
+
+
+class ActorDiedError(Exception):
+    pass
+
+
+def read_frame():
+    raise ChannelError("ring severed")
+
+
+def submit():
+    raise ActorDiedError("replica gone")
+
+
+def good_consumer():
+    # The typed contract this rule enforces exists BECAUSE of sites
+    # like this one.
+    try:
+        return read_frame()
+    except ChannelError:
+        return None
+
+
+def parent_catcher():
+    try:
+        return read_frame()
+    except Exception:  # ChannelError handled typed in good_consumer
+        return None
+
+
+def good_router():
+    try:
+        return submit()
+    except ActorDiedError:
+        return None
+
+
+def leaky_router():
+    try:
+        return submit()
+    except (ConnectionError, OSError):  # ActorDiedError escapes
+        return None

@@ -1,0 +1,41 @@
+"""True negatives: a consistent global order, reentrant re-acquires
+of the same RLock, and a Condition aliasing its backing lock."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def one():
+    with lock_a:
+        with lock_b:  # a -> b ...
+            return 1
+
+
+def two():
+    with lock_a:
+        with lock_b:  # ... and a -> b again: same order, no cycle
+            return 2
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+
+    def mutate(self):
+        with self._lock:
+            return self._read()
+
+    def _read(self):
+        # Reentrant re-acquire of the same RLock: a self-edge, not an
+        # inversion.
+        with self._lock:
+            return 3
+
+    def notify(self):
+        # The condition IS the lock (alias): no cross-lock edge.
+        with self._lock:
+            with self._cond:
+                self._cond.notify_all()

@@ -1,0 +1,40 @@
+"""True positives: the same pair of locks taken in opposite orders on
+two paths — directly, and through a call-graph hop (the entry-set
+propagation case)."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def direct_ab():
+    with lock_a:
+        with lock_b:  # edge a -> b
+            return 1
+
+
+def helper_takes_a():
+    with lock_a:  # entered with lock_b held (see below): edge b -> a
+        return 2
+
+
+def interprocedural_ba():
+    with lock_b:
+        return helper_takes_a()
+
+
+class Router:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def update(self):
+        with self._table_lock:
+            with self._stats_lock:  # table -> stats
+                return 3
+
+    def report(self):
+        with self._stats_lock:
+            with self._table_lock:  # stats -> table: ABBA
+                return 4

@@ -1,0 +1,84 @@
+"""True negatives: every call names a registered handler, every
+handler is reachable (directly or through a forwarding trampoline),
+mutating handlers ride call_idempotent/mut_call, and the dispatch
+loop re-installs both envelope scopes."""
+
+import pickle
+
+
+def _mut(fn):
+    return fn
+
+
+def _recv_msg(sock):
+    return ("req", "1", "method", b"", False, None, None)
+
+
+class _tracing:
+    @staticmethod
+    def scope_from(trace):
+        return _Scope()
+
+
+class _deadlines:
+    @staticmethod
+    def scope(deadline):
+        return _Scope()
+
+
+class _Scope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class RpcServer:
+    def __init__(self, handlers, host="127.0.0.1", port=0):
+        self.handlers = dict(handlers)
+
+    def serve_one(self, conn):
+        kind, req_id, method, raw, is_raw, trace, deadline = \
+            _recv_msg(conn)
+        fn = self.handlers.get(method)
+        with _tracing.scope_from(trace), _deadlines.scope(deadline):
+            return fn(pickle.loads(raw))
+
+
+class Head:
+    def _register_node(self, p):
+        return {"ok": True}
+
+    def _kv_get(self, p):
+        return {"found": False}
+
+    def _list_nodes(self, p):
+        return []
+
+    def build(self):
+        return RpcServer({
+            "register_node": _mut(self._register_node),
+            "kv_get": self._kv_get,
+            "list_nodes": self._list_nodes,
+        })
+
+
+class Client:
+    def __init__(self, head):
+        self.head = head
+
+    def attach(self):
+        return self.head.call_idempotent("register_node",
+                                         {"node_id": "n1"})
+
+    def peers(self):
+        return self.head.call("list_nodes", {})
+
+    def _call(self, method, payload):
+        # forwarding trampoline: literal-name callers of _call are
+        # call sites of the forwarded method
+        return self.head.call(method, payload)
+
+    def lookup(self):
+        return self._call("kv_get", {"key": "a"})

@@ -1,0 +1,61 @@
+"""True positives for the rpc-protocol family: a call to a method no
+table registers, a handler nobody calls, a mutating (_mut) handler
+invoked via the plain call path, and a dispatch loop that never
+re-installs the envelope's trace/deadline scopes."""
+
+import pickle
+
+
+def _mut(fn):
+    return fn
+
+
+def _recv_msg(sock):
+    return ("req", "1", "method", b"", False, None, None)
+
+
+class RpcServer:
+    def __init__(self, handlers, host="127.0.0.1", port=0):
+        self.handlers = dict(handlers)
+
+    def serve_one(self, conn):
+        # Dispatch loop WITHOUT tracing.scope_from/deadlines.scope:
+        # every handler runs context-free.
+        kind, req_id, method, raw, is_raw, trace, deadline = \
+            _recv_msg(conn)
+        fn = self.handlers.get(method)
+        return fn(pickle.loads(raw))
+
+
+class Head:
+    def _register_node(self, p):
+        return {"ok": True}
+
+    def _orphan(self, p):
+        return {"ok": True}
+
+    def _list_nodes(self, p):
+        return []
+
+    def build(self):
+        return RpcServer({
+            "register_node": _mut(self._register_node),
+            "orphan_handler": self._orphan,  # registered, never called
+            "list_nodes": self._list_nodes,
+        })
+
+
+class Client:
+    def __init__(self, head):
+        self.head = head
+
+    def attach(self):
+        # plain .call of a _mut-registered mutating handler
+        return self.head.call("register_node", {"node_id": "n1"})
+
+    def peers(self):
+        return self.head.call("list_nodes", {})
+
+    def typo(self):
+        # no table registers "lst_nodes"
+        return self.head.call("lst_nodes", {})

@@ -1,0 +1,27 @@
+"""True negatives: waits that hold only the condition's own lock —
+including through the Condition(lock) alias — and waits entered with
+no lock held at all."""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._free_cond = threading.Condition()
+
+    def drain(self):
+        # The with takes the condition's OWN backing lock (alias):
+        # the wait releases exactly what is held.
+        with self._cond:
+            while not getattr(self, "done", False):
+                self._cond.wait(timeout=1.0)
+
+    def park(self):
+        with self._free_cond:
+            self._free_cond.wait()
+
+    def snapshot(self):
+        with self._lock:
+            return dict(vars(self))

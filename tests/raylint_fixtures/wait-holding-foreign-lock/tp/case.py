@@ -1,0 +1,26 @@
+"""True positives: Condition.wait entered while a DIFFERENT lock is
+held — locally, and through a caller (entry-set case).  The wait
+releases only the condition's own lock; the foreign one stays held
+for the full wait."""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def drain(self):
+        with self._lock:
+            with self._cond:
+                # timeouted or not: '_lock' is blocked for the wait
+                self._cond.wait(timeout=1.0)
+
+    def _park(self):
+        with self._cond:
+            self._cond.wait()
+
+    def flush(self):
+        with self._lock:
+            self._park()  # interprocedural: waits with '_lock' held

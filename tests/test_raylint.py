@@ -103,6 +103,170 @@ def test_suppression_comment_suppresses_and_validates():
     assert of_rule(findings, "ft-exception-swallow")
 
 
+def test_lock_order_inversion_cites_both_chains():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("lock-order-inversion", "tp"),
+        "lock-order-inversion")]
+    # the module-level ABBA: both acquisition chains cited in one
+    # finding, including the interprocedural entry-set hop
+    mod = [m for m in msgs if "case.lock_a" in m]
+    assert mod, msgs
+    assert "direct_ab acquires" in mod[0]
+    assert "helper_takes_a acquires" in mod[0]
+    assert "entered holding it via" in mod[0]
+    assert "interprocedural_ba" in mod[0]
+    # the in-class ABBA pair is its own cycle
+    assert any("Router._stats_lock" in m and "Router._table_lock" in m
+               for m in msgs)
+
+
+def test_wait_holding_foreign_lock_interprocedural():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("wait-holding-foreign-lock", "tp"),
+        "wait-holding-foreign-lock")]
+    assert len(msgs) == 2
+    # the entry-set case names the caller chain
+    assert any("held via" in m and "Pipeline.flush" in m
+               for m in msgs)
+
+
+def test_rpc_protocol_subchecks():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("rpc-protocol", "tp"), "rpc-protocol")]
+    assert any("no server table registers" in m
+               and "'lst_nodes'" in m for m in msgs)
+    assert any("never called" in m and "'orphan_handler'" in m
+               for m in msgs)
+    assert any("bypasses idempotency" in m
+               and "'register_node'" in m for m in msgs)
+    assert any("re-installs the request envelope" in m
+               and "tracing.scope_from" in m
+               and "deadlines.scope" in m for m in msgs)
+    # read-only handlers via plain call stay clean
+    assert not any("'list_nodes'" in m for m in msgs)
+
+
+def test_exception_contract_subchecks():
+    msgs = [f.message for f in of_rule(
+        lint_fixture("exception-contract", "tp"), "exception-contract")]
+    assert any("catches only the parent" in m and "ChannelError" in m
+               and "good_consumer" in m for m in msgs)
+    assert any("escapes every except clause" in m
+               and "ActorDiedError" in m for m in msgs)
+
+
+# ----------------------------------------------- lock-set propagation
+def test_lock_set_propagation_on_synthetic_call_graph(tmp_path):
+    """Entry lock-sets propagate over confident call edges (and NOT
+    over the class-blind unique-name fallback), aliasing merges a
+    Condition with its backing lock, and the order graph records the
+    interprocedural edge with its witness."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(
+        "import threading\n"
+        "lock_a = threading.Lock()\n"
+        "lock_b = threading.Lock()\n"
+        "def leaf():\n"
+        "    with lock_b:\n"
+        "        return 1\n"
+        "def mid():\n"
+        "    return leaf()\n"
+        "def root():\n"
+        "    with lock_a:\n"
+        "        return mid()\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cond = threading.Condition(self._lock)\n"
+        "    def guess_target(self):\n"
+        "        return 2\n"
+        "    def run(self):\n"
+        "        with self._lock:\n"
+        "            with self._cond:\n"
+        "                return self.other.guess_target()\n")
+    model = ProjectModel(str(pkg))
+    la = model.lock_analysis()
+    A, B = "pkg.mod:lock_a", "pkg.mod:lock_b"
+    # two confident hops: root -> mid -> leaf
+    assert A in la.entry["pkg.mod:mid"]
+    assert A in la.entry["pkg.mod:leaf"]
+    # the acquisition of lock_b inside leaf records the a -> b edge
+    # with leaf as witness, flagged as entry-propagated
+    wits = la.edges[(A, B)]
+    assert wits[0][0] == "pkg.mod:leaf" and wits[0][3] is True
+    # chain renders root-first
+    assert la.chain("pkg.mod:leaf", A) == \
+        ["mod:root", "mod:mid", "mod:leaf"]
+    # condition aliases its backing lock: no _lock -> _cond edge
+    assert not any("_cond" in a or "_cond" in b
+                   for (a, b) in la.edges)
+    # 'self.other.guess_target()' resolves only via the unique-name
+    # fallback: the lock held at that site must NOT propagate
+    assert not la.entry["pkg.mod:C.guess_target"]
+    assert la.cycles() == []
+
+
+# ----------------------------------------------------------- determinism
+def test_whole_package_runs_are_byte_identical():
+    """Two subprocess lints under DIFFERENT hash seeds must emit
+    byte-identical reports (modulo the elapsed_s timing field): set
+    iteration anywhere in the model/rules would leak here."""
+    import subprocess
+    import sys
+
+    outs = []
+    for seed in ("1", "2"):
+        env = dict(os.environ, PYTHONHASHSEED=seed,
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from ray_tpu.tools.raylint import cli; "
+             "cli.main(['--json', '--no-baseline'])"],
+            capture_output=True, text=True, timeout=300, env=env)
+        blob = json.loads(proc.stdout)
+        blob.pop("elapsed_s", None)
+        outs.append(json.dumps(blob, sort_keys=False))
+    assert outs[0] == outs[1]
+
+
+# ------------------------------------------------------------ parse cache
+def test_parse_cache_memo_and_invalidation(tmp_path, monkeypatch):
+    """The content-hash parse memo: a rebuilt model re-parses nothing
+    for unchanged bytes (same content in a DIFFERENT path still
+    hits), and an edited file misses exactly itself."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("def f():\n    return 1\n")
+    m1 = ProjectModel(str(pkg))
+    assert "pkg.mod:f" in m1.functions
+    # warm build parses nothing (the memo satisfies every file)
+    import ray_tpu.tools.raylint.model as model_mod
+
+    real_parse = model_mod.ast.parse
+    calls = []
+    monkeypatch.setattr(model_mod.ast, "parse",
+                        lambda *a, **k: calls.append(1) or
+                        real_parse(*a, **k))
+    m2 = ProjectModel(str(pkg))
+    assert "pkg.mod:f" in m2.functions and not calls
+    # content-keyed, not path-keyed: identical bytes elsewhere hit too
+    pkg2 = tmp_path / "pkg2"
+    pkg2.mkdir()
+    (pkg2 / "other.py").write_text("def f():\n    return 1\n")
+    m2b = ProjectModel(str(pkg2))
+    assert "pkg2.other:f" in m2b.functions and not calls
+    # a content change misses the memo and re-parses
+    (pkg / "mod.py").write_text("def g():\n    return 2\n")
+    m3 = ProjectModel(str(pkg))
+    assert "pkg.mod:g" in m3.functions and len(calls) == 1
+    # RAY_TPU_RAYLINT_CACHE=0 disables the memo entirely
+    monkeypatch.setenv("RAY_TPU_RAYLINT_CACHE", "0")
+    ProjectModel(str(pkg))
+    assert len(calls) == 2
+
+
 # ------------------------------------------------------------ baseline
 def test_baseline_grandfathers_and_shrinks(tmp_path):
     root = os.path.join(FIXTURES, "ft-exception-swallow", "tp")
@@ -152,6 +316,118 @@ def test_cli_list_rules(capsys):
 
 def test_cli_unknown_rule_is_usage_error(capsys):
     assert raylint_cli.main([FIXTURES, "--select", "bogus"]) == 2
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    tp = os.path.join(FIXTURES, "rpc-protocol", "tp")
+    rc = raylint_cli.main([tp, "--format", "sarif", "--baseline",
+                           str(tmp_path / "bl.json")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["version"] == "2.1.0"
+    run = out["runs"][0]
+    assert run["tool"]["driver"]["name"] == "raylint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(ALL_RULES) <= rule_ids
+    results = run["results"]
+    assert results
+    r0 = results[0]
+    assert r0["ruleId"] == "rpc-protocol"
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("case.py")
+    assert loc["region"]["startLine"] >= 1
+    assert "raylint/v1" in r0["partialFingerprints"]
+
+
+def test_cli_lock_graph_dump(capsys):
+    tp = os.path.join(FIXTURES, "lock-order-inversion", "tp")
+    assert raylint_cli.main([tp, "--lock-graph", "dot"]) == 0
+    dot = capsys.readouterr().out
+    assert dot.startswith("digraph lock_order")
+    assert "case.lock_a" in dot and "->" in dot
+    assert raylint_cli.main([tp, "--lock-graph", "json"]) == 0
+    blob = json.loads(capsys.readouterr().out)
+    assert "tp.case:lock_a" in blob["nodes"]
+    assert blob["cycles"], "the ABBA fixture must show its cycle"
+    edge = blob["edges"][0]
+    assert {"from", "to", "witnesses"} <= set(edge)
+    assert {"function", "path", "line",
+            "via_entry"} <= set(edge["witnesses"][0])
+
+
+def test_identical_files_keep_distinct_call_graphs(tmp_path):
+    """The parse memo shares one AST between byte-identical files;
+    call-edge resolution must still happen per MODULE (a shared node
+    must not replay module a's resolution inside module b)."""
+    pkg = tmp_path / "pkg"
+    src = ("import threading\n"
+           "lk = threading.Lock()\n"
+           "def g():\n"
+           "    return 1\n"
+           "def f():\n"
+           "    with lk:\n"
+           "        return g()\n")
+    for sub in ("a", "b"):
+        d = pkg / sub
+        d.mkdir(parents=True)
+        (d / "mod.py").write_text(src)
+    model = ProjectModel(str(pkg))
+    for sub in ("a", "b"):
+        callees = {c for c, _l, _v
+                   in model.calls[f"pkg.{sub}.mod:f"]}
+        assert callees == {f"pkg.{sub}.mod:g"}
+    la = model.lock_analysis()
+    assert la.entry["pkg.a.mod:g"] == {"pkg.a.mod:lk"}
+    assert la.entry["pkg.b.mod:g"] == {"pkg.b.mod:lk"}
+
+
+def test_cli_changed_scopes_reporting(tmp_path, capsys):
+    """--changed filters findings to git-changed files; the analysis
+    stays whole-program (an unchanged file's handler table still
+    resolves a changed file's call sites).  The package parent is
+    deliberately NOT the git toplevel: diff paths are toplevel-
+    relative while ls-files --others is cwd-relative, and both must
+    land in finding shape."""
+    import subprocess
+
+    proj = tmp_path / "proj"
+    pkg = proj / "sub" / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "stable.py").write_text(
+        "import time, threading\n"
+        "_lk = threading.Lock()\n"
+        "def old_debt():\n"
+        "    with _lk:\n"
+        "        time.sleep(1.0)\n")
+    run = lambda *cmd: subprocess.run(  # noqa: E731
+        cmd, cwd=proj, capture_output=True, text=True, timeout=30)
+    run("git", "init", "-q")
+    run("git", "-c", "user.email=t@t", "-c", "user.name=t",
+        "add", "-A")
+    run("git", "-c", "user.email=t@t", "-c", "user.name=t",
+        "commit", "-qm", "seed")
+    (pkg / "fresh.py").write_text(
+        "import time, threading\n"
+        "_lk = threading.Lock()\n"
+        "def new_bug():\n"
+        "    with _lk:\n"
+        "        time.sleep(1.0)\n")
+    bl = str(tmp_path / "bl.json")
+    rc = raylint_cli.main([str(pkg), "--changed", "--baseline", bl])
+    out = capsys.readouterr().out + capsys.readouterr().err
+    assert rc == 1
+    # unscoped: both files flagged
+    rc_all = raylint_cli.main([str(pkg), "--baseline", bl])
+    out_all = capsys.readouterr().out
+    assert rc_all == 1
+    assert "stable.py" in out_all and "fresh.py" in out_all
+    assert "fresh.py" in out and "stable.py" not in out
+    # --changed never rewrites the baseline
+    rc = raylint_cli.main([str(pkg), "--changed",
+                           "--update-baseline", "--baseline", bl])
+    capsys.readouterr()
+    assert rc == 2 and not os.path.exists(bl)
 
 
 def test_cli_update_baseline_rejects_select(tmp_path, capsys):
