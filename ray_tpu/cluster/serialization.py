@@ -427,6 +427,56 @@ def sealed_from_flat(meta: dict, buf) -> Serialized:
     return Serialized(payload, externs)
 
 
+# ---------------------------------------------------------------------------
+# Block-table-aware KV export (paged serving handoff)
+# ---------------------------------------------------------------------------
+#
+# The paged KV pool (models/llama.init_paged_kv_cache) is BLOCK-major:
+# ``(num_blocks, L, block_size, Hkv, D)`` per tensor, so one block id
+# indexes a single contiguous slab.  A prefill→decode handoff ships an
+# arbitrary block-table's worth of K/V without ever gathering: each
+# block is exported as a zero-copy view straight out of the (CPU-backed)
+# pool, laid out ``k_b0 || v_b0 || k_b1 || v_b1 || ...`` behind a tiny
+# header.  The receive side rebuilds strided views over one contiguous
+# buffer — the only copy on the whole path is the receiver's scatter
+# into its own pool.
+
+
+def export_kv_blocks(pool_k: np.ndarray, pool_v: np.ndarray,
+                     block_ids) -> Tuple[dict, List[memoryview]]:
+    """(meta, buffers) for the K/V of ``block_ids`` out of a
+    block-major pool.  ``pool_k``/``pool_v`` are HOST views of the
+    device pool (``np.asarray`` aliases CPU-backed jax arrays);
+    buffers alias the pool — consume them before the pool is donated
+    into another device call."""
+    if not len(block_ids):
+        raise ValueError("empty block table")
+    block_shape = tuple(pool_k.shape[1:])
+    meta = {
+        "dtype": str(pool_k.dtype),
+        "block_shape": block_shape,
+        "n": len(block_ids),
+        "block_ids": [int(b) for b in block_ids],
+    }
+    bufs: List[memoryview] = []
+    for b in block_ids:
+        bufs.append(_u8_view(np.ascontiguousarray(pool_k[b])))
+        bufs.append(_u8_view(np.ascontiguousarray(pool_v[b])))
+    return meta, bufs
+
+
+def kv_blocks_from_wire(meta: dict, buf) -> Tuple[np.ndarray, np.ndarray]:
+    """(k_blocks, v_blocks) each ``(n, *block_shape)`` — zero-copy
+    strided views over the received flat buffer."""
+    view = memoryview(buf)
+    if not view.readonly:
+        view = view.toreadonly()
+    shape = (meta["n"], 2) + tuple(meta["block_shape"])
+    arr = np.frombuffer(view, dtype=_parse_dtype(meta["dtype"]),
+                        count=int(np.prod(shape))).reshape(shape)
+    return arr[:, 0], arr[:, 1]
+
+
 def dumps(value: Any) -> bytes:
     """One-shot: value → wire bytes."""
     return to_wire(serialize(value))

@@ -60,7 +60,7 @@ from . import chaos as _chaos
 
 __all__ = [
     "Channel", "ChannelClosed", "ChannelPeerDied", "ChannelArg",
-    "ChannelError", "ChannelWriter", "ChannelReader",
+    "ChannelError", "ChannelWriter", "ChannelReader", "KVBlockFrame",
     "channels_available", "channel_path", "submit_channel_call",
     "channel_host", "channel_location", "destroy_channel",
     "destroy_channel_at", "CHANNEL_STEP_METHOD",
@@ -83,6 +83,9 @@ _PROBE_PERIOD_S = 0.5
 _TAG_VALUE = 0x57   # "W": flat wire bytes follow
 _TAG_REF = 0x52     # "R": pickled ObjectRef (payload exceeded the slot)
 _TAG_ERROR = 0x45   # "E": pickled {"err": exc, "ctx": {...}} dict
+_TAG_KV = 0x4B      # "K": KV-block frame (paged-KV handoff: pickled
+#                     meta + raw block slabs, serialization.export_kv_blocks
+#                     layout) — read back as a KVBlockFrame
 
 _available: Optional[bool] = None
 _avail_lock = threading.Lock()
@@ -201,6 +204,20 @@ def _round_up_pow2(n: int) -> int:
 # Endpoints (process-wide, resolved lazily inside the executing worker)
 # ---------------------------------------------------------------------------
 
+class KVBlockFrame:
+    """A received KV-block frame (paged-serving prefill→decode
+    handoff): ``meta`` is the block-table header
+    (``cluster/serialization.export_kv_blocks``), ``data`` the raw
+    concatenated block slabs — rebuild zero-copy per-block views with
+    ``serialization.kv_blocks_from_wire(meta, data)``."""
+
+    __slots__ = ("meta", "data")
+
+    def __init__(self, meta: dict, data):
+        self.meta = meta
+        self.data = data
+
+
 class ChannelWriter:
     """Producer endpoint.  Creates the backing ring at first put, sized
     from the first payload unless ``slot_bytes`` hints otherwise."""
@@ -301,6 +318,49 @@ class ChannelWriter:
                         pid=process_pid(),
                         tid=threading.current_thread().name,
                         ts=t_wall, args={"seq": self._seq})
+
+    def put_kv_blocks(self, meta: dict, bufs: Sequence) -> int:
+        """Write one KV-block frame (the paged-serving handoff fast
+        path): pickled block-table meta followed by the raw block
+        slabs, assembled directly in slot memory — the sender's pool
+        views memcpy once, the reader rebuilds zero-copy views.  A
+        frame exceeding the slot capacity falls back to an object-plane
+        ref (the reader's generic ref path resolves it), so one
+        oversize prompt never wedges the handoff ring.  Returns the
+        payload byte count (the transport counters' input)."""
+        self._seq += 1
+        self._chaos_gate()
+        hdr = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
+        parts = [bytes([_TAG_KV]), len(hdr).to_bytes(4, "big"),
+                 hdr, *bufs]
+        total = 5 + len(hdr) + sum(len(b) for b in bufs)
+        chan = self._ensure(total)
+        ring = os.path.basename(self.path)
+        if total > chan.slot_bytes:
+            import numpy as np
+
+            flat = bytearray(total - 5 - len(hdr))
+            off = 0
+            for b in bufs:
+                flat[off:off + len(b)] = b
+                off += len(b)
+            parts = [self._ref_frame(
+                KVBlockFrame(meta, np.frombuffer(bytes(flat),
+                                                 dtype=np.uint8)))]
+            # The ring carried only the ref frame — the payload rode
+            # the object plane; counting the full KV bytes here would
+            # permanently skew write-vs-read series for this ring.
+            total = len(parts[0])
+            _chan_metrics()["fallback"].inc(tags={"ring": ring})
+        t0 = time.perf_counter()
+        chan.put_parts(parts, timeout=self.timeout)
+        m = _chan_metrics()
+        m["write_wait"].observe(time.perf_counter() - t0,
+                                tags={"ring": ring})
+        tags = {"ring": ring, "dir": "write"}
+        m["frames"].inc(tags=tags)
+        m["bytes"].inc(total, tags=tags)
+        return total
 
     def _ref_frame(self, value: Any) -> bytes:
         from ..core.runtime import get_runtime
@@ -488,7 +548,7 @@ class ChannelReader:
                 "empty frame",
                 context={"ring": os.path.basename(self.path)})
         tag = data[0]
-        if tag in (_TAG_VALUE, _TAG_REF):
+        if tag in (_TAG_VALUE, _TAG_REF, _TAG_KV):
             self._seq += 1
             tags = {"ring": ring, "dir": "read"}
             m["frames"].inc(tags=tags)
@@ -508,6 +568,13 @@ class ChannelReader:
             # Array leaves are zero-copy views into the frame buffer
             # (already our private copy straight out of the slot).
             return deserialize(sealed_from_flat(meta, mv[5 + hl:]))
+        if tag == _TAG_KV:
+            mv = memoryview(data)
+            hl = int.from_bytes(mv[1:5], "big")
+            meta = pickle.loads(mv[5:5 + hl])
+            # Raw block slabs stay a zero-copy view over the private
+            # frame copy; the consumer scatters them into its pool.
+            return KVBlockFrame(meta, mv[5 + hl:])
         if tag == _TAG_REF:
             from ..core.runtime import get_runtime
 

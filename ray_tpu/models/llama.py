@@ -681,6 +681,25 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def init_paged_kv_cache(config: LlamaConfig, num_blocks: int,
+                        block_size: int,
+                        dtype: Any = None) -> Dict[str, jax.Array]:
+    """Block-pool KV cache for paged attention (vLLM SOSP '23 shape):
+    ``(num_blocks, L, block_size, Hkv, D)`` per tensor.  BLOCK-major —
+    one block's K (or V) across all layers is a single contiguous
+    slab, so the prefill→decode KV handoff exports per-block zero-copy
+    views (cluster/serialization.export_kv_blocks) instead of
+    gathering.  Block 0 is reserved as the null/padding block: block
+    tables pad with it, attention masks whatever it holds, and
+    scatter-back writes land there harmlessly.  Memory scales with
+    ``num_blocks`` (live tokens), not ``max_slots × max_len``."""
+    c = config
+    dt = dtype or c.dtype
+    shape = (num_blocks, c.n_layers, block_size, c.n_kv_heads,
+             c.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
 def prefill_forward(params: PyTree, tokens: jax.Array,
                     lengths: jax.Array, config: LlamaConfig
                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:

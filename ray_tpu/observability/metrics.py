@@ -332,6 +332,44 @@ def overload_counters():
     })
 
 
+def kv_cache_counters():
+    """The paged-KV serving plane's series (serve/kv_cache.py +
+    serve/llm.py): block-pool occupancy, prefix-cache effectiveness,
+    decode-batch utilization, and KV handoff traffic between
+    disaggregated prefill/decode replicas."""
+    return metric_group("kv_cache", lambda: {
+        "blocks_used": Gauge(
+            "ray_tpu_kv_blocks_used",
+            "KV-cache blocks currently allocated (refcount > 0, "
+            "incl. blocks pinned by the prefix cache)",
+            tag_keys=("pool",)),
+        "blocks_free": Gauge(
+            "ray_tpu_kv_blocks_free",
+            "KV-cache blocks on the free list", tag_keys=("pool",)),
+        "prefix_hits": Counter(
+            "ray_tpu_prefix_cache_hits",
+            "prompt-prefix lookups that reused >= 1 cached block",
+            tag_keys=("pool",)),
+        "prefix_misses": Counter(
+            "ray_tpu_prefix_cache_misses",
+            "prompt-prefix lookups with no cached block",
+            tag_keys=("pool",)),
+        "batch_occupancy": Gauge(
+            "ray_tpu_decode_batch_occupancy",
+            "active slots in the last launched decode chunk",
+            tag_keys=("deployment",)),
+        "kv_handoff_bytes": Counter(
+            "ray_tpu_kv_handoff_bytes",
+            "KV-block bytes handed prefill->decode, by transport "
+            "(shm = same-host channel ring, dcn = striped object "
+            "plane)", tag_keys=("transport",)),
+        "kv_handoffs": Counter(
+            "ray_tpu_kv_handoff_total",
+            "prefill->decode KV handoffs completed, by transport",
+            tag_keys=("transport",)),
+    })
+
+
 def dropped_events_counter() -> Counter:
     """Timeline ring-buffer evictions (observability/timeline.py
     increments this so drops show up in metrics_summary())."""

@@ -45,14 +45,18 @@ class Deployment:
                 max_queued_requests: Optional[int] = None,
                 user_config: Any = None,
                 autoscaling_config: Optional[dict] = None,
-                ray_actor_options: Optional[dict] = None) -> "Deployment":
+                ray_actor_options: Optional[dict] = None,
+                replica_roles: Optional[dict] = None,
+                ingress_role: Optional[str] = None) -> "Deployment":
         cfg = dict(self._config)
         for k, v in (("num_replicas", num_replicas),
                      ("max_ongoing_requests", max_ongoing_requests),
                      ("max_queued_requests", max_queued_requests),
                      ("user_config", user_config),
                      ("autoscaling_config", autoscaling_config),
-                     ("ray_actor_options", ray_actor_options)):
+                     ("ray_actor_options", ray_actor_options),
+                     ("replica_roles", replica_roles),
+                     ("ingress_role", ingress_role)):
             if v is not None:
                 cfg[k] = v
         return Deployment(self._callable, name or self.name, cfg)
@@ -71,7 +75,9 @@ def deployment(_callable=None, *, name: Optional[str] = None,
                max_queued_requests: int = -1,
                user_config: Any = None,
                autoscaling_config: Optional[dict] = None,
-               ray_actor_options: Optional[dict] = None):
+               ray_actor_options: Optional[dict] = None,
+               replica_roles: Optional[dict] = None,
+               ingress_role: Optional[str] = None):
     """``@serve.deployment`` decorator (reference: serve/api.py:246).
 
     ``max_queued_requests`` (reference: serve deployment config of the
@@ -83,7 +89,15 @@ def deployment(_callable=None, *, name: Optional[str] = None,
     ``autoscaling_config`` (reference: serve autoscaling_policy.py):
     ``{"min_replicas", "max_replicas", "target_ongoing_requests",
     "interval_s", "downscale_delay_s"}`` — queue-depth-driven replica
-    count between min and max."""
+    count between min and max.
+
+    ``replica_roles`` (prefill/decode disaggregation):
+    ``{"prefill": 1, "decode": {"num": 2, "ray_actor_options": {...}}}``
+    splits the replica set into roles; the router sends ingress
+    traffic to ``ingress_role`` replicas (default: ``"prefill"`` when
+    one exists), and prefill replicas hand KV blocks to decode peers
+    over the shm ring (same host) or the striped object plane
+    (cross host) — see docs/serving.md."""
 
     def deco(cd):
         return Deployment(cd, name or cd.__name__, {
@@ -93,6 +107,8 @@ def deployment(_callable=None, *, name: Optional[str] = None,
             "user_config": user_config,
             "autoscaling_config": autoscaling_config,
             "ray_actor_options": ray_actor_options,
+            "replica_roles": replica_roles,
+            "ingress_role": ingress_role,
         })
 
     if _callable is not None:
@@ -176,7 +192,9 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     membership = ray_tpu.get(controller.get_membership.remote(name, -1))
     return DeploymentHandle(name, membership["replicas"],
                             controller=controller,
-                            version=membership["version"])
+                            version=membership["version"],
+                            roles=membership.get("roles"),
+                            ingress_role=membership.get("ingress_role"))
 
 
 def status() -> Dict[str, Any]:

@@ -122,13 +122,36 @@ class ServeController:
         self._checkpoint()
         return out
 
+    @staticmethod
+    def _role_plan(config) -> List[Optional[str]]:
+        """The per-replica role sequence a deployment's config asks
+        for: ``replica_roles={"prefill": 1, "decode": 2}`` (values may
+        also be ``{"num": n, "ray_actor_options": {...}}`` for
+        per-role placement) expands to one entry per replica; plain
+        deployments get ``[None] * num_replicas``."""
+        roles = config.get("replica_roles")
+        if not roles:
+            num = max(1, int(config.get("num_replicas", 1)))
+            auto = config.get("autoscaling_config")
+            if auto:
+                num = max(int(auto.get("min_replicas", 1)),
+                          min(num, int(auto.get("max_replicas", num))))
+            return [None] * num
+        plan: List[Optional[str]] = []
+        for role, opts in roles.items():
+            if role not in ("prefill", "decode", "both"):
+                raise ValueError(f"unknown replica role {role!r}")
+            n = int(opts.get("num", 1)) if isinstance(opts, dict) \
+                else int(opts)
+            plan.extend([role] * max(0, n))
+        if not plan:
+            raise ValueError("replica_roles names zero replicas")
+        return plan
+
     def _deploy_locked(self, name, callable_def, init_args,
                        init_kwargs, config):
-        num = max(1, int(config.get("num_replicas", 1)))
-        auto = config.get("autoscaling_config")
-        if auto:
-            num = max(int(auto.get("min_replicas", 1)),
-                      min(num, int(auto.get("max_replicas", num))))
+        plan = self._role_plan(config)
+        num = len(plan)
         spec = {"config": dict(config), "callable": callable_def,
                 "init_args": init_args, "init_kwargs": init_kwargs}
         with self._lock:
@@ -136,12 +159,13 @@ class ServeController:
             version = (existing["version"] + 1) if existing else 1
             if existing is None:
                 self._deployments[name] = {
-                    **spec, "replicas": [], "version": version,
+                    **spec, "replicas": [], "role_by_id": {},
+                    "version": version,
                     "membership_version": 0, "next_replica_id": 0,
                     "last_downscale_ok": time.monotonic()}
         if existing is None:
-            for _ in range(num):
-                self._start_replica(name)
+            for role in plan:
+                self._start_replica(name, role=role)
             with self._lock:
                 n = len(self._deployments[name]["replicas"])
             return {"name": name, "version": version,
@@ -163,23 +187,26 @@ class ServeController:
                 d.update(**spec, version=version)
                 self._bump_membership(name)
             self._stop_replicas(old)
-            for _ in range(num):
-                self._start_replica(name)
+            for role in plan:
+                self._start_replica(name, role=role)
         else:
-            canary = self._construct_replica(name, spec, version, 0)
+            canary = self._construct_replica(name, spec, version, 0,
+                                             role=plan[0])
             with self._lock:
                 d = self._deployments[name]
                 old = list(d["replicas"])
                 d.update(**spec, version=version)
                 d["next_replica_id"] = max(d["next_replica_id"], 1)
                 d["replicas"].append(canary)
+                if plan[0] is not None:
+                    d["role_by_id"][self._replica_key(canary)] = plan[0]
                 self._bump_membership(name)
             # Rolling update (deployment_state.py:1245): one new
             # replica up and healthy, then one old drained and
             # stopped — traffic always has a live target.
             for i in range(num):
                 if i > 0:
-                    self._start_replica(name)
+                    self._start_replica(name, role=plan[i])
                 if old:
                     victim = old.pop(0)
                     with self._lock:
@@ -206,8 +233,13 @@ class ServeController:
             return True
         return bool((opts.get("resources") or {}).get("TPU"))
 
+    @staticmethod
+    def _replica_key(replica):
+        return getattr(replica, "_actor_id", id(replica))
+
     def _construct_replica(self, name: str, spec: Dict[str, Any],
-                           version: int, rid: int):
+                           version: int, rid: int,
+                           role: Optional[str] = None):
         """Create + health-gate one replica from an explicit spec (no
         lock held; the caller publishes it)."""
         import ray_tpu
@@ -215,7 +247,15 @@ class ServeController:
         from .replica import Replica
 
         config = spec["config"]
-        ray_actor_options = config.get("ray_actor_options") or {}
+        ray_actor_options = dict(config.get("ray_actor_options") or {})
+        if role is not None:
+            # Per-role placement: a role entry may carry its own actor
+            # options (e.g. pin decode replicas to the TPU-rich node,
+            # prefill to the CPU-rich one) layered over the shared ones.
+            opts = (config.get("replica_roles") or {}).get(role)
+            if isinstance(opts, dict):
+                ray_actor_options.update(
+                    opts.get("ray_actor_options") or {})
         RemoteReplica = ray_tpu.remote(Replica)
         # Admission control: max_queued_requests bounds the replica's
         # MAILBOX (max_ongoing_requests bounds concurrent execution).
@@ -234,7 +274,7 @@ class ServeController:
             max_pending_calls=max_queued,
             **ray_actor_options,
         ).remote(name, spec["callable"], spec["init_args"],
-                 spec["init_kwargs"])
+                 spec["init_kwargs"], role or "both")
         # Health-gate before routing traffic (reference: replicas must
         # pass initialization before the deployment goes HEALTHY).
         ray_tpu.get(replica.health_check.remote())
@@ -243,7 +283,7 @@ class ServeController:
                 config["user_config"]))
         return replica
 
-    def _start_replica(self, name: str):
+    def _start_replica(self, name: str, role: Optional[str] = None):
         """Create one replica of the deployment's CURRENT spec, wait
         for health (outside the lock), publish it."""
         import ray_tpu
@@ -255,7 +295,8 @@ class ServeController:
             version = d["version"]
             rid = d["next_replica_id"]
             d["next_replica_id"] += 1
-        replica = self._construct_replica(name, spec, version, rid)
+        replica = self._construct_replica(name, spec, version, rid,
+                                          role=role)
         stale = False
         with self._lock:
             d = self._deployments.get(name)
@@ -267,6 +308,8 @@ class ServeController:
                 stale = True
             else:
                 d["replicas"].append(replica)
+                if role is not None:
+                    d["role_by_id"][self._replica_key(replica)] = role
                 self._bump_membership(name)
         if stale:
             self._stop_replicas([replica])
@@ -274,7 +317,13 @@ class ServeController:
         return replica
 
     def _bump_membership(self, name: str):
-        self._deployments[name]["membership_version"] += 1
+        d = self._deployments[name]
+        d["membership_version"] += 1
+        rb = d.get("role_by_id")
+        if rb:
+            live = {self._replica_key(r) for r in d["replicas"]}
+            d["role_by_id"] = {k: v for k, v in rb.items()
+                               if k in live}
 
     # --------------------------------------------------------- membership
     def get_replicas(self, name: str) -> List[Any]:
@@ -296,8 +345,22 @@ class ServeController:
                 raise KeyError(f"no deployment named {name!r}")
             if d["membership_version"] == known_version:
                 return None
-            return {"version": d["membership_version"],
-                    "replicas": list(d["replicas"])}
+            role_by_id = d.get("role_by_id") or {}
+            replicas = list(d["replicas"])
+            out = {"version": d["membership_version"],
+                   "replicas": replicas}
+            if role_by_id:
+                out["roles"] = [
+                    role_by_id.get(self._replica_key(r), "both")
+                    for r in replicas]
+                # Default ingress: prefill replicas front the request
+                # path (they own TTFT); override via config.
+                out["ingress_role"] = d["config"].get(
+                    "ingress_role") or (
+                    "prefill" if any(v == "prefill"
+                                     for v in role_by_id.values())
+                    else None)
+            return out
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:

@@ -279,12 +279,23 @@ class _Router:
     replica set, per-replica outstanding counts, membership version."""
 
     def __init__(self, deployment_name: str, replicas: List[Any],
-                 controller=None, version: int = -1):
+                 controller=None, version: int = -1,
+                 roles: Optional[List[str]] = None,
+                 ingress_role: Optional[str] = None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._version = version
         self._lock = threading.Lock()
         self._replicas = list(replicas)
+        # Disaggregated-serving roles (prefill | decode | both), keyed
+        # like everything else by replica id; ``_ingress_role`` is the
+        # default pick() filter when the caller names none (prefill
+        # replicas front a disaggregated LLM deployment).
+        self._roles: Dict[Any, str] = {}
+        if roles:
+            for r, role in zip(self._replicas, roles):
+                self._roles[self._key(r)] = role
+        self._ingress_role = ingress_role
         # Keyed by replica actor id so counts survive membership swaps.
         self._outstanding: Dict[Any, int] = {
             self._key(r): 0 for r in self._replicas}
@@ -447,6 +458,12 @@ class _Router:
         with self._lock:
             self._version = update["version"]
             self._replicas = list(update["replicas"])
+            roles = update.get("roles")
+            self._roles = ({self._key(r): role for r, role
+                            in zip(self._replicas, roles)}
+                           if roles else {})
+            if "ingress_role" in update:
+                self._ingress_role = update["ingress_role"]
             fresh = {}
             for r in self._replicas:
                 k = self._key(r)
@@ -510,43 +527,57 @@ class _Router:
             if b is not None:
                 b.probing = False
 
-    def pick(self, model_id: str = ""):
+    def _role_ok(self, key, role: Optional[str]) -> bool:
+        """Role gate: a requested role matches replicas of that role
+        or of role "both"; unknown replicas (no role info) pass."""
+        if role is None:
+            return True
+        have = self._roles.get(key)
+        return have is None or have == role or have == "both"
+
+    def pick(self, model_id: str = "", role: Optional[str] = None):
         """Power-of-two-choices on outstanding + reported queue depth,
-        with a model-affinity tier for multiplexed requests and a
-        circuit-breaker gate; returns (replica, key)."""
+        with a model-affinity tier for multiplexed requests, a
+        circuit-breaker gate, and (disaggregated deployments) a
+        replica-role filter; returns (replica, key)."""
         self._maybe_refresh()
         now = time.monotonic()
         with self._lock:
-            if not self._replicas:
+            if role is None:
+                role = self._ingress_role
+            pool = [r for r in self._replicas
+                    if self._role_ok(self._key(r), role)]
+            if not pool:
                 raise NoLiveReplicasError(
                     f"deployment {self.deployment_name!r} has no live "
-                    f"replicas")
+                    f"replicas"
+                    + (f" of role {role!r}" if role else ""))
             if model_id:
-                by_key = {self._key(r): r for r in self._replicas}
+                by_key = {self._key(r): r for r in pool}
                 k = self._model_affinity.get(model_id)
                 if k in by_key and self._admissible(k, now):
                     least = min(self._score(self._key(r))
-                                for r in self._replicas)
+                                for r in pool)
                     if self._score(k) <= least + self._AFFINITY_SLACK:
                         self._mark_probe_if_open(k)
                         self._outstanding[k] = \
                             self._outstanding.get(k, 0) + 1
                         return by_key[k], k
-            candidates = [i for i, r in enumerate(self._replicas)
+            candidates = [i for i, r in enumerate(pool)
                           if self._admissible(self._key(r), now)]
             if not candidates:
                 # Every replica's breaker is open and cooling: degrade
                 # to least-loaded rather than failing outright (the
                 # breaker is an avoidance bias, not an outage switch).
-                candidates = list(range(len(self._replicas)))
+                candidates = list(range(len(pool)))
             if len(candidates) == 1:
                 idx = candidates[0]
             else:
                 a, b = random.sample(candidates, 2)
-                ka = self._key(self._replicas[a])
-                kb = self._key(self._replicas[b])
+                ka = self._key(pool[a])
+                kb = self._key(pool[b])
                 idx = a if self._score(ka) <= self._score(kb) else b
-            replica = self._replicas[idx]
+            replica = pool[idx]
             k = self._key(replica)
             self._mark_probe_if_open(k)
             if model_id:
@@ -582,14 +613,22 @@ class DeploymentHandle:
                  method_name: str = "", controller=None,
                  version: int = -1, _router: Optional[_Router] = None,
                  stream: bool = False, multiplexed_model_id: str = "",
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 roles: Optional[List[str]] = None,
+                 ingress_role: Optional[str] = None,
+                 role: Optional[str] = None):
         self.deployment_name = deployment_name
         self._router = _router or _Router(deployment_name, replicas,
-                                          controller, version)
+                                          controller, version,
+                                          roles=roles,
+                                          ingress_role=ingress_role)
         self._method = method_name
         self._stream = stream
         self._model_id = multiplexed_model_id
         self._deadline_s = deadline_s
+        # Explicit replica-role target for this view (None = the
+        # deployment's ingress default).
+        self._role = role
 
     # -- calls -------------------------------------------------------------
     def remote(self, *args, **kwargs):
@@ -699,7 +738,8 @@ class DeploymentHandle:
         rejections = 0
         for attempt in range(_DEAD_REPLICA_RETRIES + 1):
             try:
-                replica, key = self._router.pick(self._model_id)
+                replica, key = self._router.pick(self._model_id,
+                                                 role=self._role)
             except NoLiveReplicasError:
                 # Router drained by mark_dead: ride out the window
                 # until the controller's health check repopulates the
@@ -765,7 +805,8 @@ class DeploymentHandle:
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
-                deadline_s: Optional[float] = None
+                deadline_s: Optional[float] = None,
+                role: Optional[str] = None
                 ) -> "DeploymentHandle":
         # Views share the router, so balance and membership are global
         # across method-scoped views of the same handle.
@@ -778,7 +819,8 @@ class DeploymentHandle:
                                   if multiplexed_model_id is None
                                   else multiplexed_model_id),
             deadline_s=(self._deadline_s if deadline_s is None
-                        else deadline_s))
+                        else deadline_s),
+            role=self._role if role is None else role)
 
     @property
     def method(self):

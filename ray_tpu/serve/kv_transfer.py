@@ -1,0 +1,242 @@
+"""KV-block handoff between disaggregated prefill and decode replicas.
+
+The disaggregation data path (DistServe/Splitwise shape): a prefill
+replica computes a request's KV blocks, then hands them to a decode
+replica so long prompts never stall the decode stream.  Transport is
+picked per (prefill, decode) pair by HOST locality:
+
+- **same host** → the PR 1 shm channel ring: the decode replica mints
+  one SPSC ring per prefill peer (``kv_endpoint``), the prefill side
+  writes ``KVBlockFrame``s (pickled block-table meta + raw block
+  slabs, one memcpy into slot memory), the decode side rebuilds
+  zero-copy views and scatters into its own pool.
+- **cross host** → the PR 6 striped object plane: the block slabs ride
+  ``ray_tpu.put`` (device-native v2 wire frames, adaptive multi-stream
+  chunk pulls), and the decode replica materializes the primary copy
+  over the striped raw-socket path.
+
+Delivery is counted in ``ray_tpu_kv_handoff_{total,bytes}{transport=}``
+on the RECEIVING side (proof the bytes arrived over that transport,
+not just that a sender picked it).
+
+Frames can land out of order relative to the ``decode_ingest`` RPCs
+that announce them (the prefill replica serves many requests
+concurrently), so the receiver buffers frames by request id.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _kv_metrics():
+    from ..observability.metrics import kv_cache_counters
+
+    return kv_cache_counters()
+
+
+def _count_handoff(transport: str, nbytes: int) -> None:
+    try:
+        m = _kv_metrics()
+        tags = {"transport": transport}
+        m["kv_handoffs"].inc(tags=tags)
+        m["kv_handoff_bytes"].inc(int(nbytes), tags=tags)
+    except Exception:
+        pass
+
+
+def local_node_id() -> Optional[str]:
+    """This process's cluster node id, or None in local (single-node)
+    mode — two Nones compare as co-located, which is correct there."""
+    import ray_tpu
+
+    try:
+        rt = ray_tpu.get_runtime()
+    except Exception:
+        return None
+    cluster = getattr(rt, "cluster", None)
+    return getattr(cluster, "node_id", None)
+
+
+class KVSender:
+    """Prefill-side half.  One instance per LLM engine; per-target
+    transport state (ring writers) is cached by the decode replica's
+    endpoint descriptor."""
+
+    def __init__(self, slot_bytes_hint: int = 0):
+        self._node = None
+        self._node_resolved = False
+        self._writers: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        # One writer THREAD per SPSC ring at a time: the prefill
+        # replica hands off many requests concurrently, and interleaved
+        # put_parts on one ring corrupt frames.
+        self._send_locks: Dict[str, threading.Lock] = {}
+        self._slot_bytes_hint = int(slot_bytes_hint)
+
+    def _local_node(self):
+        if not self._node_resolved:
+            self._node = local_node_id()
+            self._node_resolved = True
+        return self._node
+
+    def transport_for(self, endpoint: Dict[str, Any]) -> str:
+        return ("shm" if endpoint.get("node") == self._local_node()
+                else "dcn")
+
+    def send(self, endpoint: Dict[str, Any], req_id: str,
+             pool_k: np.ndarray, pool_v: np.ndarray,
+             block_ids) -> Dict[str, Any]:
+        """Ship ``block_ids``' K/V to the decode replica described by
+        ``endpoint`` (``{"node": ..., "ring": path}``).  Returns the
+        handoff descriptor the decode replica's ``decode_ingest``
+        resolves with :meth:`KVReceiver.recv`."""
+        from ..cluster.serialization import export_kv_blocks
+
+        meta, bufs = export_kv_blocks(pool_k, pool_v, block_ids)
+        meta["req"] = req_id
+        if self.transport_for(endpoint) == "shm":
+            from ..experimental.channel import ChannelWriter
+
+            ring = endpoint["ring"]
+            with self._lock:
+                w = self._writers.get(ring)
+                if w is None:
+                    w = self._writers[ring] = ChannelWriter(
+                        ring, n_slots=8,
+                        slot_bytes=self._slot_bytes_hint)
+                slock = self._send_locks.setdefault(
+                    ring, threading.Lock())
+            with slock:
+                w.put_kv_blocks(meta, bufs)
+            return {"transport": "shm", "ring": ring, "req": req_id}
+        # Cross-host: the striped object plane carries the slabs.  The
+        # export views alias the live pool (donated away by the next
+        # device call), so the sealed copy put() takes is mandatory
+        # here, not overhead.
+        import ray_tpu
+
+        k = np.stack([pool_k[b] for b in block_ids])
+        v = np.stack([pool_v[b] for b in block_ids])
+        ref = ray_tpu.put({"meta": meta, "k": k, "v": v})
+        return {"transport": "dcn", "ref": ref, "req": req_id,
+                "nbytes": int(k.nbytes + v.nbytes)}
+
+    def close(self) -> None:
+        with self._lock:
+            writers, self._writers = dict(self._writers), {}
+        for w in writers.values():
+            try:
+                w.destroy()
+            except Exception:
+                pass
+
+
+class KVReceiver:
+    """Decode-side half: resolves a handoff descriptor into
+    ``(k_blocks, v_blocks)`` host arrays ready to scatter into the
+    local pool, counting delivery per transport."""
+
+    # Out-of-order frames parked for ingest RPCs that haven't arrived
+    # yet.  Bounded drop-oldest: an orphan frame (its prefill replica
+    # died between the ring write and the ingest RPC) must not pin KV
+    # copies forever.
+    _STASH_MAX = 128
+
+    def __init__(self, read_timeout: float = 60.0):
+        self._readers: Dict[str, Any] = {}
+        # Frames read off a ring ahead of their ingest RPC, by req id.
+        self._stash: Dict[str, Tuple[np.ndarray, np.ndarray, int]] = {}
+        self._lock = threading.Lock()
+        # One reader thread per SPSC ring at a time; waiters poll the
+        # stash (the current reader may pull THEIR frame off the ring).
+        self._ring_locks: Dict[str, threading.Lock] = {}
+        self._timeout = read_timeout
+
+    def recv(self, handoff: Dict[str, Any]
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        if handoff["transport"] == "dcn":
+            import ray_tpu
+
+            payload = ray_tpu.get(handoff["ref"],
+                                  timeout=self._timeout)
+            k, v = payload["k"], payload["v"]
+            _count_handoff("dcn", k.nbytes + v.nbytes)
+            return k, v
+        return self._recv_ring(handoff["ring"], handoff["req"])
+
+    def _recv_ring(self, ring: str, req_id: str
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        from ..cluster.serialization import kv_blocks_from_wire
+        from ..experimental.channel import ChannelReader, KVBlockFrame
+
+        from ..exceptions import ChannelError
+
+        with self._lock:
+            reader = self._readers.get(ring)
+            if reader is None:
+                reader = self._readers[ring] = ChannelReader(
+                    ring, timeout=self._timeout)
+            rlock = self._ring_locks.setdefault(ring,
+                                                threading.Lock())
+        # Overall deadline: reader.get_value only bounds an IDLE ring
+        # — on a busy ring a request whose frame was lost (stash
+        # eviction, sender death between write and RPC) would
+        # otherwise spin here forever.
+        deadline = time.monotonic() + self._timeout
+        while True:
+            if time.monotonic() > deadline:
+                raise ChannelError(
+                    f"KV frame for request {req_id} not delivered "
+                    f"within {self._timeout:.0f}s",
+                    context={"ring": ring, "req": req_id})
+            with self._lock:
+                hit = self._stash.pop(req_id, None)
+            if hit is not None:
+                _count_handoff("shm", hit[2])
+                return hit[0], hit[1]
+            # Only one ingest thread drains the SPSC ring at a time;
+            # the others poll the stash — the draining thread may pull
+            # THEIR frame and park it there.
+            if not rlock.acquire(timeout=0.05):
+                continue
+            try:
+                with self._lock:
+                    hit = self._stash.pop(req_id, None)
+                if hit is not None:
+                    _count_handoff("shm", hit[2])
+                    return hit[0], hit[1]
+                frame = reader.get_value()
+                if isinstance(frame, KVBlockFrame):
+                    k, v = kv_blocks_from_wire(frame.meta, frame.data)
+                    got = frame.meta.get("req")
+                else:
+                    raise TypeError(
+                        f"unexpected frame on KV ring: {type(frame)}")
+                if got == req_id:
+                    _count_handoff("shm", k.nbytes + v.nbytes)
+                    return k, v
+                # Out-of-order arrival: park a private copy for the
+                # ingest call it belongs to (copies, so lifetime is
+                # independent of the frame buffer).
+                with self._lock:
+                    self._stash[got] = (np.array(k), np.array(v),
+                                        int(k.nbytes + v.nbytes))
+                    while len(self._stash) > self._STASH_MAX:
+                        self._stash.pop(next(iter(self._stash)))
+            finally:
+                rlock.release()
+
+    def close(self) -> None:
+        with self._lock:
+            readers, self._readers = dict(self._readers), {}
+            self._stash.clear()
+        for r in readers.values():
+            try:
+                r.close()
+            except Exception:
+                pass

@@ -9,32 +9,59 @@ whose per-call host↔device round trip is tens of milliseconds:
   greedy steps run inside a single device call (lax.scan feeding the
   argmax back in-graph), so the round-trip cost amortizes over
   chunk × B tokens.
-- The attended/updated cache prefix is BUCKETED (static slice to the
-  smallest bucket covering every active slot's position): cache
-  traffic scales with live occupancy, not max_len.  Measured
-  end-to-end (BENCH_r05, 125M model, max_slots=112, 24-token prompts,
-  32 new tokens): 4,098 decode tok/s sustained at saturation — the
-  whole-request number, including prefill admission and host
-  scheduling, not a decode-chunk microbenchmark.  Decode-chunk-only
-  rates run higher (the bucketing win over an unbucketed cache read is
-  ~2-3x at low occupancy); quote the bench number.
+- TWO memory planes share the scheduler.  The legacy DENSE plane keeps
+  a per-slot cache region (memory = max_slots × max_len) with the
+  attended prefix BUCKETED to the smallest static slice covering every
+  active slot.  The PAGED plane (``paged=True``; Orca OSDI '22 +
+  vLLM SOSP '23) replaces it with a block pool: fixed
+  ``block_size``-token blocks, a per-request block table feeding a
+  block-GATHERING attention read (static block-count buckets replace
+  the prefix buckets), free-list allocation with typed
+  ``BackPressureError`` exhaustion, and copy-on-write prefix sharing —
+  identical system prompts map to shared refcounted blocks through a
+  hash-trie prefix cache (``serve/kv_cache.py``), so a warm prompt
+  prefills only its suffix.  Decode tokens are BIT-IDENTICAL across
+  the two planes (tests/test_kv_cache.py parity gate): the gathered
+  block layout equals the dense layout position-for-position, and the
+  cold prefill path runs the same ``prefill_forward`` computation.
 - Cache rows are written with a masked select, not per-slot scatters
   (XLA TPU serializes scatters; the masked write is bandwidth-bound).
+  The paged plane scatters whole BLOCKS back (block-granular indices,
+  the layout XLA handles well), mirroring the dense plane's
+  slice-update of the attended prefix.
 - Prefill runs plain causal attention WITHIN the prompt (no cache
-  read), inserts K/V via a one-hot slot projection at static offsets,
-  and returns the FIRST generated token directly — TTFT costs one
-  prefill call, not prefill + a decode round trip.
+  read), inserts K/V via a one-hot slot projection (dense) or a
+  block-table scatter (paged) at static offsets, and returns the
+  FIRST generated token directly — TTFT costs one prefill call, not
+  prefill + a decode round trip.  A paged prefix-cache hit instead
+  runs the WARM path: the suffix attends gathered cached blocks +
+  itself, skipping recompute of the shared prefix entirely.
+- ITERATION-LEVEL SCHEDULING: requests join and leave the running
+  batch at chunk boundaries.  Admission is earliest-deadline-first
+  over the backlog (arrival order breaks ties, so no-deadline traffic
+  keeps FIFO semantics); work whose budget is already blown — or
+  provably cannot finish inside it at the measured decode rate — is
+  shed TYPED (``DeadlineExceededError``) before touching the device,
+  and pool exhaustion preempts the latest-deadline running request
+  (recompute-on-readmit) instead of OOMing.
 - ONE-DEEP PIPELINE: the scheduler launches chunk N+1 (with
   device-resident token/length carries, plus host overrides for newly
   admitted slots) BEFORE materializing chunk N's tokens, so host
-  bookkeeping and device compute overlap.  Slot reuse is safe: a
-  reassigned slot's prefill is queued behind the in-flight chunk on
-  the device stream, and every cache row is rewritten before it is
-  first attended.
-- Params are cast to the compute dtype once at init (per-use casts in
-  the forward become no-ops; numerics identical, bytes halved).
-- All (group, bucket) prefill shapes and all decode buckets are
-  compiled at init (warmup=True) so no request ever pays a compile.
+  bookkeeping and device compute overlap.
+- PREFILL/DECODE DISAGGREGATION: ``role="prefill"`` replicas compute
+  KV blocks and first tokens, then hand the blocks to a
+  ``role="decode"`` peer (same-host: shm channel ring; cross-host:
+  striped object plane — ``serve/kv_transfer.py``), so decode replicas
+  never stall behind long prompts.  ``role="both"`` (default) serves
+  end-to-end.
+- Params are cast to the compute dtype once at init; all prefill
+  shapes and decode buckets are compiled at init (warmup=True) so no
+  request ever pays a compile.
+
+Measured end-to-end (BENCH_r05, dense plane, 125M model,
+max_slots=112, 24-token prompts, 32 new tokens): 4,098 decode tok/s
+sustained at saturation — the whole-request number, including prefill
+admission and host scheduling.
 """
 
 from __future__ import annotations
@@ -42,9 +69,13 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..core import deadlines as _deadlines
+from ..exceptions import BackPressureError, DeadlineExceededError
 
 # Prefill group sizes (prompts per call, padded with slot=-1).  Each
 # call costs a device round trip serialized against decode chunks, so
@@ -54,13 +85,41 @@ import numpy as np
 # latency).  Each size × prompt bucket is one compile, warmed at init.
 PREFILL_GROUPS = (4, 32)
 
+# How aggressively the feasibility shed fires: a request is shed when
+# its remaining budget is under this fraction of the ESTIMATED time to
+# finish (measured chunk/prefill EMAs).  < 1.0 biases toward admitting
+# — a false shed wastes a request that might have made it.
+_FEASIBILITY_MARGIN = 0.6
+# A request whose budget is within this multiple of its service time
+# is LATENCY-SENSITIVE: it is additionally shed when the estimated
+# queue delay alone exceeds ~one service time (DAGOR-style early
+# shedding — bounding the admitted stream's queueing delay is what
+# keeps admitted p99 TTFT flat at 2x saturation; requests with
+# generous budgets are allowed to queue up to the feasibility bound
+# instead).
+_QUEUE_TIGHT_X = 10.0
+
+
+def _shed_counter(where: str) -> None:
+    try:
+        from ..observability.metrics import overload_counters
+
+        overload_counters()["expired_shed"].inc(tags={"where": where})
+    except Exception:
+        pass
+
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "event", "tokens",
                  "t_submit", "t_first_token", "error", "done",
-                 "on_done")
+                 "on_done", "deadline", "arrival", "want_kv", "kv",
+                 "preseed", "rid")
 
-    def __init__(self, prompt: List[int], max_new_tokens: int):
+    _arrival_counter = 0
+    _arrival_lock = threading.Lock()
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 deadline: Optional[float] = None):
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.event = threading.Event()
@@ -73,6 +132,19 @@ class _Request:
         # waiters must not burn an executor thread each (the default
         # pool has ~32 workers; 64+ concurrent requests starve it).
         self.on_done: Optional[Any] = None
+        # Absolute end-to-end deadline (epoch s) or None; EDF admission
+        # key, tie-broken by arrival so deadline-free traffic is FIFO.
+        self.deadline = deadline
+        with _Request._arrival_lock:
+            _Request._arrival_counter += 1
+            self.arrival = _Request._arrival_counter
+        # Disaggregation: prefill-role extraction request (keep the KV
+        # blocks on finish) / decode-role pre-seeded request (KV blocks
+        # arrive via handoff, skip prefill).
+        self.want_kv = False
+        self.kv: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.preseed: Optional[Dict[str, Any]] = None
+        self.rid = uuid.uuid4().hex[:16]
 
     def finish_notify(self):
         self.event.set()
@@ -94,12 +166,21 @@ class LLMServer:
                  max_slots: int = 64, max_len: int = 512,
                  prefill_buckets=(32, 64, 128, 256), params=None,
                  decode_chunk: int = 16, seed: int = 0,
-                 warmup: bool = True):
+                 warmup: bool = True, paged: bool = False,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 role: str = "both",
+                 serve_deployment: Optional[str] = None,
+                 prefill_groups: Optional[Tuple[int, ...]] = None):
         import jax
         import jax.numpy as jnp
 
         from ray_tpu.models import llama
 
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r}")
+        if role != "both" and not paged:
+            raise ValueError("prefill/decode disaggregation requires "
+                             "the paged KV plane (paged=True)")
         preset = getattr(llama.LlamaConfig, model_preset)
         self.cfg = preset(max_seq_len=max_len)
         self.max_slots = max_slots
@@ -107,6 +188,13 @@ class LLMServer:
         self.buckets = tuple(sorted(b for b in prefill_buckets
                                     if b <= max_len))
         self.decode_chunk = max(1, int(decode_chunk))
+        self.paged = bool(paged)
+        self.role = role
+        self._deployment = serve_deployment
+        # Prefill group ladder (compile-matrix knob: each size × bucket
+        # × {cold, warm} is one warmed compile).
+        self.prefill_groups = tuple(sorted(
+            prefill_groups or PREFILL_GROUPS))
         # Attended-prefix buckets: powers of two from the smallest
         # prefill bucket up to max_len.
         dbs = []
@@ -123,7 +211,6 @@ class LLMServer:
         self.params = jax.tree.map(
             lambda x: x.astype(self.cfg.dtype)
             if x.dtype == jnp.float32 else x, params)
-        self.cache = llama.init_kv_cache(self.cfg, max_slots, max_len)
 
         # Host-authoritative slot state (device carries mirror it
         # between chunk launches).
@@ -134,6 +221,55 @@ class LLMServer:
         # override token lands.
         self.slot_waiting = np.zeros(max_slots, bool)
 
+        if self.paged:
+            self._init_paged(block_size, num_blocks, llama, jax, jnp)
+        else:
+            self.cache = llama.init_kv_cache(self.cfg, max_slots,
+                                             max_len)
+            self._build_dense(llama, jax, jnp)
+
+        self._jnp = jnp
+        # Device-resident carries between chunk launches.
+        self._tok_dev = jnp.zeros(max_slots, jnp.int32)
+        self._len_dev = jnp.zeros(max_slots, jnp.int32)
+        # Host overrides applied at the next chunk launch.
+        self._ov_tok = np.zeros(max_slots, np.int32)
+        self._ov_len = np.zeros(max_slots, np.int32)
+        self._ov_mask = np.zeros(max_slots, bool)
+        # Prefill results pending first-token extraction:
+        # (first_tokens_devicearray, [(group_index, slot, req)], t0).
+        self._pending_prefills: List[tuple] = []
+        # Rate estimators feeding the feasibility shed (EMA seconds).
+        self._chunk_ema: Optional[float] = None
+        self._prefill_ema: Optional[float] = None
+
+        if warmup:
+            self._warmup()
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # Engine ingress bound: the serve replica mailbox
+        # (max_queued_requests) is the first line, but the engine's own
+        # queue must also reject typed rather than grow without bound
+        # (deadline-free traffic never sheds at admission).
+        self._queue_cap = max(64, 8 * self.max_slots)
+        # EDF backlog: queued requests drained here and admitted at
+        # chunk boundaries in (deadline, arrival) order.
+        self._backlog: List[_Request] = []
+        self._stop = threading.Event()
+        # Disaggregation plumbing (lazy: only paid when role != both).
+        self._kv_sender = None
+        self._kv_receiver = None
+        self._kv_rings: Dict[str, str] = {}
+        self._kv_lock = threading.Lock()
+        self._decode_targets: List[Any] = []
+        self._decode_rr = 0
+        self._decode_refresh = 0.0
+        self._membership_version = -1
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------- dense plane
+    def _build_dense(self, llama, jax, jnp):
         cfg = self.cfg
 
         def prefill(params, cache, tokens, lengths, slots):
@@ -150,46 +286,8 @@ class LLMServer:
             ck = jax.lax.slice_in_dim(cache["k"], 0, s_active, axis=2)
             cv = jax.lax.slice_in_dim(cache["v"], 0, s_active, axis=2)
             key_pos = jnp.arange(s_active, dtype=jnp.int32)
-
-            def step(carry, _):
-                ck, cv, tok, lens = carry
-                dt = cfg.dtype
-                x = params["embed_tokens"].astype(dt)[tok][:, None]
-                sin, cos = llama.rope_table(lens[:, None], cfg.head_dim,
-                                            cfg.rope_theta)
-                # Inactive slots MUST not write: a just-admitted slot's
-                # prefill may already have landed (it sits out this
-                # chunk awaiting its first token) and a stale-position
-                # write would corrupt its fresh rows.
-                writemask = ((key_pos[None, :] == lens[:, None])
-                             & active[:, None])[:, :, None, None]
-                scale = cfg.head_dim ** -0.5
-
-                def body(x, layer_and_cache):
-                    layer, ck_l, cv_l = layer_and_cache
-                    q, kk, vv = llama._qkv_rope(x, layer, sin, cos, cfg)
-                    ck_l = jnp.where(writemask, kk.astype(ck_l.dtype),
-                                     ck_l)
-                    cv_l = jnp.where(writemask, vv.astype(cv_l.dtype),
-                                     cv_l)
-                    attn = llama._cache_attend(q, ck_l, cv_l,
-                                               lens[:, None], scale)
-                    x = llama._attn_out_mlp(x, attn, layer, cfg)
-                    return x, (ck_l, cv_l)
-
-                x, (ck, cv) = jax.lax.scan(
-                    lambda x, i: body(x, i), x,
-                    (params["layers"], ck, cv))
-                x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
-                head = (params["embed_tokens"].astype(cfg.dtype).T
-                        if cfg.tie_embeddings
-                        else params["lm_head"].astype(cfg.dtype))
-                logits = llama.matmul(x, head)[:, 0]
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                nxt = jnp.where(active, nxt, tok)
-                lens = lens + active.astype(jnp.int32)
-                return (ck, cv, nxt, lens), nxt
-
+            step = self._make_decode_step(params, key_pos, active,
+                                          llama, jax, jnp)
             (ck, cv, tok, lens), toks = jax.lax.scan(
                 step, (ck, cv, tok, lens), None, length=k)
             cache = {
@@ -203,59 +301,280 @@ class LLMServer:
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
         self._decode_k = jax.jit(decode_k, donate_argnums=(1,),
                                  static_argnames=("k", "s_active"))
-        self._jnp = jnp
-        # Device-resident carries between chunk launches.
-        self._tok_dev = jnp.zeros(max_slots, jnp.int32)
-        self._len_dev = jnp.zeros(max_slots, jnp.int32)
-        # Host overrides applied at the next chunk launch.
-        self._ov_tok = np.zeros(max_slots, np.int32)
-        self._ov_len = np.zeros(max_slots, np.int32)
-        self._ov_mask = np.zeros(max_slots, bool)
-        # Prefill results pending first-token extraction:
-        # (first_tokens_devicearray, [(group_index, slot, req)]).
-        self._pending_prefills: List[Tuple[Any, List[tuple]]] = []
 
-        if warmup:
-            self._warmup()
+    def _make_decode_step(self, params, key_pos, active, llama, jax,
+                          jnp):
+        """The shared per-token decode step (scan body): masked-select
+        K/V write at each slot's current position, bucketed cache
+        attention, greedy argmax fed back in-graph.  IDENTICAL math for
+        the dense slice and the paged gathered layout — block ordering
+        makes gathered index == absolute position, which is what keeps
+        the two planes' tokens bit-identical."""
+        cfg = self.cfg
 
-        self._queue: "queue.Queue[_Request]" = queue.Queue()
-        # Request dequeued by the idle wait, consumed by the next
-        # _admit_wave ahead of the queue (re-enqueueing at the tail
-        # would reorder FIFO admission).
-        self._idle_stash: Optional[_Request] = None
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        def step(carry, _):
+            ck, cv, tok, lens = carry
+            dt = cfg.dtype
+            x = params["embed_tokens"].astype(dt)[tok][:, None]
+            sin, cos = llama.rope_table(lens[:, None], cfg.head_dim,
+                                        cfg.rope_theta)
+            # Inactive slots MUST not write: a just-admitted slot's
+            # prefill may already have landed (it sits out this
+            # chunk awaiting its first token) and a stale-position
+            # write would corrupt its fresh rows.
+            writemask = ((key_pos[None, :] == lens[:, None])
+                         & active[:, None])[:, :, None, None]
+            scale = cfg.head_dim ** -0.5
 
+            def body(x, layer_and_cache):
+                layer, ck_l, cv_l = layer_and_cache
+                q, kk, vv = llama._qkv_rope(x, layer, sin, cos, cfg)
+                ck_l = jnp.where(writemask, kk.astype(ck_l.dtype),
+                                 ck_l)
+                cv_l = jnp.where(writemask, vv.astype(cv_l.dtype),
+                                 cv_l)
+                attn = llama._cache_attend(q, ck_l, cv_l,
+                                           lens[:, None], scale)
+                x = llama._attn_out_mlp(x, attn, layer, cfg)
+                return x, (ck_l, cv_l)
+
+            x, (ck, cv) = jax.lax.scan(
+                lambda x, i: body(x, i), x,
+                (params["layers"], ck, cv))
+            x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+            head = (params["embed_tokens"].astype(cfg.dtype).T
+                    if cfg.tie_embeddings
+                    else params["lm_head"].astype(cfg.dtype))
+            logits = llama.matmul(x, head)[:, 0]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, tok)
+            lens = lens + active.astype(jnp.int32)
+            return (ck, cv, nxt, lens), nxt
+
+        return step
+
+    # ------------------------------------------------------- paged plane
+    def _init_paged(self, block_size, num_blocks, llama, jax, jnp):
+        from .kv_cache import KVBlockAllocator, PrefixCache
+
+        cfg = self.cfg
+        bs = int(block_size)
+        if bs < 1:
+            raise ValueError("block_size must be >= 1")
+        self.block_size = bs
+        max_blocks_per_req = -(-self.max_len // bs)
+        if num_blocks is None:
+            # Capacity parity with the dense plane by default; size it
+            # DOWN for the memory win once the workload shape is known
+            # (prefix sharing usually covers the difference).
+            num_blocks = 1 + self.max_slots * max_blocks_per_req
+        self.num_blocks = int(num_blocks)
+        # Out-of-range PAD index: gathers clip (garbage, masked),
+        # scatters drop (no write) — block-table padding never touches
+        # live blocks.
+        self._pad_block = self.num_blocks
+        self.allocator = KVBlockAllocator(
+            self.num_blocks, bs,
+            pool_label=self._deployment or "llm")
+        self.prefix_cache = PrefixCache(self.allocator)
+        self.slot_table: List[Optional[Any]] = [None] * self.max_slots
+        self.pool = llama.init_paged_kv_cache(cfg, self.num_blocks, bs)
+        # Block-count buckets: the paged analogue of the dense
+        # attended-prefix buckets (one decode compile per bucket).
+        self._nb_buckets = tuple(sorted(
+            {-(-b // bs) for b in self.decode_buckets}))
+        # Warm-prefill prefix buckets: one static gather width.
+        self._np_max = max(1, (max(self.buckets) - 1) // bs)
+
+        def gather(pool_t, bt):
+            N, L, bsz, Hkv, D = pool_t.shape
+            B, nb = bt.shape
+            g = jnp.take(pool_t, bt.reshape(-1), axis=0, mode="clip")
+            g = g.reshape(B, nb, L, bsz, Hkv, D)
+            return g.transpose(2, 0, 1, 3, 4, 5).reshape(
+                L, B, nb * bsz, Hkv, D)
+
+        def scatter(pool_t, bt, g):
+            N, L, bsz, Hkv, D = pool_t.shape
+            B, nb = bt.shape
+            u = g.reshape(L, B, nb, bsz, Hkv, D).transpose(
+                1, 2, 0, 3, 4, 5)
+            return pool_t.at[bt.reshape(-1)].set(
+                u.reshape(B * nb, L, bsz, Hkv, D), mode="drop")
+
+        def rows_to_blocks(rows, nw):
+            # (L, G, Ppad, H, D) -> (G*nw, L, bs, H, D) scatter updates
+            L, G, Ppad, Hkv, D = rows.shape
+            u = rows.transpose(1, 0, 2, 3, 4).reshape(
+                G, L, nw, bs, Hkv, D)
+            return u.transpose(0, 2, 1, 3, 4, 5).reshape(
+                G * nw, L, bs, Hkv, D)
+
+        def pad_rows(rows, nw):
+            L, G, P, Hkv, D = rows.shape
+            if P == nw * bs:
+                return rows
+            return jnp.pad(rows, ((0, 0), (0, 0), (0, nw * bs - P),
+                                  (0, 0), (0, 0)))
+
+        def prefill_cold(params, pool, tokens, lengths, write_bt):
+            # Same computation as the dense plane's prefill (bit-equal
+            # first tokens + K/V rows); only the insert differs.
+            last_logits, ks, vs = llama.prefill_forward(
+                params, tokens, lengths, cfg)
+            nw = write_bt.shape[1]
+            flat = write_bt.reshape(-1)
+            pool = {
+                "k": pool["k"].at[flat].set(
+                    rows_to_blocks(pad_rows(ks, nw), nw), mode="drop"),
+                "v": pool["v"].at[flat].set(
+                    rows_to_blocks(pad_rows(vs, nw), nw), mode="drop"),
+            }
+            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            return pool, first
+
+        def prefill_warm(params, pool, tokens, lengths, pos0,
+                         prefix_bt, write_bt):
+            # Prefix-cache hit: the SUFFIX attends the gathered shared
+            # blocks plus itself — the shared prefix is never
+            # recomputed (the whole point of COW prefix sharing).
+            G, P = tokens.shape
+            Sp = prefix_bt.shape[1] * bs
+            dt = cfg.dtype
+            positions = pos0[:, None] + jnp.arange(
+                P, dtype=jnp.int32)[None, :]
+            sin, cos = llama.rope_table(positions, cfg.head_dim,
+                                        cfg.rope_theta)
+            x = params["embed_tokens"].astype(dt)[tokens]
+            ckp = gather(pool["k"], prefix_bt)
+            cvp = gather(pool["v"], prefix_bt)
+            prefix_pos = jnp.arange(Sp, dtype=jnp.int32)
+            key_abs = jnp.concatenate(
+                [jnp.broadcast_to(prefix_pos[None, :], (G, Sp)),
+                 positions], axis=1)
+            key_valid = jnp.concatenate(
+                [prefix_pos[None, :] < pos0[:, None],
+                 jnp.ones((G, P), bool)], axis=1)
+            scale = cfg.head_dim ** -0.5
+
+            def body(x, layer_and_prefix):
+                layer, ckp_l, cvp_l = layer_and_prefix
+                q, k, v = llama._qkv_rope(x, layer, sin, cos, cfg)
+                keys = jnp.concatenate(
+                    [ckp_l, k.astype(ckp_l.dtype)], axis=1)
+                vals = jnp.concatenate(
+                    [cvp_l, v.astype(cvp_l.dtype)], axis=1)
+                attn = _masked_attend(q, keys, vals, positions,
+                                      key_abs, key_valid, scale, jnp,
+                                      jax)
+                x = llama._attn_out_mlp(x, attn, layer, cfg)
+                return x, (k, v)
+
+            x, (ks, vs) = jax.lax.scan(body, x,
+                                       (params["layers"], ckp, cvp))
+            x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+            last = jnp.take_along_axis(
+                x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)
+            head = (params["embed_tokens"].astype(dt).T
+                    if cfg.tie_embeddings
+                    else params["lm_head"].astype(dt))
+            first = jnp.argmax(llama.matmul(last, head)[:, 0],
+                               axis=-1).astype(jnp.int32)
+            nw = write_bt.shape[1]
+            flat = write_bt.reshape(-1)
+            pool = {
+                "k": pool["k"].at[flat].set(
+                    rows_to_blocks(pad_rows(ks, nw), nw), mode="drop"),
+                "v": pool["v"].at[flat].set(
+                    rows_to_blocks(pad_rows(vs, nw), nw), mode="drop"),
+            }
+            return pool, first
+
+        def decode_paged(params, pool, tok_dev, len_dev, ov_tok,
+                         ov_len, ov_mask, active, bt, k):
+            tok = jnp.where(ov_mask, ov_tok, tok_dev)
+            lens = jnp.where(ov_mask, ov_len, len_dev)
+            nb = bt.shape[1]
+            ck = gather(pool["k"], bt)
+            cv = gather(pool["v"], bt)
+            key_pos = jnp.arange(nb * bs, dtype=jnp.int32)
+            step = self._make_decode_step(params, key_pos, active,
+                                          llama, jax, jnp)
+            (ck, cv, tok, lens), toks = jax.lax.scan(
+                step, (ck, cv, tok, lens), None, length=k)
+            pool = {"k": scatter(pool["k"], bt, ck),
+                    "v": scatter(pool["v"], bt, cv)}
+            return pool, toks, tok, lens
+
+        def inject(pool, kb, vb, dest):
+            return {"k": pool["k"].at[dest].set(kb, mode="drop"),
+                    "v": pool["v"].at[dest].set(vb, mode="drop")}
+
+        self._prefill_cold = jax.jit(prefill_cold, donate_argnums=(1,))
+        self._prefill_warm = jax.jit(prefill_warm, donate_argnums=(1,))
+        self._decode_paged = jax.jit(decode_paged, donate_argnums=(1,),
+                                     static_argnames=("k",))
+        self._inject = jax.jit(inject, donate_argnums=(0,))
+
+    # ------------------------------------------------------------ warmup
     def _warmup(self):
-        """Compile every (bucket) prefill and every decode bucket up
+        """Compile every prefill shape and every decode bucket up
         front so no request ever pays a compile mid-run."""
         import jax
 
         jnp = self._jnp
-        for g in PREFILL_GROUPS:
-            slots = jnp.full(g, -1, jnp.int32)  # writes nothing
+        for g in self.prefill_groups:
             lengths = jnp.ones(g, jnp.int32)
             for bucket in self.buckets:
                 toks = jnp.zeros((g, bucket), jnp.int32)
-                self.cache, _first = self._prefill(
-                    self.params, self.cache, toks, lengths, slots)
+                if self.paged:
+                    bs = self.block_size
+                    nw = -(-bucket // bs)
+                    pad_bt = jnp.full((g, nw), self._pad_block,
+                                      jnp.int32)  # all writes dropped
+                    self.pool, _f = self._prefill_cold(
+                        self.params, self.pool, toks, lengths, pad_bt)
+                    pre = jnp.full((g, self._np_max), self._pad_block,
+                                   jnp.int32)
+                    self.pool, _f = self._prefill_warm(
+                        self.params, self.pool, toks, lengths,
+                        jnp.zeros(g, jnp.int32), pre, pad_bt)
+                else:
+                    slots = jnp.full(g, -1, jnp.int32)  # writes nothing
+                    self.cache, _first = self._prefill(
+                        self.params, self.cache, toks, lengths, slots)
         active = jnp.zeros(self.max_slots, bool)  # no-op decode
         ov = jnp.zeros(self.max_slots, jnp.int32)
         ovm = jnp.zeros(self.max_slots, bool)
-        for sa in self.decode_buckets:
-            self.cache, _t, self._tok_dev, self._len_dev = \
-                self._decode_k(self.params, self.cache, self._tok_dev,
-                               self._len_dev, ov, ov, ovm, active,
-                               k=self.decode_chunk, s_active=int(sa))
-        jax.block_until_ready(self.cache["k"])
+        if self.paged:
+            for nb in self._nb_buckets:
+                bt = jnp.full((self.max_slots, nb), self._pad_block,
+                              jnp.int32)
+                self.pool, _t, self._tok_dev, self._len_dev = \
+                    self._decode_paged(
+                        self.params, self.pool, self._tok_dev,
+                        self._len_dev, ov, ov, ovm, active, bt,
+                        k=self.decode_chunk)
+                kb = jnp.zeros((nb,) + self.pool["k"].shape[1:],
+                               self.pool["k"].dtype)
+                dest = jnp.full(nb, self._pad_block, jnp.int32)
+                self.pool = self._inject(self.pool, kb, kb, dest)
+            jax.block_until_ready(self.pool["k"])
+        else:
+            for sa in self.decode_buckets:
+                self.cache, _t, self._tok_dev, self._len_dev = \
+                    self._decode_k(self.params, self.cache,
+                                   self._tok_dev, self._len_dev, ov,
+                                   ov, ovm, active,
+                                   k=self.decode_chunk,
+                                   s_active=int(sa))
+            jax.block_until_ready(self.cache["k"])
 
     # ------------------------------------------------------------ serving
     async def generate(self, request: Dict[str, Any]) -> Dict[str, Any]:
-        """{"prompt": [int token ids], "max_new_tokens": n} →
+        """{"prompt": [int token ids], "max_new_tokens": n,
+        "deadline_s": optional relative budget} →
         {"tokens": [...], "ttft_ms": float}."""
-        import asyncio
-
         if self._stop.is_set():
             raise RuntimeError("LLMServer is stopped (prior device "
                                "failure or shutdown)")
@@ -266,7 +585,31 @@ class LLMServer:
             raise ValueError(
                 f"prompt of {len(prompt)} exceeds the largest prefill "
                 f"bucket {max(self.buckets)}")
-        req = _Request(prompt, int(request.get("max_new_tokens", 32)))
+        max_new = int(request.get("max_new_tokens", 32))
+        deadline = self._request_deadline(request)
+        if self.role == "prefill" and max_new > 1:
+            return await self._generate_disaggregated(
+                prompt, max_new, deadline)
+        req = _Request(prompt, max_new, deadline=deadline)
+        await self._submit_and_wait(req)
+        return {
+            "tokens": req.tokens,
+            "ttft_ms": round(
+                (req.t_first_token - req.t_submit) * 1e3, 2),
+        }
+
+    @staticmethod
+    def _request_deadline(request) -> Optional[float]:
+        rel = request.get("deadline_s")
+        if rel is not None:
+            return time.time() + float(rel)
+        # Ambient: serve's deadline plane installs the request budget
+        # around the replica dispatch (PR 5).
+        return _deadlines.current()
+
+    async def _submit_and_wait(self, req: _Request) -> None:
+        import asyncio
+
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
 
@@ -275,6 +618,18 @@ class LLMServer:
                 lambda: fut.done() or fut.set_result(None))
 
         req.on_done = _wake
+        if self._queue.qsize() + len(self._backlog) >= self._queue_cap:
+            try:
+                from ..observability.metrics import overload_counters
+
+                overload_counters()["backpressure"].inc(
+                    tags={"where": "llm_queue"})
+            except Exception:
+                pass
+            raise BackPressureError(
+                f"LLM engine queue full ({self._queue_cap})",
+                retry_after_s=0.1,
+                context={"where": "llm_queue"})
         self._queue.put(req)
         if self._stop.is_set() and not req.event.is_set():
             # Raced _fatal's queue drain: fail this request ourselves.
@@ -285,10 +640,6 @@ class LLMServer:
         await fut
         if req.error is not None:
             raise req.error
-        return {
-            "tokens": req.tokens,
-            "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 2),
-        }
 
     def check_health(self):
         return not self._stop.is_set()
@@ -302,78 +653,314 @@ class LLMServer:
 
     def _decode_bucket(self) -> int:
         """Smallest attended-prefix bucket covering every active slot's
-        end position after this chunk."""
+        end position after this chunk (dense plane)."""
         high = 0
         for s in range(self.max_slots):
             if self.slot_req[s] is not None:
-                high = max(high, int(self.slot_len[s]) + self.decode_chunk)
+                high = max(high,
+                           int(self.slot_len[s]) + self.decode_chunk)
         for b in self.decode_buckets:
             if high <= b:
                 return b
         return self.decode_buckets[-1]
 
+    def _nb_bucket(self, nb: int) -> int:
+        for b in self._nb_buckets:
+            if nb <= b:
+                return b
+        return self._nb_buckets[-1]
+
+    # ----------------------------------------------- admission (EDF plane)
+    def _drain_queue(self):
+        while True:
+            try:
+                self._backlog.append(self._queue.get_nowait())
+            except queue.Empty:
+                return
+
+    def _shed(self, req: _Request, err: BaseException, where: str):
+        req.error = err
+        if isinstance(err, DeadlineExceededError):
+            _shed_counter(where)
+        req.finish_notify()
+
+    def _estimate_need_s(self, req: _Request) -> Optional[float]:
+        """Estimated seconds to finish ``req`` from a standing start,
+        from the measured prefill/chunk EMAs (None until both have
+        samples — never shed on a guess)."""
+        if self._chunk_ema is None:
+            return None
+        prefill = self._prefill_ema or self._chunk_ema
+        chunks = -(-req.max_new_tokens // self.decode_chunk)
+        return prefill + chunks * self._chunk_ema
+
+    def _admission_pass(self):
+        """Shed blown/infeasible work typed, then EDF-order the
+        backlog (iteration-level scheduling: this runs at every chunk
+        boundary, so new arrivals join — and hopeless ones leave — the
+        running batch between chunks, never mid-chunk).
+
+        Feasibility is judged AT ARRIVAL POSITION: a request ``i`` deep
+        in the EDF backlog must fit (estimated queue delay for i
+        admissions ahead of it) + (its own estimated service time)
+        inside its budget — overload sheds the doomed tail immediately
+        instead of letting it queue until its deadline dies, which is
+        what keeps ADMITTED p99 TTFT flat at 2x saturation (the Tail
+        at Scale bar the overload soak asserts)."""
+        if not self._backlog:
+            return
+        self._backlog.sort(
+            key=lambda r: (r.deadline if r.deadline is not None
+                           else float("inf"), r.arrival))
+        now = time.time()
+        keep: List[_Request] = []
+        for r in self._backlog:
+            if r.deadline is not None and now >= r.deadline:
+                self._shed(r, DeadlineExceededError(
+                    "shed at LLM admission: deadline exceeded",
+                    deadline=r.deadline,
+                    context={"where": "llm_admission"}),
+                    "llm_admission")
+                continue
+            if r.deadline is not None:
+                need = self._estimate_need_s(r)
+                if need is not None:
+                    # ~max_slots requests run concurrently, so each
+                    # admission ahead adds ~need/max_slots of delay.
+                    remaining = r.deadline - now
+                    queue_est = len(keep) * need / self.max_slots
+                    infeasible = remaining < _FEASIBILITY_MARGIN * (
+                        need + queue_est)
+                    queue_bound = max(need,
+                                      2 * (self._chunk_ema or 0.0))
+                    overlong_queue = (remaining < _QUEUE_TIGHT_X * need
+                                      and queue_est > queue_bound)
+                    if infeasible or overlong_queue:
+                        self._shed(r, DeadlineExceededError(
+                            "shed at LLM admission: cannot finish "
+                            f"inside the request budget (needs "
+                            f"~{need + queue_est:.2f}s)",
+                            deadline=r.deadline,
+                            context={
+                                "where": "llm_admission_infeasible"}),
+                            "llm_admission_infeasible")
+                        continue
+            keep.append(r)
+        self._backlog = keep
+
     def _admit_wave(self):
-        """Move queued requests into free slots: one prefill call per
-        (padded) group of PREFILL_GROUP same-bucket prompts.  The calls
+        """Move backlog requests into free slots: one prefill call per
+        (padded) group of PREFILL_GROUP same-shape prompts.  The calls
         are launched async (they queue behind the in-flight chunk) and
         their first tokens are harvested in a later _process."""
-        jnp = self._jnp
+        self._drain_queue()
+        self._admission_pass()
+        if not self._backlog:
+            return
         free = [s for s in range(self.max_slots)
                 if self.slot_req[s] is None]
-        wave: List[tuple] = []  # (slot, req, bucket)
-        while free:
-            if self._idle_stash is not None:
-                req, self._idle_stash = self._idle_stash, None
-            else:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-            slot = free.pop(0)
-            # Claim the slot immediately: if a device call fails,
-            # _fatal finds every dequeued request in slot_req.
+        wave: List[tuple] = []  # (slot, req, bucket, pos0)
+        while free and self._backlog:
+            req = self._backlog[0]
+            slot = free[0]
+            try:
+                entry = self._claim_slot(slot, req)
+            except BackPressureError as e:
+                if self._req_impossible(req):
+                    # This request can NEVER fit (prompt + decode
+                    # exceed the whole pool): fail it typed instead of
+                    # wedging the head of the backlog forever.
+                    self._backlog.pop(0)
+                    self._shed(req, e, "llm_admission")
+                    continue
+                break  # pool pressure: retry at the next boundary
+            self._backlog.pop(0)
+            free.pop(0)
+            if entry is not None:
+                wave.append(entry)
+        if wave:
+            self._launch_prefills(wave)
+
+    def _req_impossible(self, req: _Request) -> bool:
+        if not self.paged:
+            return False
+        bs = self.block_size
+        # Generation truncates at the model horizon, so a huge
+        # max_new_tokens never needs more than max_len positions.
+        positions = min(len(req.prompt) + req.max_new_tokens,
+                        self.max_len)
+        return -(-positions // bs) > self.num_blocks - 1
+
+    def _claim_slot(self, slot: int, req: _Request) -> Optional[tuple]:
+        """Bind ``req`` to ``slot``; paged plane allocates its block
+        table (prefix-cache fork first) and may raise a typed
+        ``BackPressureError`` WITHOUT claiming.  Returns a prefill
+        wave entry, or None when no prefill is needed (pre-seeded
+        disaggregated ingest)."""
+        P = len(req.prompt)
+        if not self.paged:
             self.slot_req[slot] = req
-            self.slot_len[slot] = 0
-            wave.append((slot, req, self._bucket(len(req.prompt))))
-        by_bucket: Dict[int, List[tuple]] = {}
-        for slot, req, bucket in wave:
-            by_bucket.setdefault(bucket, []).append((slot, req))
-        for bucket, entries in by_bucket.items():
+            self.slot_len[slot] = P
+            self.slot_waiting[slot] = True
+            return (slot, req, self._bucket(P), 0)
+        from .kv_cache import BlockTable
+
+        if req.preseed is not None:
+            table = BlockTable(self.allocator)
+            try:
+                table.ensure(P)
+            except BaseException:
+                table.release()
+                raise
+            self.slot_req[slot] = req
+            self.slot_table[slot] = table
+            try:
+                self._apply_preseed(slot, req, table)
+            except ValueError as e:
+                # A malformed handoff (block-count/shape mismatch —
+                # e.g. a rolling redeploy changed block_size mid-
+                # window) fails THIS ingest typed; it must not
+                # _fatal the whole decode engine.
+                req.error = e
+                req.finish_notify()
+            return None
+        shared = self.prefix_cache.lookup(req.prompt)
+        table = BlockTable(self.allocator, shared=shared)
+        try:
+            table.ensure(P)
+        except BaseException:
+            table.release()  # give the forked prefix refs back
+            raise
+        pos0 = table.num_shared * self.block_size
+        self.slot_req[slot] = req
+        self.slot_table[slot] = table
+        self.slot_len[slot] = P
+        self.slot_waiting[slot] = True
+        # NOTE: the prompt's blocks are published into the prefix trie
+        # at HARVEST, not here — a same-wave request hitting the trie
+        # now could gather blocks whose prefill hasn't executed yet
+        # (grouped prefills launch in arbitrary order within a wave).
+        return (slot, req, self._bucket(P - pos0), pos0)
+
+    def _apply_preseed(self, slot: int, req: _Request, table) -> None:
+        """Disaggregated ingest: scatter the handed-off KV blocks into
+        the pool and seed the slot as if its prefill just landed."""
+        jnp = self._jnp
+        seed = req.preseed
+        kb, vb = np.asarray(seed["k"]), np.asarray(seed["v"])
+        n = kb.shape[0]
+        if n != len(table.blocks):
+            table.release()
+            self.slot_req[slot] = None
+            self.slot_table[slot] = None
+            raise ValueError(
+                f"handoff block count {n} != table {len(table.blocks)}")
+        nbi = self._nb_bucket(n)
+        dest = np.full(nbi, self._pad_block, np.int32)
+        dest[:n] = table.blocks
+        if nbi != n:
+            pad = ((0, nbi - n),) + ((0, 0),) * (kb.ndim - 1)
+            kb = np.pad(kb, pad)
+            vb = np.pad(vb, pad)
+        self.pool = self._inject(self.pool, jnp.asarray(kb),
+                                 jnp.asarray(vb), jnp.asarray(dest))
+        P = len(req.prompt)
+        self.slot_len[slot] = P
+        self.slot_waiting[slot] = False
+        self._ov_tok[slot] = seed["first"]
+        self._ov_len[slot] = P
+        self._ov_mask[slot] = True
+
+    def _launch_prefills(self, wave: List[tuple]):
+        jnp = self._jnp
+        # Group by (bucket, warm?) — the two paged prefill programs
+        # have different signatures; dense ignores pos0 entirely.
+        by_shape: Dict[tuple, List[tuple]] = {}
+        for slot, req, bucket, pos0 in wave:
+            key = (bucket, self.paged and pos0 > 0)
+            by_shape.setdefault(key, []).append((slot, req, pos0))
+        for (bucket, warm), entries in by_shape.items():
             i = 0
             while i < len(entries):
                 rest = len(entries) - i
-                g = next((g for g in PREFILL_GROUPS if g >= rest),
-                         PREFILL_GROUPS[-1])
+                g = next((gg for gg in self.prefill_groups
+                          if gg >= rest),
+                         self.prefill_groups[-1])
                 group = entries[i:i + g]
                 i += g
-                toks = np.zeros((g, bucket), np.int32)
-                lens = np.ones(g, np.int32)
-                slots = np.full(g, -1, np.int32)
-                members = []
-                for j, (slot, req) in enumerate(group):
-                    P = len(req.prompt)
-                    toks[j, :P] = req.prompt
-                    lens[j] = P
-                    slots[j] = slot
-                    members.append((j, slot, req))
-                    # Decode resumes at position P with the prefill's
-                    # own first token; the override token is patched in
-                    # once the prefill materializes (before the next
-                    # launch that includes this slot).
-                    self.slot_len[slot] = P
-                    self.slot_waiting[slot] = True
-                self.cache, first = self._prefill(
-                    self.params, self.cache, jnp.asarray(toks),
-                    jnp.asarray(lens), jnp.asarray(slots))
-                self._pending_prefills.append((first, members))
+                self._launch_prefill_group(g, bucket, warm, group,
+                                           jnp)
+
+    def _launch_prefill_group(self, g, bucket, warm, group, jnp):
+        toks = np.zeros((g, bucket), np.int32)
+        lens = np.ones(g, np.int32)
+        members = []
+        if not self.paged:
+            slots = np.full(g, -1, np.int32)
+            for j, (slot, req, _pos0) in enumerate(group):
+                P = len(req.prompt)
+                toks[j, :P] = req.prompt
+                lens[j] = P
+                slots[j] = slot
+                members.append((j, slot, req))
+            t0 = time.perf_counter()
+            self.cache, first = self._prefill(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(lens), jnp.asarray(slots))
+            self._pending_prefills.append((first, members, t0))
+            return
+        bs = self.block_size
+        nw = -(-bucket // bs)
+        write_bt = np.full((g, nw), self._pad_block, np.int32)
+        pos0s = np.zeros(g, np.int32)
+        pre_bt = np.full((g, self._np_max), self._pad_block, np.int32)
+        for j, (slot, req, pos0) in enumerate(group):
+            P = len(req.prompt)
+            suffix = req.prompt[pos0:]
+            toks[j, :len(suffix)] = suffix
+            lens[j] = len(suffix)
+            pos0s[j] = pos0
+            table = self.slot_table[slot]
+            first_w = pos0 // bs
+            wb = table.blocks[first_w:-(-P // bs)]
+            write_bt[j, :len(wb)] = wb
+            if warm:
+                pre_bt[j, :first_w] = table.blocks[:first_w]
+            members.append((j, slot, req))
+        t0 = time.perf_counter()
+        if warm:
+            self.pool, first = self._prefill_warm(
+                self.params, self.pool, jnp.asarray(toks),
+                jnp.asarray(lens), jnp.asarray(pos0s),
+                jnp.asarray(pre_bt), jnp.asarray(write_bt))
+        else:
+            self.pool, first = self._prefill_cold(
+                self.params, self.pool, jnp.asarray(toks),
+                jnp.asarray(lens), jnp.asarray(write_bt))
+        self._pending_prefills.append((first, members, t0))
 
     def _harvest_prefills(self):
         """Materialize queued prefill first-tokens into request streams
         and decode overrides."""
-        for first, members in self._pending_prefills:
+        for first, members, t0 in self._pending_prefills:
             first = np.asarray(first)
             now = time.perf_counter()
+            dt = now - t0
+            self._prefill_ema = (dt if self._prefill_ema is None
+                                 else 0.8 * self._prefill_ema
+                                 + 0.2 * dt)
             for j, slot, req in members:
+                if self.slot_req[slot] is not req:
+                    continue  # preempted while the prefill was in flight
+                if self.paged and req.preseed is None:
+                    # Publish the prompt's full blocks for COW sharing
+                    # only now that the prefill writing them has
+                    # MATERIALIZED (np.asarray above synced it): a
+                    # same-wave lookup must never gather unwritten
+                    # blocks.
+                    self.prefix_cache.insert(req.prompt,
+                                             self.slot_table[slot]
+                                             .blocks)
                 tok = int(first[j])
                 req.t_first_token = now
                 req.tokens.append(tok)
@@ -385,15 +972,57 @@ class LLMServer:
                     self._finish(slot)
         self._pending_prefills.clear()
 
+    def _extract_kv(self, req: _Request, table) -> None:
+        """Copy a finished prefill-role request's prompt blocks out of
+        the pool (host copies: the pool buffer is donated into the
+        next device call, so views must not escape this thread).
+        The gather runs ON DEVICE — materializing the whole pool to
+        host would move the full pool bytes per request on a real
+        accelerator (np.asarray only aliases on the CPU backend)."""
+        jnp = self._jnp
+        n = -(-len(req.prompt) // self.block_size)
+        idx = jnp.asarray(np.asarray(table.blocks[:n], np.int32))
+        req.kv = (np.asarray(jnp.take(self.pool["k"], idx, axis=0)),
+                  np.asarray(jnp.take(self.pool["v"], idx, axis=0)))
+
     def _finish(self, slot: int):
         req = self.slot_req[slot]
         self.slot_req[slot] = None
         self.slot_len[slot] = 0
         self._ov_mask[slot] = False
         self.slot_waiting[slot] = False
+        if self.paged:
+            table, self.slot_table[slot] = self.slot_table[slot], None
+            if table is not None:
+                if req is not None and req.want_kv \
+                        and req.error is None:
+                    self._extract_kv(req, table)
+                table.release()
         if req is not None:
             req.done = True
             req.finish_notify()
+
+    def _preempt(self, slot: int):
+        """Pool pressure: evict the running request in ``slot`` back to
+        the backlog (recompute-on-readmit — greedy decode reproduces
+        its tokens exactly), freeing its blocks."""
+        req = self.slot_req[slot]
+        self.slot_req[slot] = None
+        self.slot_len[slot] = 0
+        self._ov_mask[slot] = False
+        self.slot_waiting[slot] = False
+        table, self.slot_table[slot] = self.slot_table[slot], None
+        if table is not None:
+            table.release()
+        if req is not None and not req.done:
+            req.tokens = []
+            req.t_first_token = None
+            # A pre-seeded (disaggregated) request KEEPS its preseed:
+            # the handed-off K/V are host copies on the request, so
+            # readmission re-injects them.  Re-prefilling instead
+            # would regenerate the first token the prefill replica
+            # already returned — the client would see it twice.
+            self._backlog.append(req)
 
     def _fatal(self, e: BaseException):
         """A device call failed.  The cache was donated into it, so its
@@ -405,10 +1034,10 @@ class LLMServer:
             if req is not None:
                 req.error = e
                 self._finish(slot)
-        if self._idle_stash is not None:
-            req, self._idle_stash = self._idle_stash, None
+        for req in self._backlog:
             req.error = e
             req.finish_notify()
+        self._backlog = []
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -418,7 +1047,7 @@ class LLMServer:
             req.finish_notify()
 
     def _loop(self):
-        pending = None  # (toks_device, [(slot, req)], k) in flight
+        pending = None  # (toks_device, [(slot, req)], k, t0) in flight
         try:
             while not self._stop.is_set():
                 # Prefill-priority admission: queued prompts' prefill
@@ -433,22 +1062,18 @@ class LLMServer:
                 self._harvest_prefills()
                 pending = launched
                 if pending is None and not any(
-                        r is not None for r in self.slot_req):
-                    # Idle: block for work instead of spinning.  Stash
-                    # the dequeued request for the next _admit_wave.
+                        r is not None for r in self.slot_req) \
+                        and not self._backlog:
+                    # Idle: block for work instead of spinning.
                     try:
-                        self._idle_stash = self._queue.get(timeout=0.05)
+                        self._backlog.append(
+                            self._queue.get(timeout=0.05))
                     except queue.Empty:
                         pass
         except BaseException as e:  # noqa: BLE001
             self._fatal(e)
 
-    def _launch_chunk(self):
-        """Issue the next decode chunk (async) with host overrides for
-        newly admitted slots.  Returns the in-flight handle or None if
-        no slot is active."""
-        jnp = self._jnp
-        # Active = occupied and not sitting out a pending prefill.
+    def _active_snapshot(self):
         snapshot = []  # (slot, req, len_at_launch)
         active = np.zeros(self.max_slots, bool)
         for s in range(self.max_slots):
@@ -456,34 +1081,133 @@ class LLMServer:
             if req is not None and not self.slot_waiting[s]:
                 active[s] = True
                 snapshot.append((s, req, int(self.slot_len[s])))
-        if not active.any():
-            return None
+        return snapshot, active
+
+    def _grow_tables(self, snapshot) -> bool:
+        """Ensure every active slot's table covers this chunk's writes;
+        preempt latest-deadline requests under pool pressure.  Returns
+        False when the snapshot changed (caller re-snapshots)."""
         k = self.decode_chunk
-        sa = self._decode_bucket()
+        for s, req, _len0 in snapshot:
+            while True:
+                try:
+                    # Clamp at the model horizon AND the request's own
+                    # budget: near the end of a sequence the one-deep
+                    # pipeline launches a chunk past the positions any
+                    # kept step will touch (writes beyond the table
+                    # drop, reads stay under lens), so growing for
+                    # them would over-allocate one block per request.
+                    self.slot_table[s].ensure(min(
+                        int(self.slot_len[s]) + k, self.max_len,
+                        len(req.prompt) + req.max_new_tokens))
+                    break
+                except BackPressureError as e:
+                    victim = self._pick_victim()
+                    sole = not any(self.slot_req[o] is not None
+                                   for o in range(self.max_slots)
+                                   if o != s)
+                    if victim is None or (victim == s and sole):
+                        # Sole block-holder and the pool (after
+                        # prefix-cache reclaim) still can't hold it:
+                        # impossible — shed it typed rather than OOM.
+                        self.slot_req[s].error = e
+                        self._finish(s)
+                        return False
+                    # Preempt the latest-deadline holder — ACTIVE or
+                    # still waiting on its prefill (waiting slots hold
+                    # blocks too; a sole runner must not shed itself
+                    # while admissions hoard the pool) — possibly the
+                    # one being grown: recompute-on-readmit beats
+                    # failing work that already holds budget.
+                    self._preempt(victim)
+                    return False
+        return True
+
+    def _pick_victim(self) -> Optional[int]:
+        """Latest deadline loses (no deadline sorts last, newest
+        arrival breaks ties) — the EDF inverse.  Every occupied slot
+        is a candidate, including ones still waiting on their
+        prefill."""
+        best = None
+        best_key = None
+        for s in range(self.max_slots):
+            req = self.slot_req[s]
+            if req is None:
+                continue
+            key = (req.deadline if req.deadline is not None
+                   else float("inf"), req.arrival)
+            if best_key is None or key > best_key:
+                best_key = key
+                best = s
+        return best
+
+    def _launch_chunk(self):
+        """Issue the next decode chunk (async) with host overrides for
+        newly admitted slots.  Returns the in-flight handle or None if
+        no slot is active."""
+        jnp = self._jnp
+        # Active = occupied and not sitting out a pending prefill.
+        snapshot, active = self._active_snapshot()
+        if self.paged:
+            while snapshot and not self._grow_tables(snapshot):
+                snapshot, active = self._active_snapshot()
+        if not snapshot:
+            return None
+        try:
+            from ..observability.metrics import kv_cache_counters
+
+            kv_cache_counters()["batch_occupancy"].set(
+                len(snapshot),
+                tags={"deployment": self._deployment or "llm"})
+        except Exception:
+            pass
+        k = self.decode_chunk
+        t0 = time.perf_counter()
         # .copy(): on the CPU backend jnp.asarray ALIASES numpy buffers,
         # and this thread mutates the override arrays right after the
         # (async) launch — the in-flight chunk must own its inputs.
-        self.cache, toks, self._tok_dev, self._len_dev = self._decode_k(
-            self.params, self.cache, self._tok_dev, self._len_dev,
-            jnp.asarray(self._ov_tok.copy()),
-            jnp.asarray(self._ov_len.copy()),
-            jnp.asarray(self._ov_mask.copy()), jnp.asarray(active),
-            k=int(k), s_active=int(sa))
+        ov_args = (jnp.asarray(self._ov_tok.copy()),
+                   jnp.asarray(self._ov_len.copy()),
+                   jnp.asarray(self._ov_mask.copy()),
+                   jnp.asarray(active))
+        if self.paged:
+            nb = self._nb_bucket(max(
+                len(self.slot_table[s]) for s, _r, _l in snapshot))
+            bt = np.full((self.max_slots, nb), self._pad_block,
+                         np.int32)
+            for s, _req, _l in snapshot:
+                blocks = self.slot_table[s].blocks[:nb]
+                bt[s, :len(blocks)] = blocks
+            self.pool, toks, self._tok_dev, self._len_dev = \
+                self._decode_paged(self.params, self.pool,
+                                   self._tok_dev, self._len_dev,
+                                   *ov_args, jnp.asarray(bt), k=int(k))
+        else:
+            sa = self._decode_bucket()
+            self.cache, toks, self._tok_dev, self._len_dev = \
+                self._decode_k(self.params, self.cache, self._tok_dev,
+                               self._len_dev, *ov_args, k=int(k),
+                               s_active=int(sa))
         self._ov_mask[:] = False
         for s, _req, _len0 in snapshot:
             self.slot_len[s] += k
-        return (toks, snapshot, k)
+        return (toks, snapshot, k, t0)
 
     def _process(self, pending):
         """Materialize a finished chunk's tokens (blocks until the
         device call completes — by then the NEXT chunk is already
         queued) and route them to their requests."""
-        toks_dev, snapshot, k = pending
+        toks_dev, snapshot, k, t0 = pending
         toks = np.asarray(toks_dev)  # (k, B)
         now = time.perf_counter()
+        dt = now - t0
+        self._chunk_ema = (dt if self._chunk_ema is None
+                           else 0.8 * self._chunk_ema + 0.2 * dt)
         for slot, req, len0 in snapshot:
             if req is None or req.done:
                 continue
+            if self.slot_req[slot] is not req:
+                continue  # preempted after this chunk launched
             for step in range(k):
                 tok = int(toks[step, slot])
                 if req.t_first_token is None:
@@ -493,6 +1217,198 @@ class LLMServer:
                         or len0 + step + 1 >= self.max_len - 1):
                     self._finish(slot)
                     break
+
+    # ----------------------------------------- disaggregation (KV handoff)
+    def kv_endpoint(self, peer: str) -> Dict[str, Any]:
+        """Decode-side half of transport negotiation: mint (once per
+        prefill peer) the SPSC ring this peer would write KV frames
+        into, and report our node so the peer picks shm vs DCN."""
+        from ..experimental.channel import channel_path
+        from .kv_transfer import local_node_id
+
+        with self._kv_lock:
+            ring = self._kv_rings.get(peer)
+            if ring is None:
+                ring = self._kv_rings[peer] = channel_path(
+                    f"kv-{peer[:12]}")
+        return {"node": local_node_id(), "ring": ring}
+
+    async def decode_ingest(self, handoff: Dict[str, Any],
+                            prompt: List[int], first_token: int,
+                            max_new_tokens: int,
+                            deadline: Optional[float] = None
+                            ) -> Dict[str, Any]:
+        """Decode-side ingest: receive the prefill replica's KV blocks
+        (shm ring or striped object plane), seed a slot with them, and
+        decode the remaining tokens.  Returns the decode-side tokens
+        (the caller prepends the prefill's first token)."""
+        import asyncio
+
+        from .kv_transfer import KVReceiver
+
+        if self.role == "prefill":
+            raise RuntimeError("prefill-role replica cannot ingest")
+        with self._kv_lock:
+            if self._kv_receiver is None:
+                self._kv_receiver = KVReceiver()
+            receiver = self._kv_receiver
+        loop = asyncio.get_event_loop()
+        k, v = await loop.run_in_executor(None, receiver.recv, handoff)
+        req = _Request(prompt, max_new_tokens, deadline=deadline)
+        req.preseed = {"first": int(first_token), "k": k, "v": v}
+        await self._submit_and_wait(req)
+        return {"tokens": req.tokens}
+
+    def _refresh_decode_targets(self):
+        """Decode-replica membership for this deployment, via the
+        serve controller (1 Hz cache, mirroring the handles' poll)."""
+        now = time.monotonic()
+        if now - self._decode_refresh < 1.0 and self._decode_targets:
+            return
+        self._decode_refresh = now
+        import ray_tpu
+
+        if self._deployment is None:
+            return
+        try:
+            controller = ray_tpu.get_actor("serve_controller")
+            mem = ray_tpu.get(controller.get_membership.remote(
+                self._deployment, -1), timeout=10.0)
+        except Exception:
+            return
+        roles = mem.get("roles") or []
+        replicas = mem["replicas"]
+        targets = [r for r, role in zip(replicas, roles)
+                   if role in ("decode", "both")]
+        if targets:
+            self._decode_targets = targets
+
+    async def _generate_disaggregated(self, prompt, max_new,
+                                      deadline) -> Dict[str, Any]:
+        """Prefill-role path: local prefill (first token + KV blocks),
+        hand the blocks to a decode replica, await its tokens."""
+        import asyncio
+
+        import ray_tpu
+
+        from .handle import _unwrap
+        from .kv_transfer import KVSender
+
+        req = _Request(prompt, 1, deadline=deadline)
+        req.want_kv = True
+        await self._submit_and_wait(req)
+        first = req.tokens[0]
+        ttft_ms = round((req.t_first_token - req.t_submit) * 1e3, 2)
+        if req.kv is None:
+            raise RuntimeError("prefill finished without KV blocks")
+        loop = asyncio.get_event_loop()
+        from ..exceptions import ActorDiedError
+
+        last_err: Optional[BaseException] = None
+        for _attempt in range(2):  # one failover onto a fresh target
+            target = None
+            give_up = time.monotonic() + 5.0
+            while target is None:
+                # Off the event loop: the membership poll is a blocking
+                # controller RPC (up to 10 s against a dead head) and
+                # would otherwise freeze every coroutine this replica
+                # is serving.
+                await loop.run_in_executor(
+                    None, self._refresh_decode_targets)
+                if self._decode_targets:
+                    self._decode_rr += 1
+                    target = self._decode_targets[
+                        self._decode_rr % len(self._decode_targets)]
+                    break
+                if time.monotonic() > give_up:
+                    raise RuntimeError(
+                        f"no decode-role replicas in deployment "
+                        f"{self._deployment!r} to hand KV off to")
+                await asyncio.sleep(0.1)
+
+            def _handoff_and_ingest(target=target):
+                with self._kv_lock:
+                    if self._kv_sender is None:
+                        self._kv_sender = KVSender()
+                    sender = self._kv_sender
+                ep = _unwrap(ray_tpu.get(target.handle_request.remote(
+                    "kv_endpoint", (self._engine_id,), {}, ""),
+                    timeout=30.0))
+                kb, vb = req.kv
+                handoff = sender.send(ep, req.rid, kb, vb,
+                                      list(range(kb.shape[0])))
+                # Bounded: the receive path's own deadline (60 s) plus
+                # decode time — never an indefinite hang if the decode
+                # replica wedges (its typed errors surface through the
+                # result either way).
+                wait = _deadlines.remaining(deadline)
+                wait = 180.0 if wait is None else min(180.0,
+                                                      wait + 5.0)
+                return _unwrap(ray_tpu.get(
+                    target.handle_request.remote(
+                        "decode_ingest",
+                        (handoff, prompt, first, max_new - 1,
+                         deadline), {}, ""), timeout=wait))
+
+            try:
+                out = await loop.run_in_executor(
+                    None, _handoff_and_ingest)
+                return {"tokens": [first] + out["tokens"],
+                        "ttft_ms": ttft_ms}
+            except ActorDiedError as e:
+                # The chosen decode replica died under the handoff:
+                # the blocks live only in OUR req.kv copy, so a fresh
+                # send to a live peer is a clean retry (the decode
+                # side is idempotent per request id).
+                last_err = e
+                self._decode_targets = []
+                self._decode_refresh = 0.0
+        raise last_err
+
+    @property
+    def _engine_id(self) -> str:
+        eid = getattr(self, "_engine_id_", None)
+        if eid is None:
+            eid = self._engine_id_ = uuid.uuid4().hex
+        return eid
+
+    def kv_stats(self) -> Dict[str, Any]:
+        """This replica's paged-KV series (allocator occupancy, prefix
+        cache, handoff transport counters) — the per-process metric
+        truth the disaggregation tests assert transports against."""
+        from ..observability.metrics import metrics_summary
+
+        out = {k: v for k, v in metrics_summary().items()
+               if k.startswith(("ray_tpu_kv_", "ray_tpu_prefix_"))}
+        if self.paged:
+            out["allocator"] = {
+                "used": self.allocator.used_blocks,
+                "free": self.allocator.free_blocks,
+                "prefix_blocks": self.prefix_cache.num_blocks,
+            }
+        return out
+
+    # ------------------------------------------------------------ teardown
+    def release_kv_cache(self):
+        """Multiplex-eviction hook: return every pool block (tables +
+        prefix trie) to the allocator.  Stops the scheduler first —
+        tearing tables out from under a live decode loop would kill
+        in-flight requests with a raw TypeError instead of a typed
+        shutdown error (an evicted model may well have traffic in
+        flight; eviction is triggered by OTHER models' requests)."""
+        if not self.paged:
+            return
+        if not self._stop.is_set():
+            self._fatal(RuntimeError(
+                "LLM engine evicted: KV cache released"))
+            t = getattr(self, "_thread", None)
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=30.0)
+        for s in range(self.max_slots):
+            t, self.slot_table[s] = self.slot_table[s], None
+            if t is not None:
+                t.release()
+        self.prefix_cache.drop()
 
     def shutdown(self):
         """Stop the scheduler thread and fail any waiters (the
@@ -505,12 +1421,44 @@ class LLMServer:
         t = getattr(self, "_thread", None)
         if t is not None and t is not threading.current_thread():
             t.join(timeout=30.0)
+        self.release_kv_cache()
+        for res in (self._kv_sender, self._kv_receiver):
+            if res is not None:
+                try:
+                    res.close()
+                except Exception:
+                    pass
         try:
             import jax
 
-            jax.block_until_ready(self.cache["k"])
+            jax.block_until_ready(
+                self.pool["k"] if self.paged else self.cache["k"])
         except Exception:
             pass
 
     def __del__(self):
         self._stop.set()
+
+
+def _masked_attend(q, keys, vals, q_pos, key_abs, key_valid, scale,
+                   jnp, jax):
+    """Cache attention with EXPLICIT key positions/validity — the warm
+    (prefix-hit) prefill attends [gathered prefix blocks || suffix],
+    where a key's gathered index no longer equals its absolute
+    position for the suffix half.  q: (G, P, Hq, D); keys/vals:
+    (G, S, Hkv, D); q_pos: (G, P); key_abs/key_valid: (G, S)."""
+    G, P, Hq, D = q.shape
+    Hkv = keys.shape[2]
+    group = Hq // Hkv
+    qg = q.reshape(G, P, Hkv, group, D)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys,
+                        preferred_element_type=jnp.float32) * scale
+    mask = (key_valid[:, None, None, None, :]
+            & (key_abs[:, None, None, None, :]
+               <= q_pos[:, None, None, :, None]))
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vals.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vals,
+                     preferred_element_type=jnp.float32).astype(
+        vals.dtype)
+    return out.reshape(G, P, Hq, D)

@@ -92,6 +92,14 @@ def multiplexed(max_num_models_per_replica: int = 3) -> Callable:
                     _mid, old = cache.popitem(last=False)
                     evicted.append(old)
             for old in evicted:
+                # Paged-KV release first: a model holding blocks in a
+                # shared KV allocator (multi-LoRA serving) must hand
+                # them back on eviction — its table/prefix-trie holds
+                # otherwise outlive the model until process exit (the
+                # classic multiplex leak).
+                from .kv_cache import release_model_kv
+
+                release_model_kv(old)
                 unload = getattr(old, "unload", None)
                 if callable(unload):
                     try:

@@ -19,9 +19,29 @@ class Replica:
     ``ray_tpu.remote(Replica).options(...)``."""
 
     def __init__(self, deployment_name: str, callable_def,
-                 init_args: Tuple, init_kwargs: Dict[str, Any]):
+                 init_args: Tuple, init_kwargs: Dict[str, Any],
+                 role: str = "both"):
         self._deployment = deployment_name
+        # Disaggregated-serving role (prefill | decode | both): the
+        # controller assigns it per replica from the deployment's
+        # ``replica_roles``; the router filters on it.  User callables
+        # that declare ``role`` / ``serve_deployment`` params get them
+        # injected so the instance can route its own KV handoffs
+        # (serve/llm.py LLMServer does).
+        self._role = role
         if inspect.isclass(callable_def):
+            init_kwargs = dict(init_kwargs)
+            try:
+                params = inspect.signature(
+                    callable_def.__init__).parameters
+            except (TypeError, ValueError):
+                params = {}
+            if role != "both" and "role" in params \
+                    and "role" not in init_kwargs:
+                init_kwargs["role"] = role
+            if "serve_deployment" in params \
+                    and "serve_deployment" not in init_kwargs:
+                init_kwargs["serve_deployment"] = deployment_name
             self._instance = callable_def(*init_args, **init_kwargs)
         else:
             if init_args or init_kwargs:
@@ -103,6 +123,9 @@ class Replica:
         finally:
             _reset_model_id(token)
             self._num_ongoing -= 1
+
+    async def get_role(self) -> str:
+        return self._role
 
     async def num_ongoing_requests(self) -> int:
         """Queue-length probe (reference: pow-2 scheduler probes

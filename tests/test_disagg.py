@@ -1,0 +1,238 @@
+"""Prefill/decode disaggregation (ISSUE 10): replica roles, KV-block
+handoff transports (shm ring same-host, striped object plane
+cross-host — asserted by transport counters, not inspection), and the
+flat-TTFT overload soak over the paged + disaggregated serving plane."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import BackPressureError, DeadlineExceededError
+
+# Lean engine shape shared by every test: one prefill bucket and one
+# group size keep the warmup compile matrix small (2 prefill programs
+# + 1 decode bucket + 1 inject).
+_ENGINE = dict(model_preset="debug", max_slots=8, max_len=64,
+               prefill_buckets=(16,), decode_chunk=8, paged=True,
+               block_size=8, prefill_groups=(8,))
+
+
+@pytest.fixture
+def serve_session(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def _llm_app(**overrides):
+    from ray_tpu.serve.llm import LLMServer
+
+    kw = dict(_ENGINE)
+    kw.update(overrides.pop("engine", {}))
+    return serve.deployment(LLMServer, **overrides).bind(**kw)
+
+
+class TestRoleRouting:
+    def test_ingress_prefers_prefill_and_role_option_targets(
+            self, serve_session):
+        """replica_roles splits the set; default ingress traffic lands
+        on the prefill replica, options(role=...) targets explicitly."""
+
+        @serve.deployment(replica_roles={"prefill": 1, "decode": 2})
+        class WhoAmI:
+            def __init__(self, role="both"):
+                self.role = role
+
+            def __call__(self, _):
+                return self.role
+
+        handle = serve.run(WhoAmI.bind())
+        got = {handle.remote(None).result(timeout=30)
+               for _ in range(8)}
+        assert got == {"prefill"}  # ingress_role default
+        got = {handle.options(role="decode").remote(None).result(
+            timeout=30) for _ in range(8)}
+        assert got == {"decode"}
+
+    def test_bad_role_rejected(self, serve_session):
+        @serve.deployment(replica_roles={"sideways": 1})
+        class X:
+            def __call__(self):
+                return 1
+
+        with pytest.raises(Exception, match="unknown replica role"):
+            serve.run(X.bind())
+
+
+def _decode_stats(handle):
+    return handle.options(role="decode").kv_stats.remote().result(
+        timeout=60)
+
+
+class TestKVHandoffTransports:
+    @pytest.mark.slow
+    def test_cross_host_rides_striped_object_plane(self):
+        """Replicas pinned to two different nodes: the handoff falls
+        back to the PR 6 striped object plane (dcn counters on the
+        decode replica, zero shm).  ``slow``-marked for wall-clock
+        only (two worker processes each compile an engine — ~30 s the
+        timed tier-1 sweep can't spare); the same-host/shm half of the
+        transport acceptance runs in tier-1 inside the flat-TTFT
+        soak."""
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        ray_tpu.shutdown()
+        c = Cluster()
+        c.add_node(num_cpus=2, resources={"pf": 2})
+        c.add_node(num_cpus=2, resources={"dc": 2})
+        c.connect(num_cpus=2)
+        try:
+            handle = serve.run(_llm_app(replica_roles={
+                "prefill": {"num": 1, "ray_actor_options": {
+                    "resources": {"pf": 1}}},
+                "decode": {"num": 1, "ray_actor_options": {
+                    "resources": {"dc": 1}}},
+            }))
+            outs = [handle.generate.remote(
+                {"prompt": [1, 2, 3, 4, 5], "max_new_tokens": 6}
+            ).result(timeout=180) for _ in range(2)]
+            assert all(len(o["tokens"]) == 6 for o in outs)
+            assert outs[0]["tokens"] == outs[1]["tokens"]
+            stats = _decode_stats(handle)
+            assert stats["ray_tpu_kv_handoff_total"].get(
+                "dcn", 0) >= 2, stats
+            assert "shm" not in stats["ray_tpu_kv_handoff_total"], \
+                stats
+            assert stats["ray_tpu_kv_handoff_bytes"]["dcn"] > 0
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()
+            c.shutdown()
+
+
+@pytest.mark.overload
+class TestFlatTTFTSoak:
+    """ISSUE 10 acceptance: the PR 5 overload soak shape rerun over
+    paged + disaggregated serving — admitted p99 TTFT at 2x saturation
+    stays within 1.2x of the 1x-load p99, and everything the plane
+    refuses is shed TYPED (DeadlineExceededError / BackPressureError),
+    never a timeout or a raw queue blowup."""
+
+    # A deliberately capacity-limited decode engine (one slot, short
+    # chunks, long generations) so the pytest-side driver can actually
+    # saturate it: ~9 req/s on CI hardware.  The budget sits within
+    # the latency-sensitive band, so queueing beyond ~one service time
+    # sheds at admission — the mechanism under test.
+    _MAX_NEW = 48
+    _DEADLINE_S = 1.5
+    _ENGINE_OVERRIDE = dict(max_slots=1, decode_chunk=4,
+                            prefill_groups=(4,))
+
+    def _drive(self, handle, n, interval_s):
+        """Submit n requests at a fixed offered rate; returns
+        (ttfts_of_admitted_ms, typed_shed_count)."""
+        results = []
+        errors = []
+        threads = []
+
+        def one(i):
+            try:
+                out = handle.generate.remote({
+                    "prompt": [(i * 7 + j) % 97 + 1 for j in range(8)],
+                    "max_new_tokens": self._MAX_NEW,
+                    "deadline_s": self._DEADLINE_S,
+                }).result(timeout=60)
+                results.append(out["ttft_ms"])
+            except (DeadlineExceededError, BackPressureError):
+                errors.append("typed")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+        for i in range(n):
+            t = threading.Thread(target=one, args=(i,))
+            t.start()
+            threads.append(t)
+            time.sleep(interval_s)
+        for t in threads:
+            t.join(timeout=120)
+        untyped = [e for e in errors if e != "typed"]
+        assert not untyped, untyped[:3]
+        return results, len(errors)
+
+    def test_flat_ttft_at_2x_saturation(self, serve_session):
+        """Also carries the same-host transport acceptance (one
+        deployment cycle instead of two): every handoff in this test
+        rides the PR 1 shm ring, asserted from the decode replica's
+        delivery counters at the end."""
+        import asyncio
+
+        from ray_tpu.serve.llm import LLMServer
+
+        handle = serve.run(_llm_app(
+            replica_roles={"prefill": 1, "decode": 1},
+            engine=self._ENGINE_OVERRIDE))
+        # Same-host handoff correctness first: tokens bit-equal the
+        # single-engine paged reference.
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        outs = [handle.generate.remote(
+            {"prompt": prompt, "max_new_tokens": 6}).result(timeout=120)
+            for _ in range(3)]
+        ref = LLMServer(**{**_ENGINE, **self._ENGINE_OVERRIDE})
+        try:
+            expect = asyncio.run(ref.generate(
+                {"prompt": prompt, "max_new_tokens": 6}))["tokens"]
+        finally:
+            ref.shutdown()
+        assert all(o["tokens"] == expect for o in outs), (outs, expect)
+        assert all(o["ttft_ms"] > 0 for o in outs)
+        # Warm + measure saturation capacity: how fast the plane
+        # completes back-to-back requests.  Two rounds, best-of — an
+        # underestimated capacity (previous test's teardown still
+        # thrashing the box during round 1) would make the "2x" phase
+        # not actually overload.
+        time.sleep(1.0)
+        n_cal = 12
+        cap_rps = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            resps = [handle.generate.remote(
+                {"prompt": [5, 4, 3, 2, 1],
+                 "max_new_tokens": self._MAX_NEW})
+                for _ in range(n_cal)]
+            for r in resps:
+                r.result(timeout=120)
+            cap_rps = max(cap_rps,
+                          n_cal / (time.perf_counter() - t0))
+
+        n = 40
+        ttfts_1x, shed_1x = self._drive(handle, n, 1.0 / cap_rps)
+        ttfts_2x, shed_2x = self._drive(handle, n,
+                                        1.0 / (2 * cap_rps))
+        assert len(ttfts_1x) >= n * 0.5, (len(ttfts_1x), shed_1x)
+        assert len(ttfts_2x) >= 5, "everything was shed at 2x"
+        p99_1x = sorted(ttfts_1x)[int(len(ttfts_1x) * 0.99) - 1]
+        p99_2x = sorted(ttfts_2x)[int(len(ttfts_2x) * 0.99) - 1]
+        # The flat-TTFT bar: early typed shedding keeps the ADMITTED
+        # stream at 1x-like latency (80 ms absolute floor so ms-scale
+        # CI noise can't fail a healthy run).
+        assert p99_2x <= max(1.2 * p99_1x, p99_1x + 80.0), \
+            (p99_1x, p99_2x, shed_2x)
+        # 2x offered load over a saturated plane MUST shed — and
+        # everything it shed was typed (asserted inside _drive).
+        assert shed_2x > 0, (len(ttfts_2x), p99_1x, p99_2x)
+        # Same-host transport acceptance: every admitted request's KV
+        # rode the shm channel ring (receive-side delivery counters on
+        # the decode replica; zero fell back to the DCN path), and the
+        # kv- ring itself moved frames per the channel plane's own
+        # counters.
+        stats = _decode_stats(handle)
+        assert stats["ray_tpu_kv_handoff_total"].get("shm", 0) >= 3, \
+            stats
+        assert "dcn" not in stats["ray_tpu_kv_handoff_total"], stats
+        assert stats["ray_tpu_kv_handoff_bytes"]["shm"] > 0
+        from ray_tpu.observability.metrics import metrics_summary
+
+        frames = metrics_summary().get("ray_tpu_channel_frames_total",
+                                       {})
+        assert [k for k in frames if "kv-" in str(k)], frames
