@@ -823,6 +823,75 @@ def _head_scale_bench(sizes=(10, 100, 300),
     return out
 
 
+def _head_failover_bench(n_nodes: int = 300,
+                         duration_s: float = 4.0) -> dict:
+    """Replicated-head phases (ROADMAP item 3 / ISSUE 12 acceptance):
+
+    - ``head_ops_per_s_300_with_standby`` — mixed-op throughput at
+      300 virtual nodes with a SYNC-mode hot standby attached (every
+      mutation ack waits for standby durability): the replication
+      overhead guard, compared against the standby-less
+      ``head_ops_per_s_300`` from `_head_scale_bench`.
+    - ``head_failover_unavailability_ms`` — the goodput dip across a
+      primary kill -9 mid-load: largest gap between consecutive
+      successful ops around the kill (promotion on the lapsed
+      primary lease + client head-set failover inside it).
+    """
+    import os
+    import tempfile
+
+    from tools.vcluster import VCluster
+
+    out = {}
+    storage = os.path.join(
+        tempfile.mkdtemp(prefix="bench-vc-ha-"), "head.bin")
+    vc = VCluster(n_nodes, storage=storage, lease_ttl_s=5.0,
+                  hb_interval_s=0.5)
+    vc.primary_ttl_s = 1.0
+    try:
+        vc.start()
+        # Phase 0: standby-less baseline in the SAME run — the
+        # overhead ratio must not compare across bench phases
+        # minutes apart (run-to-run swing on a loaded 1-core CI box
+        # exceeds the overhead itself).
+        t0 = time.perf_counter()
+        vc.load(duration_s, threads=8)
+        vc.join_load(timeout_s=duration_s + 60)
+        dt0 = time.perf_counter() - t0
+        with vc._lock:
+            ok0 = sum(1 for _t, ok in vc.op_events if ok)
+        vc.start_standby()
+        # Phase 1: steady state with the sync standby attached.
+        t0 = time.perf_counter()
+        vc.load(duration_s, threads=8)
+        vc.join_load(timeout_s=duration_s + 60)
+        dt = time.perf_counter() - t0
+        with vc._lock:
+            ok1 = sum(1 for _t, ok in vc.op_events if ok) - ok0
+        out["head_ops_per_s_300_with_standby"] = round(ok1 / dt, 1)
+        out["head_repl_overhead_ratio"] = round(
+            (ok1 / dt) / max(1e-9, ok0 / dt0), 3)
+        # Phase 2: the failover dip.
+        vc.load(duration_s + 4.0, threads=8)
+        time.sleep(2.0)
+        vc.kill_head()
+        vc.wait_promoted(timeout_s=60.0)
+        vc.join_load(timeout_s=duration_s + 120)
+        # Settle before the ledger check: a node mid-death-and-
+        # re-register would mis-classify its (legitimately dropped)
+        # actors as lost.
+        vc.wait_converged(timeout_s=60.0)
+        report = vc.verify()
+        assert report["missing"] == [], \
+            f"failover lost {len(report['missing'])} acked mutations"
+        assert report["stale_epoch_accepted"] == 0
+        out["head_failover_unavailability_ms"] = \
+            vc.unavailability_ms()
+    finally:
+        vc.stop()
+    return out
+
+
 def _head_persist_bench(n_ops: int = 400,
                         table_entries: int = 1500) -> dict:
     """Per-mutation persistence cost, journal WAL vs the seed's
@@ -1048,6 +1117,13 @@ def main():
         extra.update(_head_scale_bench())
     except Exception as e:  # noqa: BLE001
         extra["head_scale_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: head failover phase start", file=sys.stderr,
+          flush=True)
+    try:
+        extra.update(_head_failover_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["head_failover_error"] = f"{type(e).__name__}: {e}"
 
     print("bench: head persistence phase start", file=sys.stderr,
           flush=True)

@@ -23,13 +23,45 @@ import os
 import threading
 import time
 import traceback
+import uuid as uuid_mod
 from typing import Any, Dict, List, Optional, Tuple
 
-from .rpc import (TRANSPORT_ERRORS, ClientPool, Deferred,
-                  ReconnectingClient, RpcServer)
+from .rpc import (IDEMPOTENCY_KEY, TRANSPORT_ERRORS, ClientPool,
+                  Deferred, ReconnectingClient, RpcServer,
+                  _rpc_metrics)
 from .serialization import dumps, from_wire, loads, to_wire
 
 _HEARTBEAT_S = 1.0
+
+
+def parse_head_set(head_address: str) -> List[str]:
+    """Ordered head candidates for HA clusters: the explicit address
+    (a comma-separated set is allowed), then ``RAY_TPU_HEAD_SET``
+    (comma-separated), then the ``RAY_TPU_HEAD_SET_FILE`` seed file
+    (one address per line, ``#`` comments).  First entry is dialed
+    first; the rest are failover candidates.  Servers advertise the
+    live set on registration, so static discovery only needs to name
+    ONE reachable head."""
+    out: List[str] = []
+
+    def absorb(part: str) -> None:
+        part = part.strip()
+        if part and not part.startswith("#") and part not in out:
+            out.append(part)
+
+    for part in (head_address or "").split(","):
+        absorb(part)
+    for part in os.environ.get("RAY_TPU_HEAD_SET", "").split(","):
+        absorb(part)
+    seed_file = os.environ.get("RAY_TPU_HEAD_SET_FILE", "")
+    if seed_file:
+        try:
+            with open(seed_file) as fh:
+                for line in fh:
+                    absorb(line)
+        except OSError:
+            pass
+    return out or [head_address]
 
 
 def _try_mmap_shm(shm_path, size: int, meta):
@@ -382,10 +414,19 @@ class ClusterClient:
     def __init__(self, runtime, head_address: str,
                  node_name: str = "", labels: Optional[Dict] = None):
         self.runtime = runtime
-        # Reconnecting: a head restarting at the same address (GCS FT,
-        # file-backed tables) resumes service for this node.
-        self.head = ReconnectingClient(head_address)
-        self.head_address = head_address
+        # Reconnecting + head-set aware: a head restarting at the same
+        # address (GCS FT, file-backed tables) resumes service for
+        # this node, and a FAILOVER to a promoted standby walks the
+        # candidate list (static discovery via address/env/seed-file,
+        # live set advertised on registration).
+        candidates = parse_head_set(head_address)
+        self.head = ReconnectingClient(candidates[0],
+                                       candidates=candidates)
+        self.head_address = candidates[0]
+        # Newest head generation observed (fencing token): rides every
+        # mutating RPC so a deposed primary learns of its deposition
+        # from its own clients.
+        self._head_gen = 0
         self.pool = ClientPool()
         self.node_id = runtime.node_id.hex()
         self.node_name = node_name
@@ -481,20 +522,54 @@ class ClusterClient:
     def _register_with_head(self, deadline_s: float = 30.0) -> None:
         """(Re-)register and absorb the minted lease.  Each call mints
         a NEW epoch at the head — the previous one is fenced, which is
-        exactly the semantics re-attachment needs."""
-        resp = self.head.call_idempotent("register_node", {
-            "node_id": self.node_id,
-            "address": self.address,
-            "resources": dict(self.runtime.node_resources.total),
-            "labels": self._labels, "name": self.node_name,
-        }, deadline_s=deadline_s)
+        exactly the semantics re-attachment needs.  Head-set aware:
+        a typed NotPrimary rejection (the dialed candidate is a
+        not-yet-promoted standby, or a deposed ex-primary) walks the
+        set and retries under the same deadline — the budget spans a
+        promotion in flight."""
+        from ..exceptions import NotPrimaryError
+
+        deadline = time.monotonic() + deadline_s
+        backoff = 0.05
+        while True:
+            left = max(1.0, deadline - time.monotonic())
+            try:
+                resp = self.head.call_idempotent("register_node", {
+                    "node_id": self.node_id,
+                    "address": self.address,
+                    "resources": dict(
+                        self.runtime.node_resources.total),
+                    "labels": self._labels, "name": self.node_name,
+                }, deadline_s=left)
+                break
+            except NotPrimaryError as e:
+                if time.monotonic() + backoff >= deadline:
+                    raise
+                if e.primary_hint:
+                    self.head.set_candidates([e.primary_hint])
+                self.head.failover()
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
         self._epoch = resp.get("epoch")
         self._lease_id = resp.get("lease_id", "")
         self._lease_ttl = float(resp.get("lease_ttl_s") or 10.0)
+        self._absorb_head_info(resp)
         # Fresh lease: resync both delta streams from scratch.
         self._hb_last_avail = None
         with self._loc_lock:
             self._view_seq = None
+
+    def _absorb_head_info(self, resp) -> None:
+        """Track the advertised head set + newest generation (any
+        reply that carries them: registration, heartbeats, typed
+        fencing rejections' hints)."""
+        if not isinstance(resp, dict):
+            return
+        if resp.get("head_set"):
+            self.head.set_candidates(resp["head_set"])
+        gen = resp.get("head_gen")
+        if gen and int(gen) > self._head_gen:
+            self._head_gen = int(gen)
 
     @property
     def epoch(self) -> Optional[int]:
@@ -504,26 +579,77 @@ class ClusterClient:
     def mut_call(self, method: str, payload: Dict[str, Any], *,
                  deadline_s: float = 30.0,
                  timeout: Optional[float] = None) -> Any:
-        """Mutating head RPC: idempotency key + lease epoch.  On
-        ``StaleEpochError`` — the head declared this node dead while
-        we were partitioned — re-register once (minting a fresh epoch)
-        and retry: this process holds live state, it is not a zombie;
-        the typed rejection is for writers that never come back."""
-        from ..exceptions import StaleEpochError
+        """Mutating head RPC: idempotency key + lease epoch + head
+        generation, driven to completion under ONE deadline across
+        every fencing outcome:
 
-        keyed = {**payload, "epoch": self._epoch,
-                 "epoch_node": self.node_id}
-        try:
-            return self.head.call_idempotent(
-                method, keyed, deadline_s=deadline_s, timeout=timeout)
-        except StaleEpochError:
-            self._register_with_head(deadline_s=deadline_s)
-            keyed["epoch"] = self._epoch
-            return self.head.call_idempotent(
-                method, keyed, deadline_s=deadline_s, timeout=timeout)
+        - transport failure → backoff-retry with the SAME idempotency
+          key (a reply lost to a head kill -9 dedups after recovery —
+          or after FAILOVER: the cache replicates with the journal);
+        - ``NotPrimaryError`` (standby, or deposed primary) → absorb
+          the primary hint, fail the connection over to the next head
+          candidate, retry — still the same key, so a retry straddling
+          a promotion replays the first reply instead of re-applying;
+        - ``StaleEpochError`` — the head declared this node dead while
+          we were partitioned — re-register once (minting a fresh
+          epoch) and retry: this process holds live state, it is not
+          a zombie; the typed rejection is for writers that never
+          come back."""
+        from ..exceptions import NotPrimaryError, StaleEpochError
+
+        key = uuid_mod.uuid4().hex
+        deadline = time.monotonic() + deadline_s
+        backoff = 0.05
+        reregistered = False
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    f"mut_call {method!r} exhausted its "
+                    f"{deadline_s:.0f}s deadline")
+            keyed = {**payload, "epoch": self._epoch,
+                     "epoch_node": self.node_id,
+                     "head_gen": self._head_gen,
+                     IDEMPOTENCY_KEY: key}
+            per_call = left if timeout is None else min(timeout, left)
+            try:
+                reply = self.head.call(method, keyed, per_call)
+                self._absorb_head_info(reply)
+                return reply
+            except NotPrimaryError as e:
+                # MUST precede the StaleEpochError arm (subclass).
+                if e.primary_hint:
+                    self.head.set_candidates([e.primary_hint])
+                if time.monotonic() + backoff >= deadline:
+                    raise
+                self.head.failover()
+            except StaleEpochError:
+                if reregistered:
+                    raise
+                reregistered = True
+                try:
+                    self._register_with_head(
+                        deadline_s=max(1.0,
+                                       deadline - time.monotonic()))
+                    continue  # fresh epoch: retry immediately
+                except NotPrimaryError as e:
+                    # Registration raced a failover: fail over and
+                    # let the loop re-register via the next
+                    # StaleEpochError (the flag resets for it).
+                    reregistered = False
+                    if e.primary_hint:
+                        self.head.set_candidates([e.primary_hint])
+                    self.head.failover()
+            except (ConnectionError, TimeoutError):
+                if time.monotonic() + backoff >= deadline:
+                    raise
+                _rpc_metrics()["retries"].inc(tags={"method": method})
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 2.0)
 
     # ---------------------------------------------------------- heartbeat
     def _heartbeat_loop(self):
+        standby_beats = 0
         while not self._stopped.wait(_HEARTBEAT_S):
             try:
                 p: Dict[str, Any] = {"node_id": self.node_id,
@@ -535,6 +661,23 @@ class ClusterClient:
                 if avail != self._hb_last_avail:
                     p["available"] = avail
                 resp = self.head.call("heartbeat", p, timeout=5.0)
+                self._absorb_head_info(resp)
+                if resp.get("standby"):
+                    standby_beats += 1
+                    if resp.get("deposed") or standby_beats >= 3:
+                        # A fenced ex-primary — or a standby that is
+                        # NOT promoting (the real primary is alive;
+                        # we landed here off a transient dial
+                        # failure).  Either way these ok-looking
+                        # beats renew nothing: our lease is expiring
+                        # at the real primary — walk the head set.
+                        self.head.failover()
+                        standby_beats = 0
+                    # Else mid-failover: this head has not promoted
+                    # yet.  Keep beating — the next beat lands on
+                    # the promoted head or fails back over.
+                    continue
+                standby_beats = 0
                 if resp.get("reregister"):
                     # The head restarted/lost this node or fenced our
                     # lease: re-attach with a fresh epoch (reference:

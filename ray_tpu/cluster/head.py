@@ -45,9 +45,12 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..exceptions import StaleEpochError
 from . import journal as journal_mod
+from .replication import ReplicationSender, _repl_metrics
+from .retention import DiskRing
 from .rpc import (IDEMPOTENCY_KEY, ClientPool, IdempotencyCache,
-                  RpcServer, _rpc_metrics)
+                  RpcClient, RpcServer, _rpc_metrics)
 from .serialization import loads
 from .tables import ShardedTable
 
@@ -161,7 +164,11 @@ class HeadServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  storage_path: Optional[str] = None,
                  lease_ttl_s: Optional[float] = None,
-                 persist_mode: Optional[str] = None):
+                 persist_mode: Optional[str] = None,
+                 standby_of: Optional[str] = None,
+                 repl_mode: Optional[str] = None,
+                 primary_ttl_s: Optional[float] = None,
+                 repl_timeout_s: Optional[float] = None):
         # RLock: the _mut wrapper holds it across {epoch fence +
         # handler} so a node cannot be declared dead (epoch fenced)
         # between the check and the table write — the handlers
@@ -250,7 +257,53 @@ class HeadServer:
             "RAY_TPU_HEAD_PERSIST_MODE", "journal"))
         self._legacy_dirty = False
         self._log: Optional[journal_mod.JournalWriter] = None
+        # Replicated-head role state (docs/fault_tolerance.md, "True
+        # head HA").  Head GENERATIONS are cluster-scope fencing
+        # tokens: the standby inherits the primary's at seed time and
+        # mints gen+1 at promotion; a head holding an older generation
+        # rejects every mutation typed (NotPrimaryError) — a deposed
+        # primary can never ack again.
+        self._standby_of = standby_of
+        self._is_primary = standby_of is None
+        self._deposed = False
+        self._known_primary = standby_of or ""
+        self._generation = 1
+        self._applied_seq = 0   # standby: last journal seq applied
+        self._repl_mode = (repl_mode or os.environ.get(
+            "RAY_TPU_HEAD_REPL_MODE", "sync"))
+        self._primary_ttl = (primary_ttl_s if primary_ttl_s is not None
+                             else _env_f("RAY_TPU_HEAD_PRIMARY_TTL_S",
+                                         self._lease_ttl))
+        self._repl_timeout = (repl_timeout_s
+                              if repl_timeout_s is not None
+                              else _env_f("RAY_TPU_HEAD_REPL_TIMEOUT_S",
+                                          5.0))
+        self._primary_lease_expires = 0.0
+        # Standby gate: repl traffic parks here until the seed applied.
+        self._repl_ready = threading.Event()
+        self._repl: Optional[ReplicationSender] = None
+        self._recovered_seqno = 0
+        self._resume_restarting: List[bytes] = []
+        # Historical retention: size-capped on-disk rings next to the
+        # journal absorb every event/log ingest, so timeline/log
+        # queries with history=True outlive the bounded in-memory
+        # windows (and a promoted standby can answer them — the
+        # replication side-stream feeds ITS rings).
+        self._events_ring: Optional[DiskRing] = None
+        self._logs_ring: Optional[DiskRing] = None
         if storage_path:
+            retain = int(_env_f("RAY_TPU_HEAD_RETAIN_BYTES", 32 << 20))
+            if retain > 0:
+                self._events_ring = DiskRing(
+                    storage_path + ".events", retain)
+                self._logs_ring = DiskRing(
+                    storage_path + ".logs", retain)
+        if storage_path and not self._is_primary:
+            # Standby: local state is stale by definition — it seeds
+            # fresh from the primary below; _apply_seed folds the seed
+            # into a local snapshot + fresh WAL.
+            pass
+        elif storage_path:
             self._recover()
             if self._persist_mode == "journal":
                 self._log = journal_mod.JournalWriter(
@@ -278,6 +331,10 @@ class HeadServer:
             not ship before its redo records are fsync'd)."""
 
             def wrapped(payload):
+                # Generation fence FIRST: a standby or deposed primary
+                # must not ack (not even from the idempotency cache —
+                # its cache may be behind the new primary's).
+                self._check_primary_for_mutation(payload, fn.__name__)
                 key = (payload.pop(IDEMPOTENCY_KEY, None)
                        if isinstance(payload, dict) else None)
                 if key is None:
@@ -298,6 +355,13 @@ class HeadServer:
                     if hit:
                         _rpc_metrics()["idem_hits"].inc(
                             tags={"method": fn.__name__})
+                        # The cached reply must not ack ahead of the
+                        # durability/replication barrier: the FIRST
+                        # delivery may have journaled + cached but
+                        # failed its sync-mode standby ack — a
+                        # barrier-less cache hit here would ack a
+                        # mutation a failover then loses.
+                        self._commit_persist()
                         return reply
                     ev, mine = self._idem.claim(key)
                     if not mine:
@@ -349,8 +413,24 @@ class HeadServer:
             "cluster_timeline": self._cluster_timeline,
             "cluster_metrics": self._cluster_metrics,
             "cluster_logs": self._cluster_logs,
+            # Replicated-head protocol (replication.py is the caller
+            # for the repl_* stream; promote/repl_status/repl_control
+            # are driven by tools/vcluster.py and ops tooling).
+            "standby_attach": self._standby_attach,
+            "repl_frames": self._repl_frames,  # raylint: disable=journaled-mutation -- IS the replication apply path: records arrive journaled by the primary and land in this head's own WAL via append_replica before the ack
+            "repl_heartbeat": self._repl_heartbeat,
+            "repl_seed": self._repl_seed,  # raylint: disable=journaled-mutation -- full-snapshot re-seed: the state replaces the tables wholesale and is folded into a local snapshot + fresh WAL segment atomically
+            "repl_events": self._repl_events,
+            "repl_status": self._repl_status,  # raylint: disable=rpc-protocol -- driven by tools/vcluster.py, bench.py and ops tooling (out of package)
+            "repl_control": self._repl_control,  # raylint: disable=rpc-protocol -- chaos/ops hook driven by tools/vcluster.py (partition_heads, detach_standby)
+            "promote": self._promote_rpc,  # raylint: disable=rpc-protocol -- driven by tools/vcluster.py promote() and failover runbooks (out of package)
             "ping": lambda p: "pong",  # raylint: disable=rpc-protocol -- liveness probe for out-of-package callers (tests, ops tooling, vcluster)
-        }, host=host, port=port)
+        }, host=host, port=port,
+            # The replication stream is serialized by the sender's
+            # ship lock and NEEDS arrival order; running it inline on
+            # the connection reader also saves a thread spawn per
+            # shipped batch — the hot path of every sync-mode ack.
+            ordered={"repl_frames", "repl_heartbeat", "repl_events"})
         # Batched long-poll pubsub: node deaths and actor FSM
         # transitions fan out through one outstanding poll per
         # subscriber (src/ray/pubsub/README.md:1-44).
@@ -371,14 +451,29 @@ class HeadServer:
         self._reaper.start()
         self._compactor: Optional[threading.Thread] = None
         if self._log is not None:
-            self._compactor = threading.Thread(
-                target=self._compact_loop, daemon=True)
-            self._compactor.start()
+            self._ensure_compactor()
         resume = getattr(self, "_resume_restarting", None)
         if resume:
             with self._restart_cond:
                 self._restart_pending.extend(resume)
                 self._restart_cond.notify_all()
+        self._standby_watch: Optional[threading.Thread] = None
+        if not self._is_primary:
+            # Standby boot: seed from the primary (registering our
+            # address as its replication target), then watch its
+            # lease — promotion fires when it lapses.
+            self._seed_from_primary()
+            self._standby_watch = threading.Thread(
+                target=self._standby_watch_loop, daemon=True,
+                name="head-standby-watch")
+            self._standby_watch.start()
+        _repl_metrics()["generation"].set(float(self._generation))
+
+    def _ensure_compactor(self) -> None:
+        if self._compactor is None and self._log is not None:
+            self._compactor = threading.Thread(
+                target=self._compact_loop, daemon=True)
+            self._compactor.start()
 
     # ---------------------------------------------------- persistence
     def _journal(self, record: Dict[str, Any]) -> None:
@@ -394,9 +489,24 @@ class HeadServer:
         """Durability barrier before a mutation's reply ships: fsync
         the journal tail (one fsync amortizes every record the RPC
         produced) — or, in legacy snapshot mode, rewrite the whole
-        snapshot (the seed behavior the bench compares against)."""
+        snapshot (the seed behavior the bench compares against).
+        With a standby attached in sync mode, the barrier ALSO waits
+        for the standby's durable ack: an acked mutation is then on
+        both disks, and failover loses nothing acked."""
         if self._log is not None:
-            self._log.commit()
+            repl = self._repl
+            active = (repl is not None and repl.attached
+                      and self._is_primary and not self._deposed)
+            if active:
+                # Overlap: the background shipper puts the frames on
+                # the wire while we fsync locally; the barrier then
+                # usually finds its ack already absorbed.
+                target = self._log.seqno
+                repl.kick()
+                self._log.commit()
+                repl.commit_barrier(target)
+            else:
+                self._log.commit()
         elif self._storage_path and self._legacy_dirty:
             with self._lock:
                 state = self._state_locked()
@@ -438,6 +548,399 @@ class HeadServer:
                 node_id=nid, sent_epoch=sent, current_epoch=current,
                 context={"method": method})
 
+    # ---------------------------------------------------- replication
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    @property
+    def deposed(self) -> bool:
+        return self._deposed
+
+    def journal_seqno(self) -> int:
+        return (self._log.seqno if self._log is not None
+                else self._recovered_seqno)
+
+    def _head_set_list(self) -> List[str]:
+        """Ordered candidate list clients should hold: believed
+        primary first, then the standby."""
+        if self._is_primary and not self._deposed:
+            out = [self.address]
+            if self._repl is not None and self._repl.attached:
+                out.append(self._repl.standby_address)
+            return out
+        primary = self._known_primary or self._standby_of or ""
+        return ([primary, self.address] if primary
+                else [self.address])
+
+    def _check_primary_for_mutation(self, payload, method: str) -> None:
+        """Cluster-scope fencing token check, run before every durable
+        mutation: (1) a client that has seen a NEWER head generation
+        deposes this head on contact — fencing propagates through
+        clients even while the heads are partitioned from each other;
+        (2) a standby or deposed head rejects typed with a hint at the
+        believed primary."""
+        from ..exceptions import NotPrimaryError
+
+        sent_gen = (payload.pop("head_gen", None)
+                    if isinstance(payload, dict) else None)
+        if sent_gen is not None and int(sent_gen) > self._generation:
+            self._depose(int(sent_gen))
+        if self._is_primary and not self._deposed:
+            return
+        _lease_metrics()["stale_rejections"].inc(
+            tags={"method": method})
+        raise NotPrimaryError(
+            ("head deposed by a newer generation"
+             if self._deposed else
+             "standby head cannot ack mutations"),
+            generation=self._generation,
+            primary_hint=(self._known_primary
+                          or self._standby_of or ""),
+            context={"method": method})
+
+    def _depose(self, gen: int, hint: str = "") -> None:
+        """This head learned of a newer generation: it is no longer
+        primary and must never ack a mutation again (zombie-write
+        fencing at cluster scope).  Idempotent."""
+        with self._lock:
+            if self._deposed and gen <= self._generation:
+                return
+            self._deposed = True
+            if hint:
+                self._known_primary = hint
+        import logging
+
+        logging.getLogger("ray_tpu.head").warning(
+            "head %s deposed: generation %d superseded by %d "
+            "(new primary: %s)", self.address, self._generation,
+            gen, hint or "unknown")
+
+    def build_seed(self) -> Tuple[Dict[str, Any], int, int]:
+        """(state, seqno, generation) snapshot for seeding a standby,
+        captured atomically against the journal tap."""
+        with self._lock:
+            return (self._state_locked(), self.journal_seqno(),
+                    self._generation)
+
+    def _standby_attach(self, p):
+        """A standby registered itself (payload: its address).  The
+        reply carries the full seed; the state capture, watermark
+        reset, and sender attach form ONE critical section against
+        the journal tap, so every record past ``seqno`` reaches the
+        standby as a frame and nothing is ever in neither."""
+        if not self._is_primary or self._deposed:
+            from ..exceptions import NotPrimaryError
+
+            raise NotPrimaryError(
+                "standby_attach on a non-primary head",
+                generation=self._generation,
+                primary_hint=self._known_primary or "")
+        if self._log is None:
+            return {"ok": False,
+                    "error": "head HA requires journal persist mode "
+                             "(construct the primary with a "
+                             "storage_path and persist_mode="
+                             "'journal')"}
+        address = p["address"]
+        with self._lock:
+            if self._repl is None:
+                self._repl = ReplicationSender(
+                    self, self._repl_mode,
+                    primary_ttl_s=self._primary_ttl,
+                    sync_timeout_s=self._repl_timeout)
+                self._log.set_tap(self._repl.offer)
+            state = self._state_locked()
+            seqno = self._log.seqno
+            self._repl.attach(address, seqno)
+        _repl_metrics()["standby_up"].set(1.0)
+        return {"ok": True, "state": state, "seqno": seqno,
+                "gen": self._generation,
+                "mode": self._repl_mode,
+                "primary_ttl_s": self._primary_ttl,
+                "primary": self.address}
+
+    def _seed_from_primary(self, deadline_s: float = 30.0) -> None:
+        """Standby boot: attach to the primary and apply its seed.
+        Retries transport failures under a deadline — a standby that
+        cannot reach its primary at boot is a misconfiguration."""
+        deadline = time.monotonic() + deadline_s
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                client = RpcClient(self._standby_of,
+                                   connect_timeout=5.0)
+                try:
+                    resp = client.call(
+                        "standby_attach", {"address": self.address},
+                        timeout=max(10.0, self._repl_timeout))
+                finally:
+                    client.close()
+                if not resp.get("ok"):
+                    raise RuntimeError(resp.get("error") or
+                                       "standby_attach rejected")
+                self._apply_seed(resp["state"], resp["seqno"],
+                                 resp["gen"])
+                if resp.get("primary_ttl_s"):
+                    self._primary_ttl = float(resp["primary_ttl_s"])
+                self._known_primary = resp.get("primary",
+                                               self._standby_of)
+                return
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last = e
+                time.sleep(0.2)
+        raise ConnectionError(
+            f"standby could not seed from primary "
+            f"{self._standby_of}: {last}")
+
+    def _apply_seed(self, state: Dict[str, Any], seqno: int,
+                    gen: int) -> None:
+        """Replace local state with the primary's seed and fold it
+        into a local snapshot + fresh WAL segment, so a promoted (or
+        locally restarted) standby recovers from its OWN disk."""
+        seqno = int(seqno)
+        with self._lock:
+            self._nodes.clear()
+            self._load_state(state)
+            self._generation = int(gen)
+            self._recovered_seqno = seqno
+            self._applied_seq = seqno
+            if self._storage_path:
+                if self._log is None:
+                    journal_mod.write_snapshot(
+                        self._storage_path, state, seqno)
+                    # First boot as standby: any WAL left by a PRIOR
+                    # life of this storage (e.g. a deposed ex-primary
+                    # rejoining as standby) may hold a DIVERGED,
+                    # never-acked tail past the seed seqno — a later
+                    # local recovery would replay those zombie
+                    # records on top of the seed.  The seed
+                    # supersedes everything: drop the old segments.
+                    for _idx, seg_path in journal_mod.list_segments(
+                            self._storage_path):
+                        try:
+                            os.unlink(seg_path)
+                        except OSError:
+                            pass
+                    self._log = journal_mod.JournalWriter(
+                        self._storage_path, start_seqno=seqno)
+                else:
+                    # Mid-life re-seed (we fell behind the sender's
+                    # buffer): rotate first so every pre-seed segment
+                    # is droppable, then snapshot at the seed seqno.
+                    new_seg = self._log.rotate()
+                    journal_mod.write_snapshot(
+                        self._storage_path, state, seqno)
+                    self._log.drop_segments_before(new_seg)
+                    self._log.advance_seqno(seqno)
+            self._primary_lease_expires = (time.monotonic()
+                                           + self._primary_ttl)
+        self._ensure_compactor()
+        _repl_metrics()["generation"].set(float(self._generation))
+        self._repl_ready.set()
+
+    def _repl_frames(self, p):
+        """Standby tail: apply a run of journal frames, append them to
+        the local WAL (primary seqnos preserved), fsync, then ack the
+        durable watermark.  A torn tail in the payload acks only the
+        complete prefix — the sender re-ships from the watermark.
+        Generation rules: a frame stream from an OLDER generation than
+        ours means we promoted past that primary — answer typed so it
+        deposes itself."""
+        from ..exceptions import NotPrimaryError
+
+        gen = int(p.get("gen") or 0)
+        if self._is_primary or gen < self._generation:
+            raise NotPrimaryError(
+                "replication frames from a superseded primary",
+                generation=self._generation,
+                primary_hint=self.address,
+                context={"promoted": True})
+        if not self._repl_ready.wait(timeout=10.0):
+            return {"ok": False, "applied_seq": 0, "unseeded": True,
+                    "gen": self._generation}
+        records, _consumed, torn = journal_mod.parse_frames(
+            p.get("frames") or b"")
+        with self._lock:
+            if gen > self._generation:
+                self._generation = gen
+            for rec in records:
+                seq = int(rec.get("seq") or 0)
+                if seq <= self._applied_seq:
+                    continue  # duplicate re-ship after a lost ack
+                if seq > self._applied_seq + 1:
+                    # Gap (a pipelined batch raced a sender rewind):
+                    # ack only the contiguous prefix — the sender
+                    # re-ships from the watermark or re-seeds.
+                    break
+                self._apply_record(rec)
+                if self._log is not None:
+                    self._log.append_replica(rec)
+                self._applied_seq = seq
+            self._primary_lease_expires = (time.monotonic()
+                                           + self._primary_ttl)
+        if self._log is not None:
+            # Flush (no fsync) before the ack: the record is already
+            # fsync'd on the PRIMARY's disk, so single-fault zero-loss
+            # holds; the watch loop fsyncs on its cadence so a
+            # promoted standby's own WAL converges to durable.
+            self._log.flush()
+        return {"ok": True, "applied_seq": self._applied_seq,
+                "gen": self._generation, "torn": bool(torn)}
+
+    def _repl_heartbeat(self, p):
+        """Idle-stream primary lease renewal + watermark exchange."""
+        from ..exceptions import NotPrimaryError
+
+        gen = int(p.get("gen") or 0)
+        if self._is_primary or gen < self._generation:
+            raise NotPrimaryError(
+                "replication heartbeat from a superseded primary",
+                generation=self._generation,
+                primary_hint=self.address,
+                context={"promoted": True})
+        self._primary_lease_expires = (time.monotonic()
+                                       + self._primary_ttl)
+        return {"ok": True, "applied_seq": self._applied_seq,
+                "gen": self._generation}
+
+    def _repl_seed(self, p):
+        """Mid-life full re-seed (standby fell behind the sender's
+        buffer, or re-attached after a crash with a stale WAL)."""
+        from ..exceptions import NotPrimaryError
+
+        gen = int(p.get("gen") or 0)
+        if self._is_primary or gen < self._generation:
+            raise NotPrimaryError(
+                "replication seed from a superseded primary",
+                generation=self._generation,
+                primary_hint=self.address,
+                context={"promoted": True})
+        self._apply_seed(p["state"], p["seqno"], gen)
+        return {"ok": True, "applied_seq": self._applied_seq,
+                "gen": self._generation}
+
+    def _repl_events(self, p):
+        """Observability side-stream: the primary forwards event/log
+        flushes so this standby can answer timeline/log queries after
+        promotion.  Reuses the push_events ingest wholesale."""
+        return self._push_events(p)
+
+    def _repl_status(self, p):
+        """Role/generation/watermark introspection (vcluster, bench,
+        runbooks).  ``{"digest": True}`` adds per-table content
+        digests — the divergence probe the failover tests compare
+        across the pair."""
+        out: Dict[str, Any] = {
+            "role": ("primary" if self._is_primary else "standby"),
+            "deposed": self._deposed,
+            "generation": self._generation,
+            "address": self.address,
+            "seqno": self.journal_seqno(),
+            "applied_seq": self._applied_seq,
+            "head_set": self._head_set_list(),
+            "tables": {"kv": len(self._kv),
+                       "actors": len(self._actors),
+                       "named": len(self._named),
+                       "pgs": len(self._pgs),
+                       "nodes": len(self._nodes)},
+        }
+        if isinstance(p, dict) and p.get("digest"):
+            out["digests"] = {"kv": self._kv.digest(),
+                              "actors": self._actors.digest(),
+                              "named": self._named.digest(),
+                              "pgs": self._pgs.digest()}
+        if self._repl is not None:
+            repl = self._repl.status()
+            out["repl"] = repl
+            out["synced"] = (repl["lag_entries"] == 0
+                            and repl["acked_seq"]
+                            >= self.journal_seqno())
+        if not self._is_primary:
+            # Seed applied = synced (the watermark starts AT the seed
+            # seqno — which is legitimately 0 on a fresh primary).
+            out["synced"] = self._repl_ready.is_set()
+            out["primary_lease_remaining_s"] = round(
+                self._primary_lease_expires - time.monotonic(), 3)
+        return out
+
+    def _repl_control(self, p):
+        """Chaos/ops hooks on the replication stream:
+        ``{"partition_s": X}`` drops all repl traffic for X seconds
+        (the standby sees a silent primary and promotes);
+        ``{"detach_standby": True}`` dissolves the HA pair."""
+        if p.get("partition_s") and self._repl is not None:
+            self._repl.partition(float(p["partition_s"]))
+        if p.get("detach_standby") and self._repl is not None:
+            self._repl.detach()
+        return {"ok": True}
+
+    def _promote_rpc(self, p):
+        return self.promote(reason=(p or {}).get("reason", "manual"))
+
+    def promote(self, reason: str = "manual") -> Dict[str, Any]:
+        """Standby → primary: mint generation+1 (the new fencing
+        token), journal it, re-arm the lease grace window (nodes keep
+        their replicated leases and reattach by heartbeat), and
+        resume the restart/reap duties a standby held back."""
+        with self._lock:
+            if self._is_primary:
+                return {"ok": True, "gen": self._generation,
+                        "already_primary": True}
+            self._is_primary = True
+            self._deposed = False
+            self._known_primary = self.address
+            self._generation += 1
+            self._journal({"op": "head_gen",
+                           "gen": self._generation})
+            # Nodes heartbeat the old address for a beat or two:
+            # give them one lease of grace before reaping, exactly
+            # like restart recovery.
+            self._replay_grace_until = (time.monotonic()
+                                        + self._lease_ttl)
+            now = time.monotonic()
+            for e in self._nodes.values():
+                if e.alive:
+                    e.last_heartbeat = now
+                    e.lease_expires = now + self._lease_ttl
+                    e.await_avail = True
+            resume = [aid for aid, info in self._actors.items()
+                      if info.get("state") == "RESTARTING"]
+        self._commit_persist()
+        m = _repl_metrics()
+        m["failovers"].inc()
+        m["generation"].set(float(self._generation))
+        import logging
+
+        logging.getLogger("ray_tpu.head").warning(
+            "head %s promoted to primary (generation %d, %s)",
+            self.address, self._generation, reason)
+        self._publisher.publish("head_change", {
+            "address": self.address,
+            "generation": self._generation, "reason": reason})
+        if resume:
+            with self._restart_cond:
+                self._restart_pending.extend(resume)
+                self._restart_cond.notify_all()
+        return {"ok": True, "gen": self._generation}
+
+    def _standby_watch_loop(self):
+        """Promotion timer: the primary's lease is renewed by every
+        frame/heartbeat it ships; when it lapses for one primary TTL,
+        this standby takes over."""
+        poll = max(0.05, min(0.25, self._primary_ttl / 4))
+        while not self._stop.wait(poll):
+            if self._is_primary:
+                return
+            if not self._repl_ready.is_set():
+                continue
+            if self._log is not None:
+                # Cadence fsync of the tailed WAL (acks only flush).
+                self._log.commit()
+            if time.monotonic() > self._primary_lease_expires:
+                self.promote(reason="primary lease lapsed")
+                return
+
     def _state_locked(self) -> Dict[str, Any]:
         """Serializable durable state (caller holds self._lock)."""
         return {
@@ -453,6 +956,7 @@ class HeadServer:
                 "alive": e.alive,
             } for e in self._nodes.values()},
             "epoch_counter": self._epoch_counter,
+            "head_gen": self._generation,
             "idem": self._idem.export(),
         }
 
@@ -462,6 +966,8 @@ class HeadServer:
         self._actors.replace_all(state.get("actors") or {})
         self._pgs.replace_all(state.get("pgs") or {})
         self._epoch_counter = int(state.get("epoch_counter") or 0)
+        self._generation = max(self._generation,
+                               int(state.get("head_gen") or 1))
         self._idem.load(state.get("idem") or {})
         now = time.monotonic()
         for nid, rec in (state.get("nodes") or {}).items():
@@ -529,6 +1035,10 @@ class HeadServer:
                 entry.alive = False  # epoch stays fenced
         elif op == "node_del":
             self._nodes.pop(rec["node_id"], None)
+        elif op == "head_gen":
+            # Promotion fencing token: the counter only climbs.
+            self._generation = max(self._generation,
+                                   int(rec.get("gen") or 1))
         elif op == "idem":
             self._idem.put(rec["key"], rec["reply"])
 
@@ -632,7 +1142,9 @@ class HeadServer:
         _lease_metrics()["grants"].inc()
         return {"ok": True, "num_nodes": len(self._nodes),
                 "lease_id": lease_id, "epoch": epoch,
-                "lease_ttl_s": self._lease_ttl}
+                "lease_ttl_s": self._lease_ttl,
+                "head_gen": self._generation,
+                "head_set": self._head_set_list()}
 
     def _heartbeat_one(self, p) -> Dict[str, Any]:
         """One node's beat: lease renewal + availability delta absorb.
@@ -685,7 +1197,8 @@ class HeadServer:
             self._journal({"op": "node_res", "node_id": p["node_id"],
                            "remove": list(p["remove_resources"])})
         reply = {"ok": True, "epoch": entry.epoch,
-                 "lease_ttl_s": self._lease_ttl}
+                 "lease_ttl_s": self._lease_ttl,
+                 "head_gen": self._generation}
         if entry.await_avail:
             # Journal-replayed entry: the head has registration-time
             # totals but no live availability — ask for a full report.
@@ -706,7 +1219,12 @@ class HeadServer:
             return {"available": dict(e.available),
                     "total": dict(e.total), "alive": True}
 
-        if client_seq is None or client_seq < self._view_floor:
+        if (client_seq is None or client_seq < self._view_floor
+                or client_seq > self._view_seq):
+            # ``client_seq > _view_seq``: a cursor minted against
+            # ANOTHER head's sequence space (the node failed over to
+            # a promoted standby) — resync with a full view, same as
+            # the pubsub cursor clamp.
             out["view_full"] = {e.node_id: rec(e)
                                 for e in self._nodes.values() if e.alive}
             return out
@@ -732,6 +1250,18 @@ class HeadServer:
             self._view_floor = floor_seq
 
     def _heartbeat(self, p):
+        if not self._is_primary or self._deposed:
+            # Pre-promotion standby: do NOT answer ``reregister`` (a
+            # re-registration would be refused typed anyway) — the
+            # client keeps beating and lands once we promote or it
+            # fails back over to the primary.  A DEPOSED primary
+            # additionally says so: its nodes must fail over NOW, or
+            # the new primary's reaper fences their leases while
+            # they beat a fenced head believing themselves healthy.
+            return {"ok": False, "standby": True,
+                    "deposed": self._deposed,
+                    "head_gen": self._generation,
+                    "head_set": self._head_set_list()}
         with self._lock:
             reply = self._heartbeat_one(p)
             # The one-off PG-capacity calls carry no view_seq field
@@ -748,6 +1278,11 @@ class HeadServer:
         replies plus a single shared view payload — at 300 nodes this
         collapses 300 round-trips and 300 view assemblies per interval
         into one of each."""
+        if not self._is_primary or self._deposed:
+            return {"ok": False, "standby": True,
+                    "deposed": self._deposed,
+                    "head_gen": self._generation,
+                    "head_set": self._head_set_list(), "replies": []}
         replies = []
         with self._lock:
             for beat in p.get("beats") or ():
@@ -840,6 +1375,23 @@ class HeadServer:
             meta["ts"] = time.monotonic()
             if p.get("metrics") is not None:
                 self._node_metrics[node_id] = p["metrics"]
+        # Historical retention: every ingest also lands in the
+        # size-capped disk rings next to the journal (history=True
+        # queries outlive the bounded in-memory windows).
+        if self._events_ring is not None and events:
+            # Stamp the origin node on the ring copy (shallow): the
+            # disk view has no per-node store dimension to recover it
+            # from.
+            self._events_ring.append_many(
+                [{**e, "node": node_id} for e in events])
+        if self._logs_ring is not None and records:
+            self._logs_ring.append_many(records)
+        # Observability side-stream to the standby (best-effort,
+        # bounded, never blocks this ack): a promoted standby can
+        # answer timeline/log queries about the pre-failover cluster.
+        repl = self._repl
+        if repl is not None and repl.attached and self._is_primary:
+            repl.offer_events(dict(p))
         if records:
             # Follow-mode fanout: one pubsub batch per ingested flush
             # (`ray_tpu logs -f` long-polls the "logs" channel).  A
@@ -862,13 +1414,19 @@ class HeadServer:
 
         p = dict(p or {})
         limit = int(p.pop("limit", 1000) or 1000)
+        history = bool(p.pop("history", False))
         known = {"trace_id", "node", "actor", "level", "logger",
                  "since", "until", "text"}
         filters = {k: v for k, v in p.items()
                    if k in known and v is not None}
-        with self._events_lock:
-            records = [r for store in self._node_logs.values()
-                       for r in store]
+        if history and self._logs_ring is not None:
+            # The on-disk ring: a longer window than the in-memory
+            # store (size-capped in bytes, not records), same filters.
+            records = list(self._logs_ring.scan())
+        else:
+            with self._events_lock:
+                records = [r for store in self._node_logs.values()
+                           for r in store]
         out = filter_records(records, limit=limit, **filters)
         return {"records": out, "total_stored": len(records)}
 
@@ -899,6 +1457,29 @@ class HeadServer:
         node_id = p.get("node_id") if isinstance(p, dict) else None
         with_logs = (p.get("with_logs", True) if isinstance(p, dict)
                      else True)
+        history = (p.get("history", False) if isinstance(p, dict)
+                   else False)
+        if history and self._events_ring is not None:
+            # Disk-ring view: the size-capped window that outlives
+            # RAY_TPU_HEAD_EVENTS_MAX (post-mortems; a promoted
+            # standby serves its side-stream-fed copy).
+            events = [e for e in self._events_ring.scan()
+                      if node_id is None
+                      or e.get("node") == node_id]
+            records = [r for r in self._logs_ring.scan()
+                       if node_id is None
+                       or r.get("node") == node_id] \
+                if (with_logs and self._logs_ring is not None) else []
+            with self._events_lock:
+                nodes = list(self._node_events)
+                meta = {nid: dict(m)
+                        for nid, m in self._node_event_meta.items()}
+            if records:
+                from ..observability.logs import to_timeline_events
+
+                events = events + to_timeline_events(records)
+            return {"events": events, "nodes": nodes, "meta": meta,
+                    "history": True}
         with self._events_lock:
             if node_id is not None:
                 events = list(self._node_events.get(node_id, ()))
@@ -978,6 +1559,9 @@ class HeadServer:
                         return
                     self._restart_cond.wait(timeout=1.0)
                 aid = self._restart_pending.pop(0)
+                if not self._is_primary or self._deposed:
+                    continue  # standby: replicated RESTARTING entries
+                    # re-enqueue at promotion, not here
                 info = self._actors.get(aid)
                 if info is None or info.get("state") != "RESTARTING":
                     continue
@@ -1035,7 +1619,10 @@ class HeadServer:
                     if info.get("name"):
                         self._named.pop(
                             (info.get("namespace", ""), info["name"]))
-            self._commit_persist()
+            try:
+                self._commit_persist()
+            except (ConnectionError, TimeoutError, StaleEpochError):  # raylint: disable=ft-exception-swallow -- a deposed/standby-starved barrier must not kill the restart thread; the role gate after the pop takes over next iteration
+                continue
             if kill_leaked:
                 try:
                     self._pool.get(placed["address"]).call(
@@ -1065,6 +1652,8 @@ class HeadServer:
         it can only come back through re-registration, which mints a
         strictly newer epoch."""
         while not self._stop.wait(self._lease_ttl / 4):
+            if not self._is_primary or self._deposed:
+                continue  # a standby must not reap replicated leases
             now = time.monotonic()
             with self._lock:
                 in_grace = (self._replay_grace_until
@@ -1096,7 +1685,10 @@ class HeadServer:
                         self._forget_actors_on(nid)
             if dead:
                 _lease_metrics()["expirations"].inc(len(dead))
-            self._commit_persist()
+            try:
+                self._commit_persist()
+            except (ConnectionError, TimeoutError, StaleEpochError):  # raylint: disable=ft-exception-swallow -- a deposed/standby-starved barrier must not kill the reaper thread; the records stay journaled locally and the role gate at the loop top takes over next tick
+                continue
             for nid, addr in dead:
                 self._publish_node_death(nid, addr)
 
@@ -1118,6 +1710,17 @@ class HeadServer:
         - ``label_hard`` / ``label_soft``: NodeLabel filters.
         Placements debit a TTL'd reservation so rapid successive calls
         don't oversubscribe one node between heartbeats."""
+        if not self._is_primary or self._deposed:
+            # Placement debits reservations and feeds the autoscaler
+            # ledger — primary-only state.  (Internal callers — the
+            # restart loop — only run on a primary.)
+            from ..exceptions import NotPrimaryError
+
+            raise NotPrimaryError(
+                "placement on a non-primary head",
+                generation=self._generation,
+                primary_hint=self._known_primary or "",
+                context={"method": "place"})
         demand: Dict[str, float] = p["resources"]
         exclude = set(p.get("exclude", ()))
         strategy = p.get("strategy", "default")
@@ -1479,14 +2082,21 @@ class HeadServer:
         self._stop.set()
         with self._restart_cond:
             self._restart_cond.notify_all()
+        if self._repl is not None:
+            self._repl.stop()
         self._server.shutdown()
         self._pool.close_all()
         self._restarter.join(timeout=2.0)
         self._reaper.join(timeout=2.0)
+        if self._standby_watch is not None:
+            self._standby_watch.join(timeout=2.0)
         if self._compactor is not None:
             self._compactor.join(timeout=2.0)
         if self._log is not None:
             self._log.close()
+        for ring in (self._events_ring, self._logs_ring):
+            if ring is not None:
+                ring.close()
 
 
 def main():  # pragma: no cover - exercised via subprocess in tests
@@ -1499,8 +2109,18 @@ def main():  # pragma: no cover - exercised via subprocess in tests
     ap.add_argument("--storage", default=None,
                     help="durable-table path (journal + snapshot); "
                          "restart at the same port replays state")
+    ap.add_argument("--standby-of", default=None,
+                    help="primary head address: boot as a hot "
+                         "standby tailing its journal (promotes when "
+                         "the primary's lease lapses)")
+    ap.add_argument("--repl-mode", default=None,
+                    choices=("sync", "async"),
+                    help="standby ack mode (default: "
+                         "RAY_TPU_HEAD_REPL_MODE or sync)")
     args = ap.parse_args()
-    head = HeadServer(args.host, args.port, storage_path=args.storage)
+    head = HeadServer(args.host, args.port, storage_path=args.storage,
+                      standby_of=args.standby_of,
+                      repl_mode=args.repl_mode)
     print(f"RAY_TPU_HEAD_ADDRESS={head.address}", flush=True)
     try:
         while True:

@@ -82,6 +82,45 @@ def _journal_metrics():
     })
 
 
+def frame_record(record: Dict[str, Any]) -> bytes:
+    """One record in the journal's wire/disk framing:
+    ``[u32 len][u32 crc32][pickle bytes]``.  The SAME codec frames WAL
+    segments on disk and replication payloads on the wire, so the
+    standby tails the stream with the recovery reader's tolerance."""
+    blob = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+    return _FRAME.pack(len(blob), zlib.crc32(blob)) + blob
+
+
+def parse_frames(data) -> Tuple[List[Dict[str, Any]], int, bool]:
+    """Decode a run of framed records from ``data`` (bytes-like).
+
+    Returns ``(records, consumed_bytes, torn)``: every complete,
+    crc-valid record in order, how many bytes they covered, and
+    whether a torn/corrupt tail followed them.  Mirrors
+    :func:`read_segment`'s contract — a tear ends the run, it is not
+    fatal; the replication receiver acks only the complete prefix and
+    the sender re-ships from that watermark."""
+    view = memoryview(data)
+    out: List[Dict[str, Any]] = []
+    off = 0
+    n = len(view)
+    while off + _FRAME.size <= n:
+        length, crc = _FRAME.unpack(view[off:off + _FRAME.size])
+        end = off + _FRAME.size + length
+        if end > n:
+            return out, off, True
+        blob = bytes(view[off + _FRAME.size:end])
+        if zlib.crc32(blob) != crc:
+            return out, off, True
+        try:
+            rec = pickle.loads(blob)
+        except Exception:
+            return out, off, True
+        out.append(rec)
+        off = end
+    return out, off, off < n
+
+
 class JournalWriter:
     """Append-only segmented redo log.
 
@@ -91,6 +130,10 @@ class JournalWriter:
     an internal lock so the on-disk order matches the order callers
     appended in (the head appends while holding its table lock, which
     is what makes replay order == apply order).
+
+    A ``tap`` (set via :meth:`set_tap`) sees every appended record's
+    exact framed bytes — the replication sender rides it, so the wire
+    stream is byte-identical to the WAL and costs no second pickle.
     """
 
     def __init__(self, base_path: str, *, start_seqno: int = 0,
@@ -99,6 +142,8 @@ class JournalWriter:
         self._lock = threading.Lock()
         self._seqno = int(start_seqno)
         self._dirty = False
+        self._closed = False
+        self._tap = None
         if fsync is None:
             fsync = os.environ.get(
                 "RAY_TPU_HEAD_JOURNAL_FSYNC", "1") != "0"
@@ -113,24 +158,71 @@ class JournalWriter:
     def seqno(self) -> int:
         return self._seqno
 
+    def advance_seqno(self, seqno: int) -> None:
+        """Raise the counter floor (standby re-seed: local appends
+        must mint past the seed's watermark)."""
+        with self._lock:
+            self._seqno = max(self._seqno, int(seqno))
+
+    def set_tap(self, tap) -> None:
+        """``tap(seqno, framed_bytes, record)`` fires under the append
+        lock for every record — append order == ship order."""
+        self._tap = tap
+
+    def _check_open(self) -> None:
+        """Caller holds the lock.  A handler racing shutdown must
+        fail RETRYABLE (the client walks its head set / re-dials),
+        not ship the raw 'write to closed file' ValueError."""
+        if self._closed:
+            raise ConnectionError(
+                "journal closed (head shutting down)")
+
     def append(self, record: Dict[str, Any]) -> int:
         """Frame + write one redo record; returns its seqno.  NOT yet
         durable — pair with ``commit()`` before acking a client."""
         with self._lock:
+            self._check_open()
             self._seqno += 1
             record = dict(record)
             record["seq"] = self._seqno
-            blob = pickle.dumps(record,
-                                protocol=pickle.HIGHEST_PROTOCOL)
-            self._file.write(_FRAME.pack(len(blob),
-                                         zlib.crc32(blob)))
-            self._file.write(blob)
+            framed = frame_record(record)
+            self._file.write(framed)
             self._dirty = True
-            self.bytes_since_rotate += _FRAME.size + len(blob)
+            self.bytes_since_rotate += len(framed)
             m = _journal_metrics()
             m["appends"].inc()
-            m["bytes"].inc(_FRAME.size + len(blob))
+            m["bytes"].inc(len(framed))
+            if self._tap is not None:
+                self._tap(self._seqno, framed, record)
             return self._seqno
+
+    def append_replica(self, record: Dict[str, Any]) -> int:
+        """Standby-side append: the record arrives WITH the primary's
+        seqno and keeps it (watermarks must agree across heads); the
+        local counter follows the stream instead of minting."""
+        with self._lock:
+            self._check_open()
+            seq = int(record.get("seq") or 0)
+            self._seqno = max(self._seqno, seq)
+            framed = frame_record(record)
+            self._file.write(framed)
+            self._dirty = True
+            self.bytes_since_rotate += len(framed)
+            m = _journal_metrics()
+            m["appends"].inc()
+            m["bytes"].inc(len(framed))
+            return seq
+
+    def flush(self) -> None:
+        """OS-buffer flush WITHOUT the fsync: the standby's per-ack
+        barrier.  Zero-loss math: the primary fsync'd the record
+        locally BEFORE shipping, so the pair loses an acked record
+        only if the primary's disk vanishes AND the standby dies
+        before its cadence fsync — outside the kill -9 failure model
+        (docs/fault_tolerance.md, durability matrix)."""
+        with self._lock:
+            if self._dirty and not self._closed:
+                self._file.flush()
 
     def commit(self) -> None:
         """Durability barrier: flush + fsync everything appended since
@@ -138,6 +230,7 @@ class JournalWriter:
         with self._lock:
             if not self._dirty:
                 return
+            self._check_open()
             t0 = time.perf_counter()
             self._file.flush()
             if self._fsync:
@@ -173,6 +266,7 @@ class JournalWriter:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             try:
                 self._file.flush()
                 if self._fsync:

@@ -53,6 +53,14 @@ class Publisher:
                 out = {}
                 for channel, since in cursors.items():
                     seq, events = self._channels.get(channel, (0, []))
+                    if since > seq:
+                        # Cursor minted against ANOTHER head's channel
+                        # (the subscriber failed over to a promoted
+                        # standby, whose sequences restart): clamp and
+                        # deliver the retained window — the standard
+                        # resync-from-authoritative-state fallback,
+                        # not a silent starve-until-seq-catches-up.
+                        since = 0
                     fresh = [p for s, p in events if s >= since]
                     if fresh:
                         out[channel] = {"events": fresh, "seq": seq}

@@ -1,0 +1,547 @@
+"""Primary→standby journal shipping for the replicated head.
+
+Reference analogue: primary/backup log shipping in the Raft /
+chain-replication tradition, scoped to ONE replica (the reference
+spends ~37 kLoC of GCS + Redis replication on this surface; SURVEY
+§L2).  The unit of replication is the journal record journal.py
+already mints for durability: the primary's ``JournalWriter`` tap
+hands this sender every record's exact framed bytes (byte-identical
+to the WAL — no second pickle), the sender ships runs of frames to
+the standby's ``repl_frames`` RPC, and the standby tails them into
+its OWN WAL + ShardedTables, acking a durable watermark.
+
+Modes (``RAY_TPU_HEAD_REPL_MODE``):
+
+- ``sync`` (default): the primary's commit barrier — the point where
+  a ``_mut`` reply would ship — additionally waits for the standby's
+  ack.  Zero-loss failover: every acked mutation is on BOTH disks.
+  A silent standby makes mutations fail typed (TimeoutError) instead
+  of acking writes a failover would lose; reads stay available.
+- ``async``: the barrier returns after the local fsync; a background
+  loop drains the pending buffer.  Bounded-loss failover: the loss
+  window is exactly ``lag_entries``/``lag_bytes``, exported as gauges.
+
+Fencing: head GENERATIONS are the cluster-scope fencing tokens.  The
+standby inherits the primary's generation at seed time and mints
+``gen + 1`` at promotion; every replication RPC carries the sender's
+generation, and a promoted standby answers an older generation with a
+typed ``NotPrimaryError`` — the deposed primary marks itself fenced
+and can never ack again (``HeadServer._depose``).  The same check
+runs client-side: mutating RPCs carry the newest generation the
+client has seen, so a deposed primary learns of its deposition from
+its own clients even while partitioned from the standby.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, Optional
+
+from ..exceptions import NotPrimaryError
+from .rpc import RpcClient
+
+# Sender buffer bound: past this the standby is too far behind to
+# catch up frame-by-frame and gets a full re-seed instead.
+_PENDING_MAX_BYTES = 64 << 20
+_PENDING_MAX_ENTRIES = 100_000
+
+
+def _repl_metrics():
+    """Replication / failover gauges (rebuilt after registry resets)."""
+    from ..observability import metrics as _metrics
+
+    return _metrics.metric_group("head_repl", lambda: {
+        "lag_entries": _metrics.Gauge(
+            "ray_tpu_head_repl_lag_entries",
+            "journal records appended but not yet durable on the "
+            "standby (the async-mode loss window)"),
+        "lag_bytes": _metrics.Gauge(
+            "ray_tpu_head_repl_lag_bytes",
+            "framed bytes appended but not yet durable on the standby"),
+        "generation": _metrics.Gauge(
+            "ray_tpu_head_generation",
+            "this head's generation (fencing token minted at "
+            "promotion; bumped by exactly one per failover)"),
+        "failovers": _metrics.Counter(
+            "ray_tpu_head_failovers_total",
+            "standby promotions to primary (manual or lease-lapse)"),
+        "standby_up": _metrics.Gauge(
+            "ray_tpu_head_standby_up",
+            "1 while the standby acks within the replication "
+            "timeout, else 0 (primary-side liveness view)"),
+        "shipped": _metrics.Counter(
+            "ray_tpu_head_repl_shipped_records_total",
+            "journal records acked durable by the standby"),
+        "reseeds": _metrics.Counter(
+            "ray_tpu_head_repl_reseeds_total",
+            "full-snapshot re-seeds of a standby that fell behind "
+            "the sender's pending buffer (or re-attached after a "
+            "crash)"),
+    })
+
+
+class ReplicationSender:
+    """Primary-side half of the replication stream.
+
+    Owned by a HeadServer; ``offer`` is its JournalWriter tap (fires
+    under the append lock), ``commit_barrier`` runs after the local
+    fsync at every durable-mutation boundary, and a background loop
+    drives async shipping, heartbeats, lag gauges, and the
+    observability side-stream."""
+
+    def __init__(self, head, mode: str, *,
+                 primary_ttl_s: float, sync_timeout_s: float):
+        self._head = head
+        self.mode = mode
+        self._primary_ttl = float(primary_ttl_s)
+        self._sync_timeout = float(sync_timeout_s)
+        self._lock = threading.Lock()       # pending buffer + watermarks
+        self._cond = threading.Condition(self._lock)  # ack arrivals
+        self._ship_lock = threading.Lock()  # one shipper on the wire
+        self._pending: "OrderedDict[int, bytes]" = OrderedDict()
+        self._pending_bytes = 0
+        self._need_reseed = False
+        self.standby_address = ""
+        self._client: Optional[RpcClient] = None
+        self.acked_seq = 0
+        # Pipelined wire: frames ship via call_async and acks absorb
+        # on the reader thread, so the journal-commit convoy never
+        # holds a round-trip.  _inflight_hwm = highest seq on the
+        # wire (re-pumps skip it); _inflight = outstanding batches;
+        # _wire_epoch invalidates ack callbacks that straddle an
+        # attach/detach (a stale decrement would skew _inflight
+        # negative and disable batch coalescing forever).
+        self._inflight_hwm = 0
+        self._inflight = 0
+        self._wire_epoch = 0
+        self._partition_until = 0.0
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        # Observability side-stream: event/log flushes forwarded
+        # best-effort so a promoted standby can answer timeline/log
+        # queries about the pre-failover cluster.  Bounded drop-oldest
+        # — never blocks an ack, never re-seeds.
+        self._events_q: deque = deque(maxlen=64)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="head-repl-sender")
+        self._thread.start()
+
+    # ------------------------------------------------------------ attach
+    def attach(self, address: str, seed_seq: int) -> None:
+        """Register ``address`` as the standby; everything ≤
+        ``seed_seq`` is covered by the seed the attach reply carries.
+        Caller holds the head table lock, making the state capture and
+        this watermark reset one atomic section against the tap."""
+        with self._lock:
+            old_client, self._client = self._client, None
+            self.standby_address = address
+            self.acked_seq = int(seed_seq)
+            self._inflight_hwm = int(seed_seq)
+            self._inflight = 0
+            self._wire_epoch += 1  # stale ack callbacks become no-ops
+            self._need_reseed = False
+            self._pending = OrderedDict(
+                (s, f) for s, f in self._pending.items()
+                if s > seed_seq)
+            self._pending_bytes = sum(
+                len(f) for f in self._pending.values())
+        if old_client is not None:
+            # OUTSIDE the lock: close() synchronously fails pending
+            # call_asyncs, whose error callbacks re-take self._cond —
+            # closing under the lock would self-deadlock (and this
+            # path also holds the head table lock).
+            try:
+                old_client.close()
+            except OSError:
+                pass
+        self._wake.set()
+
+    def detach(self) -> None:
+        """Operator/chaos hook: drop the standby (mutations stop
+        waiting on it; the HA pair is dissolved until a new attach)."""
+        with self._cond:
+            self.standby_address = ""
+            client, self._client = self._client, None
+            self._pending.clear()
+            self._pending_bytes = 0
+            self._inflight = 0
+            self._inflight_hwm = 0
+            self._wire_epoch += 1
+            self._cond.notify_all()
+        if client is not None:
+            try:
+                client.close()
+            except OSError:
+                pass
+        _repl_metrics()["standby_up"].set(0.0)
+
+    @property
+    def attached(self) -> bool:
+        return bool(self.standby_address)
+
+    # -------------------------------------------------------------- tap
+    def offer(self, seq: int, framed: bytes, _record) -> None:
+        """JournalWriter tap: buffer one framed record for shipping.
+        Past the buffer bound the standby is marked for re-seed — the
+        buffer must never grow without bound while a standby is down."""
+        overflow = False
+        with self._lock:
+            if not self.standby_address:
+                return
+            self._pending[seq] = framed
+            self._pending_bytes += len(framed)
+            if (self._pending_bytes > _PENDING_MAX_BYTES
+                    or len(self._pending) > _PENDING_MAX_ENTRIES):
+                self._pending.clear()
+                self._pending_bytes = 0
+                self._need_reseed = True
+                overflow = True
+        if overflow:
+            # Start the re-seed NOW, not at the next loop tick:
+            # sync-mode mutations fail typed until it completes.
+            self._wake.set()
+
+    def offer_events(self, payload: Dict[str, Any]) -> None:
+        if self.standby_address:
+            self._events_q.append(payload)
+
+    def kick(self) -> None:
+        """Put pending frames on the wire NOW — the commit path calls
+        this BEFORE its local fsync so the standby round-trip
+        overlaps the disk barrier instead of queuing behind it.
+        Direct pump (call_async returns immediately), not a thread
+        wake: the handoff latency would eat the overlap.  With a
+        batch already in flight the pump is SKIPPED — the ack
+        callback chains the next batch, so concurrent commits
+        coalesce into few, large batches instead of contending the
+        ship lock with one tiny batch each."""
+        if self.mode != "sync":
+            self._wake.set()
+            return
+        with self._lock:
+            if self._inflight > 0:
+                return
+        self._pump()
+
+    # ---------------------------------------------------------- barrier
+    def commit_barrier(self, target_seq: int) -> None:
+        """Called after the LOCAL fsync of every durable mutation.
+        sync mode: wait until the standby acks ``target_seq`` (raises
+        typed on a silent/deposed standby — the reply must not ship).
+        The wire is PIPELINED: this thread pumps frames out via
+        call_async and parks on the ack condition; it never holds a
+        round-trip, so N concurrent mutations overlap their standby
+        acks instead of convoying behind one RTT each."""
+        if not self.attached:
+            return
+        if self.mode != "sync":
+            self._wake.set()
+            return
+        deadline = time.monotonic() + self._sync_timeout
+        while True:
+            if self._head.deposed:
+                raise NotPrimaryError(
+                    "standby promoted: this head is deposed",
+                    generation=0,
+                    primary_hint=self.standby_address)
+            self._pump()
+            with self._cond:
+                if self.acked_seq >= target_seq:
+                    return
+                if not self.standby_address:
+                    # Detached mid-barrier: the HA pair is dissolved
+                    # — local durability (already done) is the whole
+                    # contract now.
+                    return
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                # Short slices: a lost connection needs a re-pump,
+                # which only this loop drives.
+                self._cond.wait(min(left, 0.1))
+            with self._lock:
+                if self.acked_seq >= target_seq:
+                    return
+            if time.monotonic() >= deadline:
+                break
+        _repl_metrics()["standby_up"].set(0.0)
+        raise TimeoutError(
+            f"sync replication: standby {self.standby_address} "
+            f"did not ack seq {target_seq} within "
+            f"{self._sync_timeout:.1f}s")
+
+    # ------------------------------------------------------------- chaos
+    def partition(self, duration_s: float) -> None:
+        """Test/chaos hook: drop all replication traffic for
+        ``duration_s`` — the standby sees a silent primary (its lease
+        lapses → it promotes) while this side keeps buffering."""
+        self._partition_until = time.monotonic() + float(duration_s)
+
+    def _partitioned(self) -> bool:
+        return time.monotonic() < self._partition_until
+
+    # -------------------------------------------------------------- wire
+    def _get_client(self) -> RpcClient:
+        with self._lock:
+            client, addr = self._client, self.standby_address
+        if not addr:
+            raise ConnectionError("no standby attached")
+        if client is not None and client._sock is not None:
+            return client
+        fresh = RpcClient(addr, connect_timeout=2.0)
+        with self._lock:
+            if self.standby_address != addr:
+                fresh.close()
+                raise ConnectionError("standby changed during dial")
+            self._client = fresh
+        return fresh
+
+    def _absorb_reply(self, reply: Dict[str, Any]) -> bool:
+        """Fold a standby ack into the watermarks; returns False when
+        the reply says we are deposed (head fenced as a side effect)."""
+        gen = int(reply.get("gen") or 0)
+        if reply.get("promoted") or gen > self._head.generation:
+            self._head._depose(gen, self.standby_address)
+            with self._cond:
+                self._cond.notify_all()
+            return False
+        applied = int(reply.get("applied_seq") or 0)
+        shipped = 0
+        with self._cond:
+            if applied > self.acked_seq:
+                self.acked_seq = applied
+            while self._pending:
+                seq = next(iter(self._pending))
+                if seq > applied:
+                    break
+                self._pending_bytes -= len(self._pending.pop(seq))
+                shipped += 1
+            behind = (self._pending
+                      and next(iter(self._pending))
+                      > self.acked_seq + 1)
+            if behind:
+                # The standby acked BELOW our oldest buffered record
+                # (its WAL lost the gap — crash without storage): a
+                # frame replay cannot bridge it; the loop re-seeds.
+                self._need_reseed = True
+            self._cond.notify_all()
+        m = _repl_metrics()
+        if shipped:
+            m["shipped"].inc(shipped)
+        m["standby_up"].set(1.0)
+        self._update_lag()
+        if behind:
+            self._wake.set()
+        return True
+
+    def _on_batch_result(self, last_seq: int, wire_epoch: int,
+                         result: Any, is_error: bool) -> None:
+        """Ack callback (runs on the RPC reader thread): absorb the
+        watermark or roll the in-flight window back so a re-pump
+        re-ships the batch.  The wire-epoch check and the in-flight
+        bookkeeping share ONE critical section — an attach/detach
+        interleaving between them would land a stale decrement and
+        pin ``_inflight`` negative (starving idle heartbeats)."""
+        if is_error:
+            if isinstance(result, NotPrimaryError):
+                self._head._depose(result.generation or 0,
+                                   self.standby_address)
+            else:
+                _repl_metrics()["standby_up"].set(0.0)
+            with self._cond:
+                if wire_epoch == self._wire_epoch:
+                    self._inflight -= 1
+                    self._inflight_hwm = self.acked_seq
+                self._cond.notify_all()
+            return
+        # Absorbing a STALE success ack is harmless (acked_seq only
+        # moves forward; post-attach pending sits above any stale
+        # applied_seq) — only the in-flight window is epoch-guarded.
+        ok = self._absorb_reply(result if isinstance(result, dict)
+                                else {})
+        chain = False
+        with self._cond:
+            if wire_epoch == self._wire_epoch:
+                self._inflight -= 1
+                if ok and self.acked_seq < last_seq:
+                    # Torn tail at the standby: ack covered only a
+                    # prefix — rewind so the next pump re-ships it.
+                    self._inflight_hwm = min(self._inflight_hwm,
+                                             self.acked_seq)
+                chain = (ok and self._inflight == 0
+                         and bool(self._pending)
+                         and next(reversed(self._pending))
+                         > self._inflight_hwm)
+            self._cond.notify_all()
+        if chain:
+            # Drain chaining: records that accumulated while this
+            # batch was in flight ship as ONE next batch (runs on
+            # the ack reader thread; call_async — no blocking).
+            self._pump()
+
+    def _pump(self) -> None:
+        """Put every pending record past the in-flight watermark on
+        the wire (one batch, call_async — no round-trip held).  The
+        ship lock only covers assembly + send, so pumps stay cheap;
+        in-order delivery + the ordered server handler keep seqs
+        monotone at the standby."""
+        if self._partitioned() or self._head.deposed:
+            return
+        with self._lock:
+            if self._need_reseed or not self.standby_address:
+                # Checked BEFORE taking the ship lock: during a
+                # reseed the loop holds it for the whole synchronous
+                # snapshot ship (30s+), and commit_barrier calls this
+                # from RPC handler threads — blocking here would
+                # stall mutations far past their typed sync timeout.
+                return
+        with self._ship_lock:
+            with self._lock:
+                if self._need_reseed or not self.standby_address:
+                    return
+                start = max(self.acked_seq, self._inflight_hwm)
+                batch = [(s, f) for s, f in self._pending.items()
+                         if s > start]
+                if not batch:
+                    return
+                last = batch[-1][0]
+                epoch = self._wire_epoch
+                # Reserve the window BEFORE the send: the ack (or a
+                # connection-error callback) can fire on the reader
+                # thread before call_async returns, and a post-send
+                # `hwm = max(...)` would overwrite its rewind —
+                # stranding an unacked suffix that no pump re-ships.
+                self._inflight += 1
+                self._inflight_hwm = max(self._inflight_hwm, last)
+            frames = b"".join(f for _s, f in batch)
+            try:
+                client = self._get_client()  # raylint: disable=blocking-under-lock -- _ship_lock covers assembly + a non-blocking call_async only; the long reseed path is excluded by the _need_reseed pre-check above, so handler-thread pumps wait at most one assembly
+                client.call_async(
+                    "repl_frames",
+                    {"gen": self._head.generation, "frames": frames,
+                     "from_seq": batch[0][0]},
+                    callback=lambda result, is_error, _l=last,
+                    _e=epoch:
+                    self._on_batch_result(_l, _e, result, is_error))
+            except (ConnectionError, TimeoutError, OSError):
+                with self._cond:
+                    if epoch == self._wire_epoch:
+                        self._inflight -= 1
+                        self._inflight_hwm = self.acked_seq
+                    self._cond.notify_all()
+                _repl_metrics()["standby_up"].set(0.0)
+                return
+
+    def _heartbeat_once(self) -> None:
+        """Idle-stream lease renewal + watermark probe (loop cadence;
+        only when nothing is pending or in flight)."""
+        try:
+            client = self._get_client()
+            reply = client.call("repl_heartbeat", {
+                "gen": self._head.generation,
+                "seqno": self._head.journal_seqno(),
+            }, timeout=self._sync_timeout)
+        except NotPrimaryError as e:
+            self._head._depose(e.generation or 0,
+                               self.standby_address)
+            raise
+        self._absorb_reply(reply)
+
+    def _reseed(self, client: RpcClient) -> None:
+        """Full-snapshot re-seed of a standby that fell behind the
+        pending buffer (or restarted empty).  Synchronous and rare —
+        driven by the background loop, never by a commit barrier."""
+        state, seqno, gen = self._head.build_seed()
+        try:
+            reply = client.call("repl_seed", {
+                "gen": gen, "state": state, "seqno": seqno,
+                "primary": self._head.address,
+            }, timeout=max(self._sync_timeout, 30.0))
+        except NotPrimaryError as e:
+            self._head._depose(e.generation or 0,
+                               self.standby_address)
+            raise
+        _repl_metrics()["reseeds"].inc()
+        with self._cond:
+            self._need_reseed = False
+            if self.acked_seq < seqno:
+                self.acked_seq = seqno
+            self._inflight_hwm = max(self._inflight_hwm, seqno)
+            while self._pending:
+                seq = next(iter(self._pending))
+                if seq > seqno:
+                    break
+                self._pending_bytes -= len(self._pending.pop(seq))
+            self._cond.notify_all()
+        self._absorb_reply(reply)
+
+    def _update_lag(self) -> None:
+        with self._lock:
+            entries = len(self._pending)
+            nbytes = self._pending_bytes
+        m = _repl_metrics()
+        m["lag_entries"].set(float(entries))
+        m["lag_bytes"].set(float(nbytes))
+
+    # -------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        """Async drain + reseeds + heartbeats + the observability
+        side-stream.  Cadence ``primary_ttl / 3``: the standby's
+        promotion timer sees at least two beats per lease even with
+        one drop."""
+        interval = max(0.05, self._primary_ttl / 3.0)
+        while True:
+            self._wake.wait(timeout=interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            if not self.attached or self._head.deposed:
+                continue
+            try:
+                with self._lock:
+                    need_reseed = self._need_reseed
+                    idle = (not self._pending
+                            and self._inflight == 0)
+                if self._partitioned():
+                    continue
+                if need_reseed:
+                    with self._ship_lock:
+                        self._reseed(self._get_client())  # raylint: disable=blocking-under-lock -- _ship_lock serializes the (rare, synchronous) reseed against pumps; no RPC handler path acquires it
+                elif not idle:
+                    self._pump()
+                else:
+                    self._heartbeat_once()
+                while self._events_q:
+                    payload = self._events_q.popleft()
+                    self._get_client().call(
+                        "repl_events", payload, timeout=5.0)
+            except NotPrimaryError:
+                continue  # deposed: the head is fenced; stop pushing
+            except (ConnectionError, TimeoutError, OSError):
+                _repl_metrics()["standby_up"].set(0.0)
+                self._update_lag()
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "standby": self.standby_address,
+                "acked_seq": self.acked_seq,
+                "lag_entries": len(self._pending),
+                "lag_bytes": self._pending_bytes,
+                "mode": self.mode,
+            }
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            try:
+                client.close()
+            except OSError:
+                pass

@@ -1,0 +1,137 @@
+"""Size-capped on-disk record rings for head-store overflow.
+
+The head's event/log/metric stores are bounded in-memory windows
+(`RAY_TPU_HEAD_EVENTS_MAX` / `RAY_TPU_HEAD_LOGS_MAX`, drop-oldest):
+exactly right for the hot query path, wrong for the post-mortem that
+arrives an hour later.  This module gives each store a **disk ring
+next to the journal** — two segments in the WAL's own framing
+(`journal.frame_record`), the active one appended on every ingest,
+rotated when it passes half the byte cap, the other truncated on
+rotation.  Total disk is bounded by ``max_bytes`` (+ one record), the
+retained window is at least ``max_bytes / 2`` of history, and a torn
+tail (kill -9 mid-append) costs only the torn record — the reader is
+the journal's tolerant frame parser.
+
+``cluster_timeline`` / ``cluster_logs`` queries pass ``history=True``
+to read the ring instead of the in-memory window; after a failover
+the promoted standby serves ITS copy, fed by the replication
+side-stream (`repl_events`).  Writes never raise: a full disk costs
+history, not the control plane.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterator, List
+
+from . import journal as journal_mod
+
+
+class DiskRing:
+    """Two-segment framed record ring at ``base.0`` / ``base.1``."""
+
+    def __init__(self, base: str, max_bytes: int):
+        self._base = base
+        self._max = max(4096, int(max_bytes))
+        self._lock = threading.Lock()
+        self._file = None
+        self.written = 0
+        self.dropped = 0
+        sizes = [self._size(i) for i in (0, 1)]
+        # Resume on the smaller segment when both exist (the larger
+        # one is the full, rotated-out half); ties pick 0.
+        self._active = 0 if sizes[0] <= sizes[1] else 1
+        # A kill -9 mid-append leaves a torn tail; records appended
+        # AFTER it would be unreachable (the tolerant reader stops at
+        # the tear), so truncate the resumed segment to its valid
+        # prefix first.
+        self._truncate_to_valid(self._path(self._active))
+        self._open_active()
+
+    def _path(self, idx: int) -> str:
+        return f"{self._base}.{idx}"
+
+    def _size(self, idx: int) -> int:
+        try:
+            return os.path.getsize(self._path(idx))
+        except OSError:
+            return 0
+
+    @staticmethod
+    def _truncate_to_valid(path: str) -> None:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        _recs, consumed, torn = journal_mod.parse_frames(data)
+        if torn:
+            try:
+                with open(path, "r+b") as f:
+                    f.truncate(consumed)
+            except OSError:
+                pass
+
+    def _open_active(self) -> None:
+        try:
+            self._file = open(self._path(self._active), "ab")
+        except OSError:
+            self._file = None
+
+    def append_many(self, records: List[Dict[str, Any]]) -> None:
+        """Frame + append; rotate past half the cap.  Never raises —
+        a failed write drops the batch and counts it."""
+        if not records:
+            return
+        with self._lock:
+            if self._file is None:
+                self._open_active()
+                if self._file is None:
+                    self.dropped += len(records)
+                    return
+            try:
+                for rec in records:
+                    self._file.write(journal_mod.frame_record(rec))
+                self._file.flush()
+                self.written += len(records)
+                if self._file.tell() >= self._max // 2:
+                    self._rotate_locked()
+            except (OSError, ValueError, TypeError):
+                self.dropped += len(records)
+
+    def _rotate_locked(self) -> None:
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        self._active ^= 1
+        try:
+            # Truncate the new active half: its contents are the
+            # OLDEST records, now aged out of the cap.
+            self._file = open(self._path(self._active), "wb")
+        except OSError:
+            self._file = None
+
+    def scan(self) -> Iterator[Dict[str, Any]]:
+        """Records oldest-first: the inactive (older) segment, then
+        the active one.  Torn tails end a segment silently."""
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                except OSError:
+                    pass
+            order = (self._active ^ 1, self._active)
+        for idx in order:
+            for rec in journal_mod.read_segment(self._path(idx)):
+                yield rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None

@@ -658,18 +658,70 @@ class ReconnectingClient:
     """RpcClient wrapper that re-dials on a lost connection (reference:
     the retryable gRPC client every daemon keeps toward the GCS,
     retryable_grpc_client.h) — the peer surviving a restart at the same
-    address resumes service transparently."""
+    address resumes service transparently.
+
+    **Head-set aware**: the wrapper holds an ordered CANDIDATE list
+    (primary first, then standbys) with a per-candidate re-dial
+    cooldown.  A lost connection re-dials the current candidate, then
+    walks the rest of the set — so a head failover costs one walk of
+    the list, not an infinite redial against the dead primary.
+    ``set_candidates`` absorbs server-advertised head sets;
+    ``failover()`` forces the walk to start PAST the current address
+    (the caller just learned it is not primary)."""
 
     _REDIAL_COOLDOWN_S = 5.0
+    # With several candidates the cooldown is what keeps a walk from
+    # re-paying the dead primary's dial budget on every reconnect;
+    # kept well under the single-candidate value so a recovered head
+    # is rediscovered quickly.
+    _MULTI_COOLDOWN_S = 2.0
 
-    def __init__(self, address: str, connect_timeout: float = 10.0):
+    def __init__(self, address: str, connect_timeout: float = 10.0,
+                 candidates: Optional[list] = None,
+                 shared_cooldowns: Optional[Dict[str, tuple]] = None):
         self.address = address
         self._connect_timeout = connect_timeout
         self._lock = threading.Lock()
         self._closed = False
-        self._no_redial_until = 0.0
+        # addr -> (no_dial_until, current_backoff_s).  Pass ONE dict
+        # to a fleet of clients (``shared_cooldowns``) so the first
+        # client to burn a dial budget against a dead head spares
+        # every other client the same probe — without sharing, N
+        # clients walking serially pay N dial budgets and a failover
+        # can outlast the node lease.  Escalates per consecutive
+        # failure so a permanently dead candidate costs ever less.
+        self._cooldowns: Dict[str, tuple] = (
+            shared_cooldowns if shared_cooldowns is not None else {})
         self._chaos_tag = ""
-        self._client = RpcClient(address, connect_timeout)
+        self._candidates = [address]
+        for cand in candidates or ():
+            if cand and cand not in self._candidates:
+                self._candidates.append(cand)
+        # Constructor walks the set too: "the primary is down, dial
+        # the standby" must hold from the very first connection, not
+        # only on re-dials.
+        budget = (connect_timeout if len(self._candidates) == 1
+                  else max(1.0, min(2.0, connect_timeout
+                                    / len(self._candidates))))
+        last_err: Optional[Exception] = None
+        self._client = None
+        now = time.monotonic()
+        order = ([c for c in self._candidates
+                  if not self._in_cooldown(c, now)]
+                 or list(self._candidates))
+        for cand in order:
+            try:
+                self._client = RpcClient(cand, budget)
+                self.address = cand
+                self._cooldowns.pop(cand, None)
+                break
+            except ConnectionError as e:
+                self._mark_dial_failed(cand)
+                last_err = e
+        if self._client is None:
+            raise ConnectionError(
+                f"no head candidate reachable "
+                f"({self._candidates}): {last_err}")
 
     @property
     def chaos_tag(self) -> str:
@@ -680,7 +732,41 @@ class ReconnectingClient:
         self._chaos_tag = tag
         self._client.chaos_tag = tag
 
-    def _reconnect(self) -> RpcClient:
+    @property
+    def candidates(self) -> list:
+        with self._lock:
+            return list(self._candidates)
+
+    def set_candidates(self, addresses) -> None:
+        """Absorb a server-advertised head set (order preserved,
+        current connection kept).  New addresses append; addresses the
+        server no longer advertises stay — a momentarily incomplete
+        advertisement must not strand the client with one candidate."""
+        with self._lock:
+            for cand in addresses or ():
+                if cand and cand not in self._candidates:
+                    self._candidates.append(cand)
+
+    def _cooldown_for(self) -> float:
+        return (self._MULTI_COOLDOWN_S if len(self._candidates) > 1
+                else self._REDIAL_COOLDOWN_S)
+
+    def _in_cooldown(self, addr: str, now: float) -> bool:
+        until, _backoff = self._cooldowns.get(addr, (0.0, 0.0))
+        return until > now
+
+    def _mark_dial_failed(self, addr: str) -> None:
+        base = self._cooldown_for()
+        _until, prev = self._cooldowns.get(addr, (0.0, 0.0))
+        if len(self._candidates) > 1 and prev:
+            # Escalate for head sets: a permanently dead candidate
+            # costs one probe per doubling window, not one per walk.
+            backoff = min(prev * 2, 15.0)
+        else:
+            backoff = base
+        self._cooldowns[addr] = (time.monotonic() + backoff, backoff)
+
+    def _reconnect(self, skip_current: bool = False) -> RpcClient:
         with self._lock:
             if self._closed:
                 # A closed client must NOT resurrect the connection:
@@ -690,38 +776,82 @@ class ReconnectingClient:
                 raise ConnectionError(
                     f"client to {self.address} is closed")
             client = self._client
-            if client._sock is not None:
+            if client._sock is not None and not skip_current:
                 return client  # another caller already re-dialed
-            if time.monotonic() < self._no_redial_until:
-                # A re-dial just burned its full connect budget: fail
-                # fast instead of every caller serially paying it
-                # again against a peer that is plainly down (callers
-                # with patience use call_retry and span the cooldown).
+            # Walk the candidate set starting at the current address
+            # (or just past it on an explicit failover), skipping
+            # candidates still cooling down from a failed dial.
+            try:
+                start = self._candidates.index(self.address)
+            except ValueError:
+                start = 0
+            if skip_current:
+                start += 1
+            order = [self._candidates[(start + i)
+                                      % len(self._candidates)]
+                     for i in range(len(self._candidates))]
+            now = time.monotonic()
+            dialable = [a for a in order
+                        if not self._in_cooldown(a, now)]
+            if not dialable:
+                # Every candidate recently burned a connect budget:
+                # fail fast instead of every caller serially paying
+                # it again (callers with patience use call_retry and
+                # span the cooldown).
                 raise ConnectionError(
-                    f"{self.address} unreachable (re-dial cooldown)")
+                    f"no head candidate reachable "
+                    f"({self._candidates}: all in re-dial cooldown)")
             client.close()
             # Dialing under the lock is the POINT: concurrent callers
             # racing a lost connection must serialize behind ONE
             # re-dial (the early return above) instead of stampeding
             # the recovering peer with N sockets.
-            try:
-                fresh = RpcClient(self.address,  # raylint: disable=blocking-under-lock -- the lock exists to serialize exactly this re-dial; no RPC ever runs under it
-                                  max(2.0, self._connect_timeout),
-                                  abort=lambda: self._closed)
-            except ConnectionError:
-                self._no_redial_until = (time.monotonic()
-                                         + self._REDIAL_COOLDOWN_S)
-                raise
-            if self._closed:
-                # close() raced the dial (it sets the flag without
-                # waiting for this lock): the fresh connection must
-                # not outlive the wrapper.
-                fresh.close()
-                raise ConnectionError(
-                    f"client to {self.address} is closed")
-            fresh.chaos_tag = self._chaos_tag
-            self._client = fresh
-            return self._client
+            last_err: Optional[Exception] = None
+            # One candidate gets the full budget (a restarting head
+            # deserves the patience); a SET caps each candidate at
+            # 1-2s — a dead primary must cost seconds of the walk,
+            # not the whole budget, or failover blows the
+            # availability target (the cooldown keeps later walks
+            # from re-paying even that).
+            budget = (max(2.0, self._connect_timeout)
+                      if len(dialable) == 1
+                      else max(1.0, min(2.0, self._connect_timeout
+                                        / len(dialable))))
+            for cand in dialable:
+                try:
+                    fresh = RpcClient(cand,  # raylint: disable=blocking-under-lock -- the lock exists to serialize exactly this re-dial; no RPC ever runs under it
+                                      budget,
+                                      abort=lambda: self._closed)
+                except ConnectionError as e:
+                    self._mark_dial_failed(cand)
+                    last_err = e
+                    continue
+                if self._closed:
+                    # close() raced the dial (it sets the flag without
+                    # waiting for this lock): the fresh connection
+                    # must not outlive the wrapper.
+                    fresh.close()
+                    raise ConnectionError(
+                        f"client to {self.address} is closed")
+                fresh.chaos_tag = self._chaos_tag
+                self._cooldowns.pop(cand, None)
+                self.address = cand
+                self._client = fresh
+                return self._client
+            raise ConnectionError(
+                f"no head candidate reachable "
+                f"({self._candidates}): {last_err}")
+
+    def failover(self) -> None:
+        """Advance to the next candidate (the current address just
+        answered that it is not the primary).  No-op with a single
+        candidate."""
+        if len(self.candidates) <= 1:
+            return
+        try:
+            self._reconnect(skip_current=True)
+        except ConnectionError:
+            pass  # next call's _reconnect keeps walking
 
     def call(self, method: str, payload: Any = None,
              timeout: Optional[float] = None) -> Any:

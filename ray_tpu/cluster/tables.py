@@ -21,6 +21,7 @@ each individual read/write atomic without it.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -91,6 +92,22 @@ class ShardedTable:
     def snapshot(self) -> Dict[Any, Any]:
         """A plain-dict copy (compaction/persistence input)."""
         return dict(self.items())
+
+    def digest(self) -> str:
+        """Order-independent content digest — the replication
+        divergence probe: a primary and a caught-up standby must
+        report identical digests per table (``repl_status`` exposes
+        them; the failover tests assert equality).  Repr-based so
+        mixed key/value types never raise; collisions across repr
+        don't matter for a consistency CHECK."""
+        h = hashlib.sha1()
+        pairs = sorted((repr(k), repr(v)) for k, v in self.items())
+        for k, v in pairs:
+            h.update(k.encode("utf-8", "replace"))
+            h.update(b"\x00")
+            h.update(v.encode("utf-8", "replace"))
+            h.update(b"\x01")
+        return h.hexdigest()
 
     def replace_all(self, data: Dict[Any, Any]) -> None:
         """Recovery path: drop everything, load ``data``."""

@@ -267,6 +267,46 @@ def _rebuild_stale_epoch(reason, node_id, sent, cur, context):
                            current_epoch=cur, context=context)
 
 
+class NotPrimaryError(StaleEpochError):
+    """A mutating control-plane RPC reached a head that is not the
+    current primary — a standby still tailing the journal, or a
+    DEPOSED primary fenced by a newer head generation after failover.
+
+    Subclasses :class:`StaleEpochError` because the contract is the
+    same lease-fencing contract one level up: head *generations* are
+    fencing tokens minted at promotion, exactly as node epochs are
+    minted at registration.  A write acked by a deposed primary would
+    be a zombie write at cluster scope, so it is rejected typed before
+    it can land.
+
+    ``generation`` is the rejecting head's generation;
+    ``primary_hint`` (may be "") is the address that head believes is
+    the current primary — clients use it to re-resolve their head set
+    (``ClusterClient.mut_call`` fails over and retries).
+    """
+
+    def __init__(self, reason: str = "head is not primary", *,
+                 generation: int = 0, primary_hint: str = "",
+                 context=None):
+        self.generation = int(generation)
+        self.primary_hint = primary_hint
+        ctx = dict(context or {})
+        ctx.setdefault("head_gen", self.generation)
+        if primary_hint:
+            ctx.setdefault("primary_hint", primary_hint)
+        super().__init__(reason, context=ctx)
+
+    def __reduce__(self):
+        return (_rebuild_not_primary,
+                (self.reason, self.generation, self.primary_hint,
+                 self.context))
+
+
+def _rebuild_not_primary(reason, generation, primary_hint, context):
+    return NotPrimaryError(reason, generation=generation,
+                           primary_hint=primary_hint, context=context)
+
+
 class OutOfMemoryError(RayTpuError):
     """Worker killed by the memory monitor (reference: OOM killer, N22)."""
 

@@ -1012,6 +1012,34 @@ def _durable_write_in(model: ProjectModel, fi: FuncInfo,
     return None
 
 
+def _journal_call_in(model: ProjectModel, fi: FuncInfo,
+                     depth: int = _JOURNAL_TRANSITIVE_DEPTH,
+                     seen: Optional[set] = None) -> bool:
+    """Does ``fi`` (or a self-method callee up to ``depth``) call
+    ``self._journal(...)`` or apply through the replay path
+    (``self._apply_record``)?  The replication-visibility check: only
+    journaled writes ship to the standby."""
+    seen = set() if seen is None else seen
+    if fi.qualname in seen:
+        return False
+    seen.add(fi.qualname)
+    prefix = fi.qualname.rsplit(".", 1)[0]
+    for node in model.walk_own(fi.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            if node.func.attr in ("_journal", "_apply_record"):
+                return True
+            if depth > 0:
+                sub = model.functions.get(
+                    f"{prefix}.{node.func.attr}")
+                if sub is not None and \
+                        _journal_call_in(model, sub, depth - 1, seen):
+                    return True
+    return False
+
+
 def rule_journaled_mutation(model: ProjectModel) -> List[Finding]:
     out = _Collector(model, "journaled-mutation")
     for fi in model.functions.values():
@@ -1038,6 +1066,31 @@ def rule_journaled_mutation(model: ProjectModel) -> List[Finding]:
                                 node.lineno))
             for hname, value, line in entries:
                 if _is_wrapped(value):
+                    # Wrapped handlers still owe REPLICATION
+                    # visibility: the durable write must flow through
+                    # self._journal (the standby tails the journal —
+                    # a direct table write is invisible to it and
+                    # silently diverges the replica).
+                    inner = value.args[0] if (
+                        isinstance(value, ast.Call) and value.args) \
+                        else None
+                    if not (isinstance(inner, ast.Attribute)
+                            and isinstance(inner.value, ast.Name)
+                            and inner.value.id == "self"):
+                        continue
+                    target = model.functions.get(
+                        f"{prefix}.{inner.attr}")
+                    if target is None:
+                        continue
+                    table = _durable_write_in(model, target)
+                    if table and not _journal_call_in(model, target):
+                        out.add(info, line, fi.qualname,
+                                f"handler {hname!r} writes durable "
+                                f"table {table!r} without a "
+                                f"self._journal record — the write "
+                                f"is invisible to the replication "
+                                f"stream (a hot standby diverges) "
+                                f"and to restart replay")
                     continue
                 if not (isinstance(value, ast.Attribute)
                         and isinstance(value.value, ast.Name)
@@ -1437,7 +1490,11 @@ RULE_DOCS = {
         "it journals + fsyncs the redo records before the reply "
         "ships.  An unwrapped writer acks mutations a head kill -9 "
         "silently loses, and skips idempotency dedup and epoch "
-        "fencing besides."),
+        "fencing besides.  Wrapped handlers are additionally checked "
+        "for REPLICATION VISIBILITY: the durable write must emit a "
+        "self._journal redo record (or ride the _apply_record replay "
+        "path) — the hot standby tails the journal, so a direct "
+        "table write never ships and the replica silently diverges."),
     "lock-order-inversion": (
         "Cycles in the global lock-acquisition-order graph (built "
         "from the interprocedural lock-set analysis: which locks may "

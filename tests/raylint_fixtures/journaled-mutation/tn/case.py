@@ -1,5 +1,7 @@
 """True negative: every durable-table writer rides the _mut/journal
-wrapper; read-only handlers and soft-state writers stay raw."""
+wrapper AND emits a journal redo record (replication-visible: the
+standby tails the journal); read-only handlers and soft-state writers
+stay raw."""
 
 
 def idempotent_handler(fn, cache):
@@ -19,14 +21,36 @@ class Head:
         self._kv = {}
         self._actors = {}
         self._idem = object()
+        self._log = None
         self._nodes = {}  # soft state: NOT a durable table
+
+    def _journal(self, record):
+        if self._log is not None:
+            self._log.append(record)
+
+    def _apply_record(self, rec):
+        # Replay/replication applier: raw table writes by design.
+        self._kv[(rec["ns"], rec["key"])] = rec["value"]
 
     def _sync_view(self, p):
         self._kv[(p["ns"], p["key"])] = p["value"]
+        self._journal({"op": "kv_put", "ns": p["ns"],
+                       "key": p["key"], "value": p["value"]})
         return {"ok": True}
 
     def _retire_entries(self, p):
-        self._actors.pop(p["actor_id"], None)
+        # Transitive: the journal record is emitted by the helper.
+        self._drop_actor(p["actor_id"])
+        return {"ok": True}
+
+    def _drop_actor(self, aid):
+        info = self._actors.pop(aid, None)
+        self._journal({"op": "actor_del", "actor_id": aid})
+        return info
+
+    def _replay(self, p):
+        # Applies through the replay path: replication-visible.
+        self._apply_record(p)
         return {"ok": True}
 
     def _read_view(self, p):
@@ -44,6 +68,7 @@ class Head:
         server = RpcServer({
             "sync_view": _mut(self._sync_view),
             "retire_entries": _mut(self._retire_entries),
+            "replay": _mut(self._replay),
             "read_view": self._read_view,
             "touch_node": self._touch_node,
         })

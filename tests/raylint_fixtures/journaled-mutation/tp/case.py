@@ -1,6 +1,13 @@
-"""True positive: handlers that write durable head tables registered
-WITHOUT the _mut/journal wrapper — their acked mutations vanish on a
-head kill -9 (no redo record ever hits the WAL)."""
+"""True positives: (1) handlers that write durable head tables
+registered WITHOUT the _mut/journal wrapper — their acked mutations
+vanish on a head kill -9 (no redo record ever hits the WAL); (2) a
+WRAPPED handler whose table write never emits a journal record — it
+survives the local kill -9 path only by accident and is INVISIBLE to
+the replication stream (a hot standby diverges silently)."""
+
+
+def idempotent_handler(fn, cache):
+    return fn
 
 
 class RpcServer:
@@ -16,6 +23,10 @@ class Head:
         self._kv = {}
         self._actors = {}
         self._named = {}
+        self._idem = object()
+
+    def _journal(self, record):
+        pass
 
     def _sync_view(self, p):
         # Direct subscript write to a durable table.
@@ -33,14 +44,24 @@ class Head:
             del self._named[info["name"]]
         return info
 
+    def _unjournaled_put(self, p):
+        # WRAPPED below, but the durable write never reaches
+        # self._journal: replication-invisible mutation.
+        self._kv[(p["ns"], p["key"])] = p["value"]
+        return {"ok": True}
+
     def _read_view(self, p):
         # Read-only: must NOT be flagged.
         return dict(self._kv)
 
     def build(self):
+        def _mut(fn):
+            return idempotent_handler(fn, self._idem)
+
         server = RpcServer({
             "sync_view": self._sync_view,
             "retire_entries": self._retire_entries,
+            "unjournaled_put": _mut(self._unjournaled_put),
             "read_view": self._read_view,
         })
         server.add_handler("late_sync", self._sync_view)

@@ -19,8 +19,34 @@ import ray_tpu
 from ray_tpu.cluster import journal as journal_mod
 from ray_tpu.cluster.cluster_utils import Cluster
 from ray_tpu.cluster.head import HeadServer
-from ray_tpu.cluster.rpc import IDEMPOTENCY_KEY, RpcClient
-from ray_tpu.exceptions import StaleEpochError
+from ray_tpu.cluster.pubsub import Publisher
+from ray_tpu.cluster.rpc import (IDEMPOTENCY_KEY, ReconnectingClient,
+                                 RpcClient, RpcServer)
+from ray_tpu.exceptions import NotPrimaryError, StaleEpochError
+
+
+def _ha_pair(tmp_path, *, primary_ttl_s=0.8, repl_mode="sync",
+             repl_timeout_s=2.0, lease_ttl_s=10.0):
+    """Primary + seeded standby with failover-speed knobs."""
+    primary = HeadServer(
+        "127.0.0.1", 0, storage_path=str(tmp_path / "primary.bin"),
+        lease_ttl_s=lease_ttl_s, repl_mode=repl_mode,
+        primary_ttl_s=primary_ttl_s, repl_timeout_s=repl_timeout_s)
+    standby = HeadServer(
+        "127.0.0.1", 0, storage_path=str(tmp_path / "standby.bin"),
+        lease_ttl_s=lease_ttl_s, standby_of=primary.address,
+        primary_ttl_s=primary_ttl_s, repl_timeout_s=repl_timeout_s)
+    return primary, standby
+
+
+def _wait_role(client: RpcClient, role: str, timeout_s: float = 15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = client.call("repl_status", {})
+        if st["role"] == role:
+            return st
+        time.sleep(0.05)
+    raise AssertionError(f"head never became {role}: {st}")
 
 
 def test_head_restart_preserves_state(tmp_path):
@@ -52,10 +78,11 @@ def test_head_restart_preserves_state(tmp_path):
         resources={"w": 1}).remote()
     assert ray_tpu.get(keeper.bump.remote(), timeout=30) == 1
 
-    # Give the flusher a beat to persist, then kill the head.
-    time.sleep(0.5)
+    # Journal mode: the ack IS the durability barrier — no flusher
+    # beat needed; a short settle covers in-flight heartbeats.
+    time.sleep(0.2)
     head.shutdown()
-    time.sleep(1.5)
+    time.sleep(0.3)
 
     # Restart at the SAME port with the same storage: tables replay.
     head2 = HeadServer("127.0.0.1", port, storage_path=storage)
@@ -406,3 +433,381 @@ def test_fencing_fenced_after_restart(tmp_path):
     finally:
         cl.close()
         head2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Replicated head: journal shipping, lease-fenced failover, split brain
+# ---------------------------------------------------------------------------
+
+def test_standby_tails_journal_and_serves_reads(tmp_path):
+    """The standby applies shipped journal frames into its own tables
+    (content digests identical to the primary's), serves READS, and
+    rejects mutations typed with a hint at the primary."""
+    primary, standby = _ha_pair(tmp_path)
+    cl = RpcClient(primary.address)
+    scl = RpcClient(standby.address)
+    try:
+        for i in range(20):
+            assert cl.call("kv_put", {"key": f"k{i}", "value": i,
+                                      "ns": "t"})["ok"]
+        assert cl.call("register_actor", {
+            "actor_id": b"A1", "node_id": "n1", "address": "x:1",
+            "name": "keeper", "namespace": ""})["ok"]
+        # Sync mode: the acks above already waited for standby
+        # durability — no settle sleep needed.
+        st = cl.call("repl_status", {"digest": True})
+        sst = scl.call("repl_status", {"digest": True})
+        assert st["synced"] and sst["synced"]
+        assert st["digests"] == sst["digests"], "replica diverged"
+        # Reads on the standby (read availability during failover).
+        assert scl.call("kv_get", {"key": "k7", "ns": "t"})["value"] == 7
+        assert scl.call("lookup_named_actor",
+                        {"name": "keeper"})["found"]
+        # Mutations reject typed with the primary hint.
+        with pytest.raises(NotPrimaryError) as ei:
+            scl.call("kv_put", {"key": "x", "value": 1, "ns": "t"})
+        assert ei.value.primary_hint == primary.address
+        assert not scl.call("kv_get", {"key": "x", "ns": "t"})["found"]
+    finally:
+        cl.close()
+        scl.close()
+        primary.shutdown()
+        standby.shutdown()
+
+
+def test_standby_promotes_on_primary_death_zero_loss(tmp_path):
+    """Primary dies → the standby's primary-lease lapses → it promotes
+    with generation+1 and serves every mutation the primary ever
+    acked (sync mode: zero-loss failover)."""
+    primary, standby = _ha_pair(tmp_path)
+    cl = RpcClient(primary.address)
+    acked = {}
+    try:
+        for i in range(30):
+            if cl.call("kv_put", {"key": f"p{i}", "value": i,
+                                  "ns": "t"})["ok"]:
+                acked[f"p{i}"] = i
+        gen0 = cl.call("repl_status", {})["generation"]
+        cl.close()
+        primary.shutdown()
+        scl = RpcClient(standby.address)
+        st = _wait_role(scl, "primary")
+        assert st["generation"] == gen0 + 1
+        for key, val in acked.items():
+            r = scl.call("kv_get", {"key": key, "ns": "t"})
+            assert r["found"] and r["value"] == val, \
+                f"acked mutation {key!r} lost across failover"
+        # The new primary acks writes.
+        assert scl.call("kv_put", {"key": "post", "value": 1,
+                                   "ns": "t"})["ok"]
+        scl.close()
+    finally:
+        primary.shutdown()
+        standby.shutdown()
+
+
+def test_promotion_race_partition_exactly_one_wins(tmp_path):
+    """Split brain: the replication link partitions, BOTH heads are
+    alive and the standby promotes.  Exactly one side may ack —
+    the sync-mode primary's mutations fail typed while partitioned
+    (never acked, so nothing is lost), and once it learns of the
+    newer generation it is deposed: rejects typed forever."""
+    primary, standby = _ha_pair(tmp_path)
+    cl = RpcClient(primary.address)
+    scl = RpcClient(standby.address)
+    try:
+        assert cl.call("kv_put", {"key": "pre", "value": 0,
+                                  "ns": "t"})["ok"]
+        cl.call("repl_control", {"partition_s": 2.5})
+        # During the partition the primary cannot confirm standby
+        # durability: the mutation FAILS TYPED instead of acking a
+        # write the failover would lose.
+        with pytest.raises((TimeoutError, NotPrimaryError)):
+            cl.call("kv_put", {"key": "torn", "value": 1, "ns": "t"},
+                    timeout=10.0)
+        _wait_role(scl, "primary")
+        # New primary acks; old primary is deposed on first contact
+        # after the heal (its ship loop hears "promoted").
+        assert scl.call("kv_put", {"key": "won", "value": 2,
+                                   "ns": "t"})["ok"]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if cl.call("repl_status", {})["deposed"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("old primary never learned of its "
+                                 "deposition")
+        with pytest.raises(NotPrimaryError) as ei:
+            cl.call("kv_put", {"key": "zombie", "value": 3, "ns": "t"})
+        assert ei.value.primary_hint == standby.address
+        # Neither head ever accepted the partitioned/zombie writes.
+        for head_cl in (scl,):
+            assert not head_cl.call("kv_get", {"key": "torn",
+                                               "ns": "t"})["found"]
+            assert not head_cl.call("kv_get", {"key": "zombie",
+                                               "ns": "t"})["found"]
+    finally:
+        cl.close()
+        scl.close()
+        primary.shutdown()
+        standby.shutdown()
+
+
+def test_client_generation_fences_stale_primary(tmp_path):
+    """Fencing propagates through CLIENTS: a mutation stamped with a
+    newer head generation deposes an old-generation head on contact —
+    a revived pre-failover primary cannot ack even before it ever
+    reaches the new primary."""
+    head = HeadServer("127.0.0.1", 0,
+                      storage_path=str(tmp_path / "solo.bin"))
+    cl = RpcClient(head.address)
+    try:
+        assert head.generation == 1
+        with pytest.raises(NotPrimaryError):
+            cl.call("kv_put", {"key": "k", "value": 1, "ns": "t",
+                               "head_gen": 7})
+        assert head.deposed
+        # ... and it stays fenced for gen-less writers too.
+        with pytest.raises(NotPrimaryError):
+            cl.call("kv_put", {"key": "k2", "value": 2, "ns": "t"})
+    finally:
+        cl.close()
+        head.shutdown()
+
+
+def test_failover_mid_mut_retry_dedups_via_replicated_idem(tmp_path):
+    """A client retry straddling a FAILOVER dedups: the idempotency
+    cache replicates with the journal, so the promoted standby
+    replays the first reply for the same key instead of re-applying
+    (here: a re-register would answer 'name already taken')."""
+    primary, standby = _ha_pair(tmp_path)
+    cl = RpcClient(primary.address)
+    payload = {"actor_id": b"A1", "node_id": "n1", "address": "x:1",
+               "name": "keeper", "namespace": ""}
+    r1 = cl.call("register_actor",
+                 {**payload, IDEMPOTENCY_KEY: "idem-f1"})
+    assert r1["ok"]
+    cl.close()
+    primary.shutdown()
+    scl = RpcClient(standby.address)
+    try:
+        _wait_role(scl, "primary")
+        # The straddling retry: same key, new head → first reply.
+        r2 = scl.call("register_actor",
+                      {**payload, IDEMPOTENCY_KEY: "idem-f1"})
+        assert r2 == r1
+        # A different key with the same name conflicts — the success
+        # above came from the cache, not laxness.
+        r3 = scl.call("register_actor",
+                      {**payload, "actor_id": b"A2",
+                       IDEMPOTENCY_KEY: "idem-f2"})
+        assert not r3["ok"] and "already taken" in r3["error"]
+    finally:
+        scl.close()
+        standby.shutdown()
+
+
+def test_standby_crash_reseed_from_primary_snapshot(tmp_path):
+    """Standby dies; the primary (async mode) keeps acking; a FRESH
+    standby re-seeds from the primary's snapshot and converges to
+    identical digests."""
+    primary, standby = _ha_pair(tmp_path, repl_mode="async",
+                                primary_ttl_s=10.0)
+    cl = RpcClient(primary.address)
+    try:
+        for i in range(10):
+            assert cl.call("kv_put", {"key": f"a{i}", "value": i,
+                                      "ns": "t"})["ok"]
+        standby.shutdown()  # crash the standby
+        # Async primary keeps acking while the standby is gone.
+        for i in range(10, 20):
+            assert cl.call("kv_put", {"key": f"a{i}", "value": i,
+                                      "ns": "t"})["ok"]
+        # A fresh standby re-seeds from the primary's snapshot
+        # (stale local WAL ignored — seed wins).
+        standby2 = HeadServer(
+            "127.0.0.1", 0,
+            storage_path=str(tmp_path / "standby2.bin"),
+            standby_of=primary.address, primary_ttl_s=10.0,
+            repl_timeout_s=2.0)
+        try:
+            s2 = RpcClient(standby2.address)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                st = cl.call("repl_status", {"digest": True})
+                sst = s2.call("repl_status", {"digest": True})
+                if (sst.get("synced")
+                        and st["digests"] == sst["digests"]):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"re-seeded standby never converged: {st} {sst}")
+            assert s2.call("kv_get", {"key": "a15",
+                                      "ns": "t"})["value"] == 15
+            s2.close()
+        finally:
+            standby2.shutdown()
+    finally:
+        cl.close()
+        primary.shutdown()
+        standby.shutdown()
+
+
+def test_torn_replication_frame_at_standby_tail(tmp_path):
+    """A truncated frame run at the standby acks only the complete
+    prefix (the tear is NOT fatal); a re-ship of the full run
+    catches the watermark up — mirroring the WAL's own torn-tail
+    tolerance on the wire."""
+    primary, standby = _ha_pair(tmp_path, primary_ttl_s=30.0)
+    cl = RpcClient(primary.address)
+    scl = RpcClient(standby.address)
+    try:
+        assert cl.call("kv_put", {"key": "base", "value": 0,
+                                  "ns": "t"})["ok"]
+        applied0 = scl.call("repl_status", {})["applied_seq"]
+        rec1 = {"op": "kv_put", "ns": "t", "key": "t1", "value": 1,
+                "seq": applied0 + 1}
+        rec2 = {"op": "kv_put", "ns": "t", "key": "t2", "value": 2,
+                "seq": applied0 + 2}
+        frames = (journal_mod.frame_record(rec1)
+                  + journal_mod.frame_record(rec2))
+        torn = frames[:-3]  # tear rec2's payload mid-byte
+        r = scl.call("repl_frames", {"gen": 1, "frames": torn})
+        assert r["torn"] and r["applied_seq"] == applied0 + 1
+        assert scl.call("kv_get", {"key": "t1", "ns": "t"})["found"]
+        assert not scl.call("kv_get", {"key": "t2", "ns": "t"})["found"]
+        # Re-ship from the acked watermark: rec1 dedups (seq ≤
+        # applied), rec2 lands.
+        r = scl.call("repl_frames", {"gen": 1, "frames": frames})
+        assert not r["torn"] and r["applied_seq"] == applied0 + 2
+        assert scl.call("kv_get", {"key": "t2", "ns": "t"})["value"] == 2
+    finally:
+        cl.close()
+        scl.close()
+        primary.shutdown()
+        standby.shutdown()
+
+
+def test_reconnecting_client_walks_head_set():
+    """Head-set aware reconnect: the constructor and re-dials walk
+    the ordered candidate list (dead candidates cost a bounded dial
+    + cooldown, not an infinite redial)."""
+    live = RpcServer({"ping": lambda p: "pong"})
+    # An address with nothing listening: instant refusals.
+    dead_addr = "127.0.0.1:1"
+    try:
+        t0 = time.monotonic()
+        cl = ReconnectingClient(dead_addr, connect_timeout=4.0,
+                                candidates=[live.address])
+        assert cl.call("ping", {}, timeout=5.0) == "pong"
+        assert cl.address == live.address
+        assert time.monotonic() - t0 < 4.0, \
+            "walk burned the whole budget on the dead candidate"
+        # The server-advertised set appends without disturbing the
+        # live connection.
+        cl.set_candidates(["127.0.0.1:2"])
+        assert cl.candidates == [dead_addr, live.address,
+                                 "127.0.0.1:2"]
+        cl.close()
+    finally:
+        live.shutdown()
+
+
+def test_pubsub_cursor_clamp_across_failover():
+    """A poll cursor minted against another head's sequence space
+    (bigger than this channel's) resyncs with the retained window
+    instead of starving until seq catches up."""
+    pub = Publisher()
+    pub.publish("node_death", {"node_id": "a"})
+    pub.publish("node_death", {"node_id": "b"})
+    out = pub.poll({"node_death": 500}, timeout_s=0.5)
+    got = [e["node_id"] for e in out["node_death"]["events"]]
+    assert got == ["a", "b"]
+    assert out["node_death"]["seq"] == 2
+
+
+def test_cluster_client_mut_call_survives_failover(tmp_path):
+    """End to end through the REAL client plane: a driver attached to
+    the primary keeps mutating across a failover — mut_call absorbs
+    the advertised head set at registration, walks to the standby on
+    connection loss, retries typed NotPrimary rejections until
+    promotion, and the op lands under its original deadline."""
+    primary, standby = _ha_pair(tmp_path, primary_ttl_s=0.8,
+                                lease_ttl_s=2.0)
+    rt = None
+    try:
+        ray_tpu.shutdown()
+        rt = ray_tpu.init(address=primary.address)
+        rt.cluster.kv_put("before", 1, ns="ha")
+        assert rt.cluster.head.candidates == [primary.address,
+                                              standby.address]
+        primary.shutdown()
+        # The SAME client keeps mutating: failover + promotion happen
+        # under this call's deadline.
+        rt.cluster.kv_put("after", 2, ns="ha")
+        assert rt.cluster.kv_get("before", ns="ha") == 1
+        assert rt.cluster.kv_get("after", ns="ha") == 2
+        st = RpcClient(standby.address).call("repl_status", {})
+        assert st["role"] == "primary"
+    finally:
+        ray_tpu.shutdown()
+        primary.shutdown()
+        standby.shutdown()
+
+
+def test_head_retention_ring_outlives_memory_window(tmp_path,
+                                                    monkeypatch):
+    """The on-disk retention ring answers history=True queries past
+    RAY_TPU_HEAD_LOGS_MAX, and a promoted standby serves ITS copy
+    fed by the replication side-stream."""
+    monkeypatch.setenv("RAY_TPU_HEAD_LOGS_MAX", "50")
+    primary, standby = _ha_pair(tmp_path, primary_ttl_s=0.5,
+                                lease_ttl_s=2.0)
+    cl = RpcClient(primary.address)
+    try:
+        for batch in range(4):
+            cl.call("push_events", {
+                "node_id": "n1",
+                "events": [{"name": f"ev{batch}-{i}", "ph": "i",
+                            "ts": batch * 100 + i}
+                           for i in range(10)],
+                "logs": [{"msg": f"rec{batch}-{i}", "level": "INFO",
+                          "ts": batch * 100 + i, "logger": "t"}
+                         for i in range(30)],
+            })
+        # In-memory window: bounded at 50; the ring kept all 120.
+        mem = cl.call("cluster_logs", {"limit": 1000})
+        assert mem["total_stored"] == 50
+        hist = cl.call("cluster_logs", {"limit": 1000,
+                                        "history": True})
+        assert len(hist["records"]) == 120
+        assert any(r["msg"] == "rec0-0" for r in hist["records"])
+        tl = cl.call("cluster_timeline", {"history": True,
+                                          "with_logs": False})
+        assert len([e for e in tl["events"]
+                    if str(e.get("name", "")).startswith("ev")]) == 40
+        # Promoted standby serves history from its side-stream copy.
+        scl = RpcClient(standby.address)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            h2 = scl.call("cluster_logs", {"limit": 1000,
+                                           "history": True})
+            if len(h2["records"]) >= 120:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"standby ring never caught up: "
+                f"{len(h2['records'])} records")
+        cl.close()
+        primary.shutdown()
+        _wait_role(scl, "primary")
+        h3 = scl.call("cluster_logs", {"limit": 1000, "history": True,
+                                       "text": "rec0-"})
+        assert len(h3["records"]) == 30
+        scl.close()
+    finally:
+        primary.shutdown()
+        standby.shutdown()

@@ -44,8 +44,8 @@ def test_vcluster_smoke_kill_head_mid_load(vcluster):
     vc = vcluster(25)
     vc.start()
     assert vc.alive_nodes() == 25
-    vc.load(4.0, threads=4)
-    time.sleep(1.2)
+    vc.load(3.0, threads=4)
+    time.sleep(0.8)
     vc.kill_head()
     assert not vc.head_alive()
     time.sleep(0.3)
@@ -161,3 +161,127 @@ def test_vcluster_soak_300_nodes_kill_head(vcluster):
     stats = vc.stats()
     assert stats["placement_p99_ms"] is not None
     print(f"\nsoak: startup {startup_s:.1f}s, stats {stats}")
+
+
+def test_vcluster_failover_standby_promotes_mid_load(vcluster):
+    """The HA smoke (acceptance shape, 25 nodes for tier-1; the
+    300-node version is the stress soak below): kill -9 the primary
+    mid-load with a hot standby attached → the standby promotes on
+    the lapsed primary lease, clients fail over through their head
+    set, zero acked mutations are lost (sync mode), no stale-epoch
+    write lands, and the goodput dip stays under 5 s."""
+    vc = vcluster(25)
+    vc.start()
+    vc.start_standby()
+    assert vc.repl_status()["repl"]["mode"] == "sync"
+    vc.load(6.0, threads=4)
+    time.sleep(1.5)
+    vc.kill_head()
+    assert not vc.head_alive()
+    vc.wait_promoted(timeout_s=30.0)
+    vc.join_load(timeout_s=60.0)
+    vc.wait_converged(timeout_s=30.0)
+    report = vc.verify()
+    assert report["checked"] > 50, "load produced too few mutations"
+    assert report["missing"] == [], \
+        f"lost acked mutations across failover: {report['missing'][:5]}"
+    assert report["stale_epoch_accepted"] == 0
+    st = vc.repl_status(standby=True)
+    assert st["role"] == "primary" and st["generation"] >= 2
+    dip = vc.unavailability_ms()
+    assert dip is not None and dip < 5000.0, \
+        f"goodput dip {dip}ms breaches the 5s failover budget"
+
+
+def test_vcluster_partition_heads_split_brain_fenced(vcluster):
+    """partition_heads: both heads alive, replication severed → the
+    standby promotes; the old primary's mutations never ack (sync
+    barrier fails typed) and once the partition heals it is deposed.
+    Exactly one head wins; zero zombie writes on either."""
+    from ray_tpu.cluster.rpc import ReconnectingClient
+    from ray_tpu.exceptions import StaleEpochError
+
+    vc = vcluster(8)
+    vc.start()
+    vc.start_standby()
+    conn = ReconnectingClient(vc.head_address)
+    try:
+        assert conn.call_idempotent(
+            "kv_put", {"key": "pre", "value": 1, "ns": "vcluster"},
+            timeout=5.0, deadline_s=15.0)["ok"]
+        vc.partition_heads(4.0)
+        with pytest.raises((TimeoutError, ConnectionError,
+                            StaleEpochError)):
+            conn.call("kv_put", {"key": "torn", "value": 1,
+                                 "ns": "vcluster"}, timeout=10.0)
+        vc.wait_promoted(timeout_s=30.0)
+        # New primary acks.
+        sconn = ReconnectingClient(vc.standby_address)
+        try:
+            assert sconn.call_idempotent(
+                "kv_put", {"key": "won", "value": 2,
+                           "ns": "vcluster"},
+                timeout=5.0, deadline_s=15.0)["ok"]
+            # Old primary learns of its deposition after the heal
+            # and rejects typed forever.
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if conn.call("repl_status", {},
+                             timeout=5.0)["deposed"]:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("old primary never deposed")
+            with pytest.raises(StaleEpochError):
+                conn.call("kv_put", {"key": "zombie", "value": 3,
+                                     "ns": "vcluster"}, timeout=10.0)
+            assert not sconn.call("kv_get", {
+                "key": "torn", "ns": "vcluster"})["found"]
+            assert not sconn.call("kv_get", {
+                "key": "zombie", "ns": "vcluster"})["found"]
+        finally:
+            sconn.close()
+    finally:
+        conn.close()
+
+
+@pytest.mark.stress
+def test_vcluster_soak_300_nodes_failover(vcluster):
+    """The PR-12 acceptance soak: 300 virtual nodes under sustained
+    load with a hot standby in sync mode, primary kill -9 mid-load →
+    promotion completes, zero acked mutations lost, zero stale-epoch
+    writes accepted by either head, goodput dip bounded under 5 s."""
+    vc = vcluster(300, n_conns=8)
+    vc.start()
+    assert vc.alive_nodes() == 300
+    vc.start_standby()
+
+    vc.load(14.0, threads=8)
+    time.sleep(4.0)
+    victim = vc.nodes[7]
+    old_epoch = victim.epoch
+    chaos.partition_node(victim.node_id, duration_s=6.0)
+    vc.kill_head()
+    vc.wait_promoted(timeout_s=60.0)
+    vc.join_load(timeout_s=120.0)
+    vc.wait_converged(timeout_s=60.0, target=299)
+
+    # Zombie fencing holds on the NEW primary too: the victim's
+    # pre-failover epoch was fenced by lease expiry (journaled,
+    # replicated) — its writes reject typed.
+    deadline = time.monotonic() + 20.0
+    while victim.epoch == old_epoch and time.monotonic() < deadline:
+        time.sleep(0.4)
+    assert vc.zombie_write_check(victim, old_epoch), \
+        "stale-epoch write accepted after failover"
+
+    report = vc.verify()
+    assert report["checked"] > 200
+    assert report["missing"] == [], \
+        f"lost {len(report['missing'])} acked mutations in failover"
+    assert report["stale_epoch_accepted"] == 0
+    dip = vc.unavailability_ms()
+    assert dip is not None and dip < 5000.0, \
+        f"goodput dip {dip}ms breaches the 5s failover budget"
+    st = vc.stats()
+    print(f"\nfailover soak: dip {dip}ms, stats {st}")

@@ -47,6 +47,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.cluster.rpc import (TRANSPORT_ERRORS, ReconnectingClient)
+from ray_tpu.exceptions import StaleEpochError
 from ray_tpu.experimental import chaos
 
 
@@ -90,6 +91,19 @@ class VCluster:
         self.head_address = head_address or ""
         self._head_port = 0
         self._proc: Optional[subprocess.Popen] = None
+        # Hot-standby pair (start_standby): its own subprocess +
+        # storage, tailing the primary's journal.
+        self.standby_address = ""
+        self.standby_storage: Optional[str] = None
+        self._standby_proc: Optional[subprocess.Popen] = None
+        self.primary_ttl_s = max(0.5, float(lease_ttl_s) / 2)
+        self.kill_times: List[float] = []
+        # One cooldown map for EVERY client this harness makes (pump
+        # conns, drivers, load workers): the first client to probe a
+        # dead head spares the rest — without it the single pump
+        # thread pays n_conns serial dial budgets after a failover
+        # and renewals can outlast the node lease.
+        self._cooldowns: Dict[str, tuple] = {}
         self._n_conns = max(1, min(int(n_conns), self.n_nodes))
         self._conns: List[ReconnectingClient] = []
         self._rng = random.Random(seed)
@@ -116,6 +130,11 @@ class VCluster:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env["RAY_TPU_LEASE_TTL_S"] = str(self.lease_ttl_s)
+        # The PRIMARY's value is authoritative (the standby adopts it
+        # from the attach reply) — it must be exported here, not just
+        # on the standby spawn, or the promotion window silently
+        # defaults to the full node lease TTL.
+        env["RAY_TPU_HEAD_PRIMARY_TTL_S"] = str(self.primary_ttl_s)
         env.setdefault("RAY_TPU_HEAD_COMPACT_EVERY_S", "2.0")
         env.update(self._head_env)
         cmd = [sys.executable, "-m", "ray_tpu.cluster.head",
@@ -156,17 +175,168 @@ class VCluster:
     def kill_head(self):
         """kill -9 the head mid-flight (delegates to chaos so tests
         read as chaos scripts)."""
+        self.kill_times.append(time.monotonic())
         return chaos.kill_head()
 
     def head_alive(self) -> bool:
         return self._proc is not None and self._proc.poll() is None
 
+    # --------------------------------------------------- hot standby
+    def _candidates(self) -> List[str]:
+        return [a for a in (self.head_address, self.standby_address)
+                if a]
+
+    def start_standby(self, storage: Optional[str] = None,
+                      sync_timeout_s: float = 120.0,
+                      repl_mode: Optional[str] = None) -> str:
+        """Spawn a hot-standby head subprocess tailing the primary's
+        journal; blocks until it reports seeded + caught up.  Returns
+        its address.  Timing: the standby promotes itself when the
+        primary ships nothing for ``primary_ttl_s`` (half the node
+        lease TTL by default — failover inside one node lease)."""
+        if self._standby_proc is not None and \
+                self._standby_proc.poll() is None:
+            raise RuntimeError("standby already running")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["RAY_TPU_LEASE_TTL_S"] = str(self.lease_ttl_s)
+        env["RAY_TPU_HEAD_PRIMARY_TTL_S"] = str(self.primary_ttl_s)
+        env.setdefault("RAY_TPU_HEAD_COMPACT_EVERY_S", "2.0")
+        env.update(self._head_env)
+        if repl_mode:
+            env["RAY_TPU_HEAD_REPL_MODE"] = repl_mode
+        self.standby_storage = storage or (
+            self.storage + ".standby" if self.storage else None)
+        cmd = [sys.executable, "-m", "ray_tpu.cluster.head",
+               "--port", "0", "--standby-of", self.head_address]
+        if self.standby_storage:
+            cmd += ["--storage", self.standby_storage]
+        self._standby_proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        import select
+
+        deadline = time.monotonic() + sync_timeout_s
+        line = ""
+        while time.monotonic() < deadline:
+            # select before readline: a standby wedged mid-seed
+            # (unreachable primary) stays ALIVE and SILENT — a bare
+            # blocking readline would hang past the deadline forever.
+            ready, _w, _x = select.select(
+                [self._standby_proc.stdout], [], [],
+                max(0.1, min(1.0, deadline - time.monotonic())))
+            if not ready:
+                if self._standby_proc.poll() is not None:
+                    raise RuntimeError(
+                        f"standby subprocess died at start: {line}")
+                continue
+            line = (self._standby_proc.stdout.readline()
+                    or b"").decode(errors="replace").strip()
+            if line.startswith("RAY_TPU_HEAD_ADDRESS="):
+                break
+            if self._standby_proc.poll() is not None:
+                raise RuntimeError(
+                    f"standby subprocess died at start: {line}")
+        else:
+            raise TimeoutError(
+                "standby subprocess never printed its address")
+        self.standby_address = line.split("=", 1)[1]
+        # Existing connections learn the widened head set; clients
+        # created later pick it up from _candidates().
+        for c in self._conns:
+            c.set_candidates(self._candidates())
+        # The primary also must see it attached + caught up before
+        # chaos starts (sync mode: acks already wait on it).
+        conn = ReconnectingClient(self.standby_address)
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    st = conn.call("repl_status", {}, timeout=5.0)
+                except TRANSPORT_ERRORS:
+                    time.sleep(0.2)
+                    continue
+                if st.get("synced"):
+                    return self.standby_address
+                time.sleep(0.1)
+        finally:
+            conn.close()
+        raise TimeoutError("standby never reported synced")
+
+    def standby_alive(self) -> bool:
+        return (self._standby_proc is not None
+                and self._standby_proc.poll() is None)
+
+    def kill_standby(self):
+        """kill -9 the standby (sync-mode primaries stall typed until
+        a standby re-attaches or is detached)."""
+        if self._standby_proc is None or \
+                self._standby_proc.poll() is not None:
+            raise RuntimeError("no live standby to kill")
+        import signal as _signal
+
+        self._standby_proc.send_signal(_signal.SIGKILL)
+        self._standby_proc.wait(timeout=10.0)
+        return self._standby_proc
+
+    def promote(self, timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Promote the standby NOW (tests that don't want to wait out
+        the primary lease)."""
+        conn = ReconnectingClient(self.standby_address)
+        try:
+            return conn.call_retry("promote",
+                                   {"reason": "vcluster"},
+                                   timeout=10.0,
+                                   deadline_s=timeout_s)
+        finally:
+            conn.close()
+
+    def partition_heads(self, duration_s: float) -> None:
+        """Sever the replication link for ``duration_s``: the standby
+        sees a silent primary (lease lapses → it promotes) while the
+        primary keeps running — the split-brain scenario the
+        generation fencing must win."""
+        conn = ReconnectingClient(self.head_address)
+        try:
+            conn.call("repl_control",
+                      {"partition_s": float(duration_s)},
+                      timeout=10.0)
+        finally:
+            conn.close()
+
+    def repl_status(self, standby: bool = False) -> Dict[str, Any]:
+        conn = ReconnectingClient(self.standby_address if standby
+                                  else self.head_address)
+        try:
+            return conn.call_retry("repl_status", {}, timeout=10.0,
+                                   deadline_s=30.0)
+        finally:
+            conn.close()
+
+    def wait_promoted(self, timeout_s: float = 30.0) -> None:
+        """Block until the standby reports role=primary."""
+        deadline = time.monotonic() + timeout_s
+        conn = ReconnectingClient(self.standby_address)
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    st = conn.call("repl_status", {}, timeout=5.0)
+                    if st.get("role") == "primary":
+                        return
+                except TRANSPORT_ERRORS:
+                    pass
+                time.sleep(0.1)
+        finally:
+            conn.close()
+        raise TimeoutError("standby never promoted")
+
     # ------------------------------------------------------------ start
     def start(self, register_timeout_s: float = 120.0) -> None:
         if not self.head_address:
             self._spawn_head()
-        self._conns = [ReconnectingClient(self.head_address)
-                       for _ in range(self._n_conns)]
+        self._conns = [ReconnectingClient(
+            self.head_address, candidates=self._candidates(),
+            shared_cooldowns=self._cooldowns)
+            for _ in range(self._n_conns)]
         # Parallel registration: at 300 nodes, serial round-trips with
         # per-mutation fsync dominate startup.
         groups = [self.nodes[i::self._n_conns]
@@ -236,8 +406,16 @@ class VCluster:
                     resp = conn.call("heartbeat_batch", {
                         "beats": beats, "view_seq": self._view_seq,
                     }, timeout=10.0)
+                except StaleEpochError:
+                    # NotPrimary included: the beat reached a deposed
+                    # primary mid-failover — walk the head set.
+                    conn.failover()
+                    continue
                 except TRANSPORT_ERRORS:
                     continue  # head down/partitioned: next tick retries
+                if resp.get("deposed"):
+                    conn.failover()  # fenced ex-primary: walk the set
+                    continue
                 self._view_seq = resp.get("view_seq", self._view_seq)
                 for node, beat, r in zip(beat_nodes, beats,
                                          resp.get("replies") or ()):
@@ -258,7 +436,9 @@ class VCluster:
 
     # -------------------------------------------------------- workload
     def _driver(self) -> ReconnectingClient:
-        return ReconnectingClient(self.head_address)
+        return ReconnectingClient(self.head_address,
+                                  candidates=self._candidates(),
+                                  shared_cooldowns=self._cooldowns)
 
     def load(self, duration_s: float, threads: int = 4,
              *, place_frac: float = 0.5, kv_frac: float = 0.25,
@@ -333,6 +513,13 @@ class VCluster:
                                 timeout=5.0,
                                 deadline_s=op_deadline_s)
                             ok = True
+                    except StaleEpochError:
+                        # NotPrimaryError included (subclass): the op
+                        # reached a standby/deposed head mid-failover
+                        # — typed, never applied.  Walk the head set
+                        # and count the op against goodput.
+                        ok = False
+                        conn.failover()
                     except TRANSPORT_ERRORS:
                         ok = False
                     with self._lock:
@@ -472,6 +659,30 @@ class VCluster:
         return [(b * bucket_s, n / bucket_s)
                 for b, n in sorted(out.items())]
 
+    def unavailability_ms(self,
+                          after_ts: Optional[float] = None,
+                          window_s: float = 30.0) -> Optional[float]:
+        """Goodput outage around a head kill: the LARGEST gap between
+        consecutive successful ops whose span intersects
+        [``after_ts``, ``after_ts + window_s``] (default: the most
+        recent ``kill_head``).  Max-gap, not first-op-after — an
+        in-flight ack draining right after the kill timestamp must
+        not mask the real dip.  None without enough signal."""
+        if after_ts is None:
+            after_ts = self.kill_times[-1] if self.kill_times else None
+        if after_ts is None:
+            return None
+        with self._lock:
+            oks = sorted(ts for ts, ok in self.op_events if ok)
+        if len(oks) < 2:
+            return None
+        worst = 0.0
+        for prev, cur in zip(oks, oks[1:]):
+            if cur < after_ts or prev > after_ts + window_s:
+                continue
+            worst = max(worst, cur - prev)
+        return round(worst * 1000.0, 1)
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             lats = sorted(self.placement_latencies)
@@ -504,12 +715,13 @@ class VCluster:
         for c in self._conns:
             c.close()
         self._conns = []
-        if self._proc is not None and self._proc.poll() is None:
-            self._proc.terminate()
-            try:
-                self._proc.wait(timeout=5.0)
-            except subprocess.TimeoutExpired:
-                self._proc.kill()
+        for proc in (self._proc, self._standby_proc):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
 
 
 def main() -> int:  # pragma: no cover - CLI soak driver
