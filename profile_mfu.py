@@ -1,19 +1,65 @@
-"""One-off MFU decomposition on the real chip (not part of the package).
+"""MFU decomposition + sweep harness on the real chip (not part of the
+package).
 
-Times the pieces of the 440M train step separately so the gap between
-31.5% measured MFU and peak is attributable.  Each phase runs in its own
-subprocess (HBM buffers + jit caches would otherwise accumulate and OOM).
+Times the pieces of the train step separately so the gap between
+measured MFU and peak is ATTRIBUTABLE (fwd vs bwd vs optimizer vs the
+attention kernel), and sweeps the knobs that move it — remat policy,
+flash-attention tile sizes, fused-vs-optax optimizer — so the winning
+configuration is reproducible from the CLI and can be recorded as the
+preset default.  Each phase runs in its own subprocess (HBM buffers +
+jit caches would otherwise accumulate and OOM).
 
-Usage: python profile_mfu.py [batch] ['{"remat_policy":"dots"}']
-       python profile_mfu.py --one <phase> <batch> <cfg_json>
+Usage:
+  python profile_mfu.py                           # preset defaults
+  python profile_mfu.py --batch 8 --remat-policy attn \
+      --remat-policy attn_ffn --attn-block 512 --attn-block 1024 \
+      --optimizer both                            # 2x2x2 sweep
+  python profile_mfu.py --phases fwd,grad,step    # subset
+  python profile_mfu.py --one '<json>'            # internal (subprocess)
+
+Per config it emits ONE JSON line with the per-phase breakdown:
+fwd/bwd/optimizer seconds, achieved TFLOP/s vs the chip roofline for
+the flop-bearing phases, tok/s and 6N MFU; after a sweep it emits a
+``winner`` line (highest tok/s) — the configuration to record on the
+preset.
 """
+import argparse
+import itertools
 import json
 import subprocess
 import sys
 import time
 
-PEAK = 197e12
 PHASES = ["fwd", "grad", "step", "attn_flash", "attn_dot", "head"]
+
+
+def _peak_flops():
+    """Per-chip bf16 peak for the roofline denominator (bench.py's
+    table); CPU runs report None and skip roofline percentages.
+
+    Probed in a SUBPROCESS: jax.devices() in the sweep parent would
+    acquire the TPU runtime (libtpu is exclusive per process) and
+    every per-phase subprocess after it would fail to initialize the
+    device — the whole reason phases run in subprocesses."""
+    proc = subprocess.run(
+        [sys.executable, __file__, "--device-info"],
+        capture_output=True, text=True, timeout=600)
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("{"):
+            info = json.loads(ln)
+            return info["peak"], info["kind"]
+    return None, "unknown"
+
+
+def _device_info():
+    import jax
+
+    from bench import _peak_bf16_flops
+
+    dev = jax.devices()[0]
+    peak = (None if dev.platform == "cpu"
+            else _peak_bf16_flops(dev.device_kind))
+    print(json.dumps({"peak": peak, "kind": dev.device_kind}))
 
 
 def _sync(out):
@@ -39,22 +85,34 @@ def timeit(fn, *args, warmup=2, steps=5):
     return (time.perf_counter() - t0) / steps
 
 
-def run_one(phase: str, batch: int, cfg_kw: dict):
+def _build_cfg(spec: dict):
+    from ray_tpu.models import llama
+
+    preset = getattr(llama.LlamaConfig, spec.get("preset", "llama_440m"))
+    return preset(**spec.get("cfg", {}))
+
+
+def run_one(spec: dict):
     import jax
     import jax.numpy as jnp
 
     from ray_tpu.models import llama
 
-    seq = 2048
-    cfg = llama.LlamaConfig.llama_440m(**cfg_kw)
+    phase = spec["phase"]
+    batch = spec["batch"]
+    seq = spec.get("seq", 2048)
+    cfg = _build_cfg(spec)
+    fused = spec.get("fused", False)
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                                 cfg.vocab_size, dtype=jnp.int32)
     b = {"tokens": tokens}
 
     if phase in ("fwd", "grad", "step"):
         if phase == "step":
-            state = llama.init_train_state(jax.random.key(0), cfg)
-            step = llama.make_train_step(cfg, donate=False)
+            state = llama.init_train_state(jax.random.key(0), cfg,
+                                           fused=fused)
+            step = llama.make_train_step(cfg, donate=False,
+                                         fused=fused)
             t = timeit(lambda: step(state, b)[1]["loss"])
         else:
             params = llama.init_params(jax.random.key(0), cfg)
@@ -75,8 +133,12 @@ def run_one(phase: str, batch: int, cfg_kw: dict):
                               jnp.bfloat16)
         pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         if phase == "attn_flash":
+            import functools
+
             from ray_tpu.ops.flash_attention import flash_attention_causal
-            attn = flash_attention_causal
+            attn = functools.partial(flash_attention_causal,
+                                     block_q=cfg.attn_block_q,
+                                     block_k=cfg.attn_block_k)
         else:
             attn = llama.dot_attention
 
@@ -84,7 +146,7 @@ def run_one(phase: str, batch: int, cfg_kw: dict):
             lambda q, k, v: jnp.sum(attn(q, k, v, pos)
                                     .astype(jnp.float32)),
             argnums=(0, 1, 2)))
-        t = timeit(g, q, k, v) * cfg.n_layers  # scale to 24 layers
+        t = timeit(g, q, k, v) * cfg.n_layers  # scale to full depth
     elif phase == "head":
         params = llama.init_params(jax.random.key(0), cfg)
         x = jax.random.normal(jax.random.key(5),
@@ -106,14 +168,35 @@ def run_one(phase: str, batch: int, cfg_kw: dict):
     print(json.dumps({"phase": phase, "s": round(t, 4)}))
 
 
-def main():
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    cfg_json = sys.argv[2] if len(sys.argv) > 2 else "{}"
-    res = {"batch": batch, "cfg": json.loads(cfg_json)}
-    for phase in PHASES:
+def _n_params(spec: dict) -> int:
+    import jax
+
+    from ray_tpu.models import llama
+
+    cfg = _build_cfg(spec)
+    return llama.param_count(jax.eval_shape(
+        lambda: llama.init_params(jax.random.key(0), cfg)))
+
+
+def run_config(spec: dict, phases, peak, seed_timings=None) -> dict:
+    """All phases for one configuration (each in a subprocess), plus
+    the derived breakdown: bwd/opt slices, achieved TFLOP/s and
+    roofline fraction per flop-bearing phase, 6N MFU.
+    ``seed_timings`` carries phase results already measured for this
+    (policy, block) under another optimizer variant — only the step
+    phase depends on the optimizer, so the sweep reuses the rest."""
+    res = {"batch": spec["batch"], "preset": spec.get("preset"),
+           "cfg": spec.get("cfg", {}),
+           "optimizer": "fused" if spec.get("fused") else "optax"}
+    for p in PHASES:
+        if p != "step" and p + "_s" in (seed_timings or {}):
+            res[p + "_s"] = seed_timings[p + "_s"]
+    phases = [p for p in phases if p + "_s" not in res]
+    for phase in phases:
         proc = subprocess.run(
-            [sys.executable, __file__, "--one", phase, str(batch),
-             cfg_json], capture_output=True, text=True, timeout=1200)
+            [sys.executable, __file__, "--one",
+             json.dumps({**spec, "phase": phase})],
+            capture_output=True, text=True, timeout=1200)
         lines = [ln for ln in proc.stdout.splitlines()
                  if ln.startswith("{")]
         if proc.returncode == 0 and lines:
@@ -122,26 +205,118 @@ def main():
             err = (proc.stderr or "").strip().splitlines()
             res[phase + "_err"] = err[-1][:120] if err else proc.returncode
         print(json.dumps(res), flush=True)
-    if "step_s" in res:
-        from ray_tpu.models import llama
-        import jax
 
-        cfg = llama.LlamaConfig.llama_440m(**res["cfg"])
-        n = llama.param_count(jax.eval_shape(
-            lambda: llama.init_params(jax.random.key(0), cfg)))
-        toks = batch * 2047
+    n = _n_params(spec)
+    toks = spec["batch"] * (spec.get("seq", 2048) - 1)
+    res["model_params"] = n
+
+    def tfs(flops_per_tok, seconds):
+        return round(toks * flops_per_tok / seconds / 1e12, 1)
+
+    # 2N fwd / 4N bwd / 6N whole-step flops per token (dense-LM
+    # approximation, same convention as bench.py's mfu field).
+    if "fwd_s" in res:
+        res["fwd_tflops_per_s"] = tfs(2 * n, res["fwd_s"])
+    if "fwd_s" in res and "grad_s" in res:
+        res["bwd_s"] = round(res["grad_s"] - res["fwd_s"], 4)
+        if res["bwd_s"] > 0:
+            res["bwd_tflops_per_s"] = tfs(4 * n, res["bwd_s"])
+        res["bwd_ratio"] = round(res["grad_s"] / res["fwd_s"], 2)
+    if "step_s" in res:
         res["tok_per_s"] = round(toks / res["step_s"], 1)
-        res["mfu_6n"] = round(toks / res["step_s"] * 6 * n / PEAK, 4)
+        res["step_tflops_per_s"] = tfs(6 * n, res["step_s"])
         if "grad_s" in res:
-            res["opt_overhead_s"] = round(res["step_s"] - res["grad_s"], 4)
-        if "fwd_s" in res and "grad_s" in res:
-            res["bwd_ratio"] = round(res["grad_s"] / res["fwd_s"], 2)
-        print(json.dumps(res), flush=True)
+            res["opt_s"] = round(res["step_s"] - res["grad_s"], 4)
+            res["opt_pct_of_step"] = round(
+                100.0 * res["opt_s"] / res["step_s"], 1)
+    if peak:
+        res["peak_tflops_per_s"] = round(peak / 1e12, 1)
+        for key in ("fwd", "bwd", "step"):
+            if key + "_tflops_per_s" in res:
+                res[key + "_roofline_pct"] = round(
+                    100.0 * res[key + "_tflops_per_s"] * 1e12 / peak, 1)
+        if "step_s" in res:
+            res["mfu_6n"] = round(toks / res["step_s"] * 6 * n / peak, 4)
+    print(json.dumps(res), flush=True)
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--preset", default="llama_440m")
+    ap.add_argument("--cfg", default="{}",
+                    help="extra LlamaConfig overrides (JSON)")
+    # Mirrors models.llama.REMAT_POLICIES (not imported here: the
+    # sweep parent must stay jax-free so phase subprocesses own the
+    # TPU); tests/test_models.py asserts the two stay in sync.
+    ap.add_argument("--remat-policy", action="append", default=[],
+                    choices=("full", "dots", "dots_saveable", "attn",
+                             "attn_ffn"),
+                    help="sweep value (repeatable)")
+    ap.add_argument("--attn-block", action="append", default=[],
+                    help="sweep value (repeatable): BQ or BQ,BK flash "
+                         "tile sizes")
+    ap.add_argument("--optimizer", choices=("optax", "fused", "both"),
+                    default="fused",
+                    help="optimizer variant for the step phase")
+    ap.add_argument("--phases", default=",".join(PHASES))
+    # Legacy positional compatibility: profile_mfu.py [batch] [cfg].
+    ap.add_argument("legacy", nargs="*", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.legacy:
+        args.batch = int(args.legacy[0])
+        if len(args.legacy) > 1:
+            args.cfg = args.legacy[1]
+
+    base_cfg = json.loads(args.cfg)
+    phases = [p for p in args.phases.split(",") if p]
+    peak, kind = _peak_flops()
+    print(json.dumps({"device_kind": kind,
+                      "peak_tflops_per_s":
+                      round(peak / 1e12, 1) if peak else None}),
+          flush=True)
+
+    policies = args.remat_policy or [None]
+    blocks = args.attn_block or [None]
+    opts = {"optax": [False], "fused": [True],
+            "both": [False, True]}[args.optimizer]
+    results = []
+    for policy, block in itertools.product(policies, blocks):
+        cfg = dict(base_cfg)
+        if policy is not None:
+            cfg["remat_policy"] = policy
+        if block is not None:
+            parts = [int(x) for x in str(block).split(",")]
+            cfg["attn_block_q"] = parts[0]
+            cfg["attn_block_k"] = parts[-1]
+        # Only the step phase depends on the optimizer variant — the
+        # first variant measures everything, the rest reuse its
+        # optimizer-independent timings and re-run just "step".
+        seed = None
+        for fused in opts:
+            spec = {"batch": args.batch, "seq": args.seq,
+                    "preset": args.preset, "cfg": cfg, "fused": fused}
+            res = run_config(spec, phases, peak, seed_timings=seed)
+            results.append(res)
+            seed = res
+
+    done = [r for r in results if "tok_per_s" in r]
+    if len(done) > 1:
+        win = max(done, key=lambda r: r["tok_per_s"])
+        print(json.dumps({
+            "winner": {"cfg": win["cfg"], "optimizer": win["optimizer"],
+                       "tok_per_s": win["tok_per_s"],
+                       "mfu_6n": win.get("mfu_6n")},
+            "note": "record this configuration as the preset default",
+        }), flush=True)
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
-        run_one(sys.argv[2], int(sys.argv[3]),
-                json.loads(sys.argv[4]) if len(sys.argv) > 4 else {})
+        run_one(json.loads(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--device-info":
+        _device_info()
     else:
         main()

@@ -70,6 +70,12 @@ class LlamaConfig:
     # across adjacent layers (fewer loop-carried DUS/sequencing
     # overheads) at the cost of compile time.
     scan_unroll: int = 1
+    # Flash-attention tile sizes (None = kernel default, currently
+    # 1024).  Exposed as a config knob so the MFU sweep
+    # (profile_mfu.py --attn-block) can tune them per chip/shape and
+    # the winner can be recorded on the preset.
+    attn_block_q: Optional[int] = None
+    attn_block_k: Optional[int] = None
     # >0 enables REAL pipeline parallelism when the active mesh has a
     # pipe axis of size >1: the layer stack runs as a GPipe microbatch
     # schedule over pipe stages (parallel/pipeline.py) instead of one
@@ -276,14 +282,28 @@ def param_count(params: PyTree) -> int:
 # Building blocks
 # ---------------------------------------------------------------------------
 
+REMAT_POLICIES = ("full", "dots", "dots_saveable", "attn", "attn_ffn")
+
+
 def _remat_policy(config: LlamaConfig):
     """Checkpoint policy for the per-layer remat wrapper (see
-    LlamaConfig.remat_policy)."""
-    if config.remat_policy == "attn":
+    LlamaConfig.remat_policy).  "attn_ffn" additionally saves the
+    FFN activation ``silu(gate)*up`` next to the flash residuals —
+    backward skips recomputing the two up-projection matmuls at
+    +intermediate_size bf16/token of residual memory (the next sweep
+    point past "attn" when HBM headroom allows; profile_mfu.py
+    --remat-policy compares them)."""
+    if config.remat_policy not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {config.remat_policy!r} "
+            f"(choose from {REMAT_POLICIES})")
+    if config.remat_policy in ("attn", "attn_ffn"):
         from ray_tpu.ops.flash_attention import FLASH_RESIDUAL_NAMES
 
-        return jax.checkpoint_policies.save_only_these_names(
-            *FLASH_RESIDUAL_NAMES)
+        names = FLASH_RESIDUAL_NAMES
+        if config.remat_policy == "attn_ffn":
+            names = names + ("ffn_act",)
+        return jax.checkpoint_policies.save_only_these_names(*names)
     return {
         "full": jax.checkpoint_policies.nothing_saveable,
         "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
@@ -361,13 +381,22 @@ def dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(B, S, Hq, D)
 
 
-def _get_attention_fn(impl: str) -> Callable:
+def _get_attention_fn(config) -> Callable:
+    """Resolve a config (or bare impl name) to the attention callable.
+    For the flash path the config's ``attn_block_q``/``attn_block_k``
+    tile sizes are bound in (the MFU sweep's tuning knob)."""
+    impl = config if isinstance(config, str) else config.attention_impl
     if impl == "dot":
         return dot_attention
     try:
         if impl == "flash":
             from ray_tpu.ops.flash_attention import flash_attention_causal
-            return flash_attention_causal
+            if isinstance(config, str):
+                return flash_attention_causal
+            return functools.partial(
+                flash_attention_causal,
+                block_q=config.attn_block_q,
+                block_k=config.attn_block_k)
         if impl == "ring":
             from ray_tpu.ops.ring_attention import ring_attention_causal
             return ring_attention_causal
@@ -412,7 +441,11 @@ def _attn_out_mlp(x: jax.Array, attn: jax.Array,
     h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
     gate = matmul(h, layer["w_gate"].astype(dt))
     up = matmul(h, layer["w_up"].astype(dt))
-    ff = jax.nn.silu(gate) * up
+    # Named so the "attn_ffn" remat policy can save it (inert under
+    # every other policy and outside jax.checkpoint).
+    from jax.ad_checkpoint import checkpoint_name
+
+    ff = checkpoint_name(jax.nn.silu(gate) * up, "ffn_act")
     ff = with_logical_constraint(ff, "batch", "seq", "mlp")
     x = x + matmul(ff, layer["w_down"].astype(dt))
     return with_logical_constraint(x, "batch", "seq", None)
@@ -483,7 +516,7 @@ def forward(params: PyTree, tokens: jax.Array, config: LlamaConfig,
     if positions is None:
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
-    attention_fn = _get_attention_fn(c.attention_impl)
+    attention_fn = _get_attention_fn(c)
 
     # ZeRO-3 semantics for the lookup: all-gather the fsdp-sharded
     # embed dim of the table BEFORE the gather.  Without this the
@@ -625,29 +658,70 @@ def default_optimizer(learning_rate: float = 3e-4):
 
 
 def init_train_state(rng: jax.Array, config: LlamaConfig,
-                     optimizer=None) -> Dict[str, Any]:
-    if optimizer is None:
-        optimizer = default_optimizer()
+                     optimizer=None,
+                     fused: bool = False) -> Dict[str, Any]:
+    """``fused=True`` pairs with ``make_train_step(fused=True)``: the
+    opt_state is a ``FusedAdamWState`` instead of the optax chain
+    tuple (same logical contents — count + two moment trees)."""
     params = init_params(rng, config)
+    if fused:
+        if optimizer is not None:
+            raise ValueError("fused=True replaces the optax chain; "
+                             "pass hyperparameters, not an optimizer")
+        from ray_tpu.train.optim import fused_adamw_init
+
+        opt_state = fused_adamw_init(params)
+    else:
+        if optimizer is None:
+            optimizer = default_optimizer()
+        opt_state = optimizer.init(params)
     return {
         "params": params,
-        "opt_state": optimizer.init(params),
+        "opt_state": opt_state,
         "step": jnp.zeros((), jnp.int32),
     }
 
 
 def make_train_step(config: LlamaConfig, optimizer=None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True, fused: bool = False,
+                    learning_rate: float = 3e-4) -> Callable:
     """Returns jitted ``train_step(state, batch) -> (state, metrics)``.
 
     Grad accumulation/clipping live in the optax chain; the step is a
     single XLA program — gradient psums over data/fsdp axes are inserted
     by the compiler from the shardings (no hand-written allreduce).
-    """
+
+    ``fused=True`` replaces the optax chain with the single-pass fused
+    AdamW (``train/optim.py``): identical hyperparameters and clip
+    semantics as ``default_optimizer()``, ~6 tree passes fewer of
+    param-sized HBM traffic in the optimizer slice of the step (the
+    ``profile_mfu.py`` ``opt_overhead_s`` phase measures it).  Loss
+    parity with the optax step is a tier-1 gate."""
     import optax
 
+    if fused:
+        if optimizer is not None:
+            raise ValueError("fused=True replaces the optax chain; "
+                             "pass hyperparameters, not an optimizer")
+        from ray_tpu.train.optim import (fused_adamw_update,
+                                         fused_hyperparams)
+
+        hp = fused_hyperparams(learning_rate)
+
+        def step(state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state["params"], batch, config)
+            params, opt_state, gnorm = fused_adamw_update(
+                grads, state["opt_state"], state["params"], **hp)
+            new_state = {"params": params, "opt_state": opt_state,
+                         "step": state["step"] + 1}
+            return new_state, {"loss": loss, "grad_norm": gnorm,
+                               "step": new_state["step"]}
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
     if optimizer is None:
-        optimizer = default_optimizer()
+        optimizer = default_optimizer(learning_rate)
 
     def step(state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch,
@@ -682,8 +756,9 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
 
 
 def init_paged_kv_cache(config: LlamaConfig, num_blocks: int,
-                        block_size: int,
-                        dtype: Any = None) -> Dict[str, jax.Array]:
+                        block_size: int, dtype: Any = None,
+                        kv_quant: Optional[str] = None
+                        ) -> Dict[str, jax.Array]:
     """Block-pool KV cache for paged attention (vLLM SOSP '23 shape):
     ``(num_blocks, L, block_size, Hkv, D)`` per tensor.  BLOCK-major —
     one block's K (or V) across all layers is a single contiguous
@@ -692,12 +767,61 @@ def init_paged_kv_cache(config: LlamaConfig, num_blocks: int,
     gathering.  Block 0 is reserved as the null/padding block: block
     tables pad with it, attention masks whatever it holds, and
     scatter-back writes land there harmlessly.  Memory scales with
-    ``num_blocks`` (live tokens), not ``max_slots × max_len``."""
+    ``num_blocks`` (live tokens), not ``max_slots × max_len``.
+
+    ``kv_quant`` ("int8"/"fp8", serve/kv_cache.KV_QUANT_FORMATS)
+    stores blocks reduced-precision with one f32 scale per KV ROW —
+    (block, layer, position, kv_head), ``k_scale``/``v_scale`` shaped
+    ``(num_blocks, L, block_size, Hkv)`` — nearly halving the bytes
+    per token (values drop 2 bytes → 1, scales add 4/head_dim), which
+    the serving plane converts into ~2x the blocks (and therefore
+    decode batch width) on the same pool budget.  The decode programs
+    dequantize on gather and requantize on scatter
+    (``quantize_kv_blocks``/``dequantize_kv_blocks``)."""
     c = config
-    dt = dtype or c.dtype
     shape = (num_blocks, c.n_layers, block_size, c.n_kv_heads,
              c.head_dim)
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if kv_quant is None:
+        dt = dtype or c.dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    from ray_tpu.serve.kv_cache import kv_quant_info
+
+    fmt = kv_quant_info(kv_quant)
+    qdt = jnp.dtype(fmt.dtype_name)
+    sshape = (num_blocks, c.n_layers, block_size, c.n_kv_heads)
+    return {"k": jnp.zeros(shape, qdt), "v": jnp.zeros(shape, qdt),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32)}
+
+
+def quantize_kv_blocks(x: jax.Array, qmax: float,
+                       qdtype: Any) -> Tuple[jax.Array, jax.Array]:
+    """Per-(block, layer, position, head) symmetric quantization of KV
+    block updates.  x: (N, L, bs, Hkv, D) full precision; returns
+    (stored (N, L, bs, Hkv, D) qdtype, scale (N, L, bs, Hkv) f32)
+    with ``stored * scale ≈ x``.  One scale per KV ROW (amax over
+    head_dim only): rope rotates K rows through position-dependent
+    dynamic ranges, so row granularity cuts the error a further ~2-4x
+    over per-block-per-head scales for 4/head_dim ≈ 3% extra bytes.
+    The amax element maps exactly onto ``±qmax``, which makes
+    dequantize→requantize a FIXED POINT: the decode loop re-scatters
+    every gathered block each chunk (including untouched COW prefix
+    blocks), and without that idempotence shared blocks would drift a
+    little every chunk."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=4)
+    scale = jnp.maximum(amax, 1e-30) / qmax
+    q = xf / scale[..., None]
+    if jnp.issubdtype(jnp.dtype(qdtype), jnp.integer):
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    return q.astype(qdtype), scale
+
+
+def dequantize_kv_blocks(stored: jax.Array, scale: jax.Array,
+                         out_dtype: Any) -> jax.Array:
+    """Inverse of :func:`quantize_kv_blocks` (same block layout)."""
+    return (stored.astype(jnp.float32)
+            * scale[..., None]).astype(out_dtype)
 
 
 def prefill_forward(params: PyTree, tokens: jax.Array,

@@ -84,7 +84,7 @@ def _run_layers(x: jax.Array, layers: PyTree, config: LlamaConfig):
     sin, cos = rope_table(positions, c.head_dim, c.rope_theta)
     block = functools.partial(
         decoder_layer, sin=sin, cos=cos, positions=positions, config=c,
-        attention_fn=_get_attention_fn(c.attention_impl))
+        attention_fn=_get_attention_fn(c))
     if c.remat:
         from .llama import _remat_policy
 

@@ -367,6 +367,20 @@ def kv_cache_counters():
             "ray_tpu_kv_handoff_total",
             "prefill->decode KV handoffs completed, by transport",
             tag_keys=("transport",)),
+        "pool_bytes": Gauge(
+            "ray_tpu_kv_pool_bytes",
+            "device bytes held by the paged KV pool (quantized pools "
+            "include their per-block scale tensors)",
+            tag_keys=("pool", "dtype")),
+        "spec_proposed": Counter(
+            "ray_tpu_spec_decode_proposed_tokens",
+            "draft-model tokens proposed to the verifier",
+            tag_keys=("deployment",)),
+        "spec_accepted": Counter(
+            "ray_tpu_spec_decode_accepted_tokens",
+            "proposed tokens the target model verified and emitted "
+            "(accept rate = accepted / proposed)",
+            tag_keys=("deployment",)),
     })
 
 

@@ -538,11 +538,16 @@ def _einsum_fallback(q, k, v, causal):
     return out.reshape(B, Sq, Hq, D)
 
 
-def flash_attention_causal(q, k, v, positions=None):
+def flash_attention_causal(q, k, v, positions=None,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None):
     """Drop-in for models.llama.dot_attention (standard causal layout;
-    packed/offset positions must use the dot path)."""
+    packed/offset positions must use the dot path).  ``block_q``/
+    ``block_k`` override the kernel tile sizes (LlamaConfig
+    ``attn_block_q``/``attn_block_k``, swept by profile_mfu.py)."""
     _check_default_positions(positions, q.shape[1], "flash_attention_causal")
-    return flash_attention(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True, block_q=block_q,
+                           block_k=block_k)
 
 
 def _check_default_positions(positions, seq_len, name):

@@ -37,6 +37,7 @@ eviction's free can't interleave.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -44,8 +45,71 @@ from ..exceptions import BackPressureError
 
 # Block 0 is the NULL block: never allocated, used as the gather/
 # scatter sink for block-table padding (padding gathers garbage that
-# attention masks out; padding scatters land here and are never read).
+# attention masks out; padding scatters land there and are never read).
 NULL_BLOCK = 0
+
+
+# ---------------------------------------------------------------------------
+# Quantized block formats
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class KVQuantFormat:
+    """One reduced-precision KV block layout.
+
+    Blocks store ``dtype_name`` values plus ONE float32 scale per KV
+    ROW — (block, layer, position, kv_head) — mapping that row's amax
+    onto ``qmax``, so dequantization is ``stored * scale``.  Row (not
+    block-wide) scaling matters: K rows after rope sweep
+    position-dependent dynamic ranges that differ by >10x across
+    heads and positions, and a single block-wide scale wastes most of
+    the 8-bit grid on the loudest row.  The scale tensor is
+    ``num_blocks x L x block_size x Hkv`` f32 = ``4/head_dim`` of the
+    stored bytes (~3% at head_dim 128), counted by the capacity math
+    below.
+    """
+
+    name: str
+    dtype_name: str  # resolvable via jnp, e.g. "int8"/"float8_e4m3fn"
+    qmax: float      # the value amax maps to (127 int8, 448 e4m3)
+    itemsize: int    # bytes per stored element
+
+
+KV_QUANT_FORMATS: Dict[str, KVQuantFormat] = {
+    "int8": KVQuantFormat("int8", "int8", 127.0, 1),
+    "fp8": KVQuantFormat("fp8", "float8_e4m3fn", 448.0, 1),
+}
+
+
+def kv_quant_info(name: Optional[str]) -> Optional[KVQuantFormat]:
+    """Resolve a quant-format name (None → full-precision pool)."""
+    if name is None:
+        return None
+    fmt = KV_QUANT_FORMATS.get(name)
+    if fmt is None:
+        raise ValueError(
+            f"unknown kv_quant {name!r} "
+            f"(choose from {sorted(KV_QUANT_FORMATS)})")
+    return fmt
+
+
+def blocks_for_bytes(pool_bytes: int, n_layers: int, block_size: int,
+                     n_kv_heads: int, head_dim: int,
+                     kv_quant: Optional[str] = None,
+                     dtype_bytes: int = 2) -> int:
+    """How many usable blocks a byte budget buys (the capacity math
+    behind the quantized-KV bench: same pool bytes, int8 blocks carry
+    ~2x the tokens bf16 blocks do).  Counts K+V and, for quantized
+    formats, the per-row (block, layer, position, head) f32
+    scales."""
+    fmt = kv_quant_info(kv_quant)
+    per_elem = fmt.itemsize if fmt else dtype_bytes
+    block_bytes = 2 * n_layers * block_size * n_kv_heads * head_dim \
+        * per_elem
+    if fmt:
+        # Per-row f32 scales: 4/head_dim of the stored bytes.
+        block_bytes += 2 * n_layers * block_size * n_kv_heads * 4
+    return max(0, int(pool_bytes) // block_bytes)
 
 
 def _kv_metrics():
@@ -249,6 +313,25 @@ class BlockTable:
         if need > 0:
             self.blocks.extend(
                 self.allocator.alloc(need, owner=self.owner))
+
+    def trim(self, num_tokens: int) -> int:
+        """Speculative-decode rollback: release owned tail blocks past
+        what ``num_tokens`` ACCEPTED positions need.  A verify pass
+        grows the table for the full k-token proposal; rejected
+        suffixes must hand those blocks straight back so pool pressure
+        reflects only accepted tokens.  Never trims into the COW
+        prefix (``num_shared`` blocks are forked references whose
+        positions are part of the prompt).  Returns blocks released
+        back to the allocator's refcounting (not necessarily freed —
+        the prefix cache may still hold them)."""
+        bs = self.allocator.block_size
+        keep = max((num_tokens + bs - 1) // bs, self.num_shared)
+        if keep >= len(self.blocks):
+            return 0
+        tail = self.blocks[keep:]
+        del self.blocks[keep:]
+        self.allocator.free(tail, owner=self.owner)
+        return len(tail)
 
     def release(self) -> None:
         """Return every reference this table holds (idempotent: the

@@ -54,6 +54,19 @@ whose per-call host↔device round trip is tens of milliseconds:
   striped object plane — ``serve/kv_transfer.py``), so decode replicas
   never stall behind long prompts.  ``role="both"`` (default) serves
   end-to-end.
+- QUANTIZED KV BLOCKS (``kv_quant="int8"|"fp8"``): the paged pool
+  stores reduced-precision values with one f32 scale per KV row;
+  gather dequantizes, every write path requantizes (amax↦±qmax makes
+  the round trip idempotent).  Same pool bytes carry ~2x the blocks
+  and therefore batch width — docs/serving.md has the layout table
+  and capacity math.
+- SPECULATIVE DECODING (``spec_k > 0``): a cheap draft (layer-
+  truncated self-draft or a separate preset) proposes k greedy
+  tokens; the target verifies all of them in ONE batched pass riding
+  the same block-count buckets; the host emits the longest verified
+  prefix + the target's correction.  Greedy-exact; rejected-suffix
+  blocks return via ``BlockTable.trim``; EDF admission/preemption
+  semantics unchanged (docs/serving.md: accept-rate model).
 - Params are cast to the compute dtype once at init; all prefill
   shapes and decode buckets are compiled at init (warmup=True) so no
   request ever pays a compile.
@@ -170,7 +183,27 @@ class LLMServer:
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  role: str = "both",
                  serve_deployment: Optional[str] = None,
-                 prefill_groups: Optional[Tuple[int, ...]] = None):
+                 prefill_groups: Optional[Tuple[int, ...]] = None,
+                 kv_quant: Optional[str] = None,
+                 spec_k: int = 0,
+                 draft_preset: Optional[str] = None,
+                 draft_layers: Optional[int] = None,
+                 draft_params=None):
+        """``kv_quant``: "int8"/"fp8" stores paged KV blocks reduced-
+        precision with per-row (block, layer, position, head) scales —
+        same pool
+        bytes carry ~2x the blocks (serve/kv_cache.KV_QUANT_FORMATS).
+
+        ``spec_k > 0`` enables SPECULATIVE DECODING (paged plane,
+        role="both" only): a cheap draft proposes ``spec_k`` greedy
+        tokens per round and the target model verifies them in ONE
+        batched pass riding the block-bucketed programs — output
+        tokens stay bit-identical to plain greedy decode.  The draft
+        is either ``draft_preset`` (its own weights; pass
+        ``draft_params`` for a trained draft) or — default — a
+        LAYER-TRUNCATED SELF-DRAFT: the target's first
+        ``draft_layers`` layers + its own norm/head (zero extra
+        weights, Draft&Verify-style early exit)."""
         import jax
         import jax.numpy as jnp
 
@@ -181,6 +214,16 @@ class LLMServer:
         if role != "both" and not paged:
             raise ValueError("prefill/decode disaggregation requires "
                              "the paged KV plane (paged=True)")
+        self.spec_k = max(0, int(spec_k))
+        if self.spec_k:
+            if not paged:
+                raise ValueError("speculative decoding rides the paged "
+                                 "KV plane (paged=True)")
+            if role != "both":
+                raise ValueError(
+                    "speculative decoding requires role='both' (the "
+                    "draft cache cannot be handed off between "
+                    "disaggregated replicas)")
         preset = getattr(llama.LlamaConfig, model_preset)
         self.cfg = preset(max_seq_len=max_len)
         self.max_slots = max_slots
@@ -221,12 +264,19 @@ class LLMServer:
         # override token lands.
         self.slot_waiting = np.zeros(max_slots, bool)
 
+        self.kv_quant = kv_quant
         if self.paged:
             self._init_paged(block_size, num_blocks, llama, jax, jnp)
         else:
+            if kv_quant is not None:
+                raise ValueError("kv_quant requires the paged KV "
+                                 "plane (paged=True)")
             self.cache = llama.init_kv_cache(self.cfg, max_slots,
                                              max_len)
             self._build_dense(llama, jax, jnp)
+        if self.spec_k:
+            self._init_draft(draft_preset, draft_layers, draft_params,
+                             seed, llama, jax, jnp)
 
         self._jnp = jnp
         # Device-resident carries between chunk launches.
@@ -303,14 +353,16 @@ class LLMServer:
                                  static_argnames=("k", "s_active"))
 
     def _make_decode_step(self, params, key_pos, active, llama, jax,
-                          jnp):
+                          jnp, cfg=None):
         """The shared per-token decode step (scan body): masked-select
         K/V write at each slot's current position, bucketed cache
         attention, greedy argmax fed back in-graph.  IDENTICAL math for
         the dense slice and the paged gathered layout — block ordering
         makes gathered index == absolute position, which is what keeps
-        the two planes' tokens bit-identical."""
-        cfg = self.cfg
+        the two planes' tokens bit-identical.  ``cfg`` overrides the
+        target config (the speculative DRAFT model reuses this step on
+        its own dense cache)."""
+        cfg = cfg or self.cfg
 
         def step(carry, _):
             ck, cv, tok, lens = carry
@@ -355,13 +407,17 @@ class LLMServer:
 
     # ------------------------------------------------------- paged plane
     def _init_paged(self, block_size, num_blocks, llama, jax, jnp):
-        from .kv_cache import KVBlockAllocator, PrefixCache
+        from .kv_cache import (KVBlockAllocator, PrefixCache,
+                               kv_quant_info)
 
         cfg = self.cfg
         bs = int(block_size)
         if bs < 1:
             raise ValueError("block_size must be >= 1")
         self.block_size = bs
+        fmt = kv_quant_info(self.kv_quant)
+        self._kv_fmt = fmt
+        qdt = jnp.dtype(fmt.dtype_name) if fmt else None
         max_blocks_per_req = -(-self.max_len // bs)
         if num_blocks is None:
             # Capacity parity with the dense plane by default; size it
@@ -378,7 +434,9 @@ class LLMServer:
             pool_label=self._deployment or "llm")
         self.prefix_cache = PrefixCache(self.allocator)
         self.slot_table: List[Optional[Any]] = [None] * self.max_slots
-        self.pool = llama.init_paged_kv_cache(cfg, self.num_blocks, bs)
+        self.pool = llama.init_paged_kv_cache(
+            cfg, self.num_blocks, bs, kv_quant=self.kv_quant)
+        self._publish_pool_bytes()
         # Block-count buckets: the paged analogue of the dense
         # attended-prefix buckets (one decode compile per bucket).
         self._nb_buckets = tuple(sorted(
@@ -386,7 +444,7 @@ class LLMServer:
         # Warm-prefill prefix buckets: one static gather width.
         self._np_max = max(1, (max(self.buckets) - 1) // bs)
 
-        def gather(pool_t, bt):
+        def gather_raw(pool_t, bt):
             N, L, bsz, Hkv, D = pool_t.shape
             B, nb = bt.shape
             g = jnp.take(pool_t, bt.reshape(-1), axis=0, mode="clip")
@@ -394,13 +452,48 @@ class LLMServer:
             return g.transpose(2, 0, 1, 3, 4, 5).reshape(
                 L, B, nb * bsz, Hkv, D)
 
-        def scatter(pool_t, bt, g):
-            N, L, bsz, Hkv, D = pool_t.shape
+        def gather(pool, name, bt):
+            """Gathered compute-dtype blocks (L, B, nb*bs, Hkv, D);
+            quantized pools dequantize here (stored * per-block-head
+            scale), so everything downstream of the gather is
+            plane-agnostic."""
+            g = gather_raw(pool[name], bt)
+            if fmt is None:
+                return g
             B, nb = bt.shape
-            u = g.reshape(L, B, nb, bsz, Hkv, D).transpose(
-                1, 2, 0, 3, 4, 5)
-            return pool_t.at[bt.reshape(-1)].set(
-                u.reshape(B * nb, L, bsz, Hkv, D), mode="drop")
+            s = jnp.take(pool[name + "_scale"], bt.reshape(-1), axis=0,
+                         mode="clip")               # (B*nb, L, bs, Hkv)
+            L, Hkv = s.shape[1], s.shape[3]
+            s = s.reshape(B, nb, L, bs, Hkv).transpose(
+                2, 0, 1, 3, 4).reshape(L, B, nb * bs, Hkv)
+            return (g.astype(jnp.float32)
+                    * s[..., None]).astype(cfg.dtype)
+
+        def set_blocks(pool, name, flat, updates):
+            """Store block updates ((M, L, bs, Hkv, D), compute dtype)
+            at ``flat`` indices; quantized pools quantize on the way in
+            (scale written next to the block)."""
+            if fmt is None:
+                return {name: pool[name].at[flat].set(
+                    updates.astype(pool[name].dtype), mode="drop")}
+            q, sc = llama.quantize_kv_blocks(updates, fmt.qmax, qdt)
+            return {
+                name: pool[name].at[flat].set(q, mode="drop"),
+                name + "_scale": pool[name + "_scale"].at[flat].set(
+                    sc, mode="drop"),
+            }
+
+        def scatter(pool, name, bt, g):
+            L = pool[name].shape[1]
+            B, nb = bt.shape
+            u = g.reshape(L, B, nb, bs, -1,
+                          cfg.head_dim).transpose(1, 2, 0, 3, 4, 5)
+            return set_blocks(pool, name, bt.reshape(-1),
+                              u.reshape(B * nb, L, bs, -1,
+                                        cfg.head_dim))
+
+        self._gather_kv = gather
+        self._set_kv_blocks = set_blocks
 
         def rows_to_blocks(rows, nw):
             # (L, G, Ppad, H, D) -> (G*nw, L, bs, H, D) scatter updates
@@ -425,10 +518,11 @@ class LLMServer:
             nw = write_bt.shape[1]
             flat = write_bt.reshape(-1)
             pool = {
-                "k": pool["k"].at[flat].set(
-                    rows_to_blocks(pad_rows(ks, nw), nw), mode="drop"),
-                "v": pool["v"].at[flat].set(
-                    rows_to_blocks(pad_rows(vs, nw), nw), mode="drop"),
+                **pool,
+                **set_blocks(pool, "k", flat,
+                             rows_to_blocks(pad_rows(ks, nw), nw)),
+                **set_blocks(pool, "v", flat,
+                             rows_to_blocks(pad_rows(vs, nw), nw)),
             }
             first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
             return pool, first
@@ -446,8 +540,8 @@ class LLMServer:
             sin, cos = llama.rope_table(positions, cfg.head_dim,
                                         cfg.rope_theta)
             x = params["embed_tokens"].astype(dt)[tokens]
-            ckp = gather(pool["k"], prefix_bt)
-            cvp = gather(pool["v"], prefix_bt)
+            ckp = gather(pool, "k", prefix_bt)
+            cvp = gather(pool, "v", prefix_bt)
             prefix_pos = jnp.arange(Sp, dtype=jnp.int32)
             key_abs = jnp.concatenate(
                 [jnp.broadcast_to(prefix_pos[None, :], (G, Sp)),
@@ -483,10 +577,11 @@ class LLMServer:
             nw = write_bt.shape[1]
             flat = write_bt.reshape(-1)
             pool = {
-                "k": pool["k"].at[flat].set(
-                    rows_to_blocks(pad_rows(ks, nw), nw), mode="drop"),
-                "v": pool["v"].at[flat].set(
-                    rows_to_blocks(pad_rows(vs, nw), nw), mode="drop"),
+                **pool,
+                **set_blocks(pool, "k", flat,
+                             rows_to_blocks(pad_rows(ks, nw), nw)),
+                **set_blocks(pool, "v", flat,
+                             rows_to_blocks(pad_rows(vs, nw), nw)),
             }
             return pool, first
 
@@ -495,26 +590,177 @@ class LLMServer:
             tok = jnp.where(ov_mask, ov_tok, tok_dev)
             lens = jnp.where(ov_mask, ov_len, len_dev)
             nb = bt.shape[1]
-            ck = gather(pool["k"], bt)
-            cv = gather(pool["v"], bt)
+            ck = gather(pool, "k", bt)
+            cv = gather(pool, "v", bt)
             key_pos = jnp.arange(nb * bs, dtype=jnp.int32)
             step = self._make_decode_step(params, key_pos, active,
                                           llama, jax, jnp)
             (ck, cv, tok, lens), toks = jax.lax.scan(
                 step, (ck, cv, tok, lens), None, length=k)
-            pool = {"k": scatter(pool["k"], bt, ck),
-                    "v": scatter(pool["v"], bt, cv)}
+            pool = {**pool, **scatter(pool, "k", bt, ck),
+                    **scatter(pool, "v", bt, cv)}
             return pool, toks, tok, lens
 
         def inject(pool, kb, vb, dest):
-            return {"k": pool["k"].at[dest].set(kb, mode="drop"),
-                    "v": pool["v"].at[dest].set(vb, mode="drop")}
+            # Handoff blocks arrive FULL PRECISION (the prefill side
+            # dequantizes on extract), so quantized and bf16 engines
+            # interoperate across a disaggregated pair.
+            return {**pool, **set_blocks(pool, "k", dest, kb),
+                    **set_blocks(pool, "v", dest, vb)}
+
+        def spec_verify(params, pool, tokens, positions, active, bt):
+            """Target-model verification of a draft proposal: T tokens
+            per slot in ONE pass over the gathered block layout.
+            tokens/positions: (B, T) — [last accepted, d1..d_{T-1}] at
+            absolute positions; returns the target's greedy token for
+            positions+1 (B, T) and writes the inputs' K/V at their
+            positions (gathered index == absolute position, same
+            invariant as the decode step — which is what keeps spec
+            output bit-identical to plain greedy decode)."""
+            dt = cfg.dtype
+            S = bt.shape[1] * bs
+            ck = gather(pool, "k", bt)
+            cv = gather(pool, "v", bt)
+            x = params["embed_tokens"].astype(dt)[tokens]
+            sin, cos = llama.rope_table(positions, cfg.head_dim,
+                                        cfg.rope_theta)
+            key_pos = jnp.arange(S, dtype=jnp.int32)
+            onehot = ((key_pos[None, None, :]
+                       == positions[:, :, None])
+                      & active[:, None, None])            # (B, T, S)
+            written = onehot.any(axis=1)[:, :, None, None]
+            proj = onehot.astype(dt)
+            scale = cfg.head_dim ** -0.5
+
+            def body(x, layer_and_cache):
+                layer, ck_l, cv_l = layer_and_cache
+                q, kk, vv = llama._qkv_rope(x, layer, sin, cos, cfg)
+                # One-hot projection places the T fresh rows at their
+                # absolute positions (like insert_prefill, scatters
+                # would serialize on TPU).
+                up_k = jnp.einsum("bts,bthd->bshd", proj, kk)
+                up_v = jnp.einsum("bts,bthd->bshd", proj, vv)
+                ck_l = jnp.where(written, up_k.astype(ck_l.dtype),
+                                 ck_l)
+                cv_l = jnp.where(written, up_v.astype(cv_l.dtype),
+                                 cv_l)
+                attn = llama._cache_attend(q, ck_l, cv_l, positions,
+                                           scale)
+                x = llama._attn_out_mlp(x, attn, layer, cfg)
+                return x, (ck_l, cv_l)
+
+            x, (ck, cv) = jax.lax.scan(lambda x, i: body(x, i), x,
+                                       (params["layers"], ck, cv))
+            x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+            head = (params["embed_tokens"].astype(dt).T
+                    if cfg.tie_embeddings
+                    else params["lm_head"].astype(dt))
+            logits = llama.matmul(x, head)
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            pool = {**pool, **scatter(pool, "k", bt, ck),
+                    **scatter(pool, "v", bt, cv)}
+            return pool, toks
 
         self._prefill_cold = jax.jit(prefill_cold, donate_argnums=(1,))
         self._prefill_warm = jax.jit(prefill_warm, donate_argnums=(1,))
         self._decode_paged = jax.jit(decode_paged, donate_argnums=(1,),
                                      static_argnames=("k",))
         self._inject = jax.jit(inject, donate_argnums=(0,))
+        self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
+
+    def _publish_pool_bytes(self) -> None:
+        try:
+            from ..observability.metrics import kv_cache_counters
+
+            nbytes = sum(int(x.size) * x.dtype.itemsize
+                         for x in self.pool.values())
+            kv_cache_counters()["pool_bytes"].set(
+                nbytes, tags={"pool": self._deployment or "llm",
+                              "dtype": self.kv_quant or "bf16"})
+        except Exception:
+            pass
+
+    # -------------------------------------------------- draft plane (spec)
+    def _init_draft(self, draft_preset, draft_layers, draft_params,
+                    seed, llama, jax, jnp):
+        """Build the speculative draft: its config/params, a DENSE
+        per-slot KV cache (the draft is small — paging it buys
+        nothing), and the propose/prefill programs.  The draft rides
+        the SAME decode-step math as the dense plane, so its cache
+        bookkeeping inherits the write-before-attend invariant."""
+        import dataclasses
+
+        cfg = self.cfg
+        if draft_preset is not None:
+            dpreset = getattr(llama.LlamaConfig, draft_preset)
+            dcfg = dpreset(max_seq_len=self.max_len)
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}: proposals must share the "
+                    f"token space")
+            if draft_params is None:
+                draft_params = llama.init_params(
+                    jax.random.key(seed + 1), dcfg)
+            dparams = jax.tree.map(
+                lambda x: x.astype(dcfg.dtype)
+                if x.dtype == jnp.float32 else x, draft_params)
+        else:
+            # Layer-truncated self-draft: the target's first n layers
+            # + its own norm/head.  Zero extra weights, and the shared
+            # residual stream keeps draft/target argmaxes correlated
+            # even for untrained params (the accept-rate floor the
+            # bench relies on).
+            n = draft_layers or max(1, cfg.n_layers // 4)
+            if not 0 < n < cfg.n_layers:
+                raise ValueError(
+                    f"draft_layers={n} must be in [1, "
+                    f"{cfg.n_layers - 1}]")
+            dcfg = dataclasses.replace(cfg, n_layers=n)
+            dparams = {
+                "embed_tokens": self.params["embed_tokens"],
+                "layers": jax.tree.map(lambda x: x[:n],
+                                       self.params["layers"]),
+                "final_norm": self.params["final_norm"],
+            }
+            if not cfg.tie_embeddings:
+                dparams["lm_head"] = self.params["lm_head"]
+        self.draft_cfg = dcfg
+        self.draft_params = dparams
+        self.draft_cache = llama.init_kv_cache(dcfg, self.max_slots,
+                                               self.max_len)
+        # Accept-rate accounting (host truth for kv_stats/bench).
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_tok_ema: Optional[float] = None
+
+        def draft_prefill(params, cache, tokens, lengths, slots):
+            _logits, ks, vs = llama.prefill_forward(params, tokens,
+                                                    lengths, dcfg)
+            return llama.insert_prefill(cache, ks, vs, slots)
+
+        def draft_propose(params, cache, tok, pos, active, k,
+                          s_active):
+            ck = jax.lax.slice_in_dim(cache["k"], 0, s_active, axis=2)
+            cv = jax.lax.slice_in_dim(cache["v"], 0, s_active, axis=2)
+            key_pos = jnp.arange(s_active, dtype=jnp.int32)
+            step = self._make_decode_step(params, key_pos, active,
+                                          llama, jax, jnp, cfg=dcfg)
+            (ck, cv, tok, pos), toks = jax.lax.scan(
+                step, (ck, cv, tok, pos), None, length=k)
+            cache = {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    cache["k"], ck, 0, axis=2),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    cache["v"], cv, 0, axis=2),
+            }
+            return cache, toks
+
+        self._draft_prefill = jax.jit(draft_prefill,
+                                      donate_argnums=(1,))
+        self._draft_propose = jax.jit(
+            draft_propose, donate_argnums=(1,),
+            static_argnames=("k", "s_active"))
 
     # ------------------------------------------------------------ warmup
     def _warmup(self):
@@ -543,6 +789,10 @@ class LLMServer:
                     slots = jnp.full(g, -1, jnp.int32)  # writes nothing
                     self.cache, _first = self._prefill(
                         self.params, self.cache, toks, lengths, slots)
+                if self.spec_k:
+                    self.draft_cache = self._draft_prefill(
+                        self.draft_params, self.draft_cache, toks,
+                        lengths, jnp.full(g, -1, jnp.int32))
         active = jnp.zeros(self.max_slots, bool)  # no-op decode
         ov = jnp.zeros(self.max_slots, jnp.int32)
         ovm = jnp.zeros(self.max_slots, bool)
@@ -550,15 +800,32 @@ class LLMServer:
             for nb in self._nb_buckets:
                 bt = jnp.full((self.max_slots, nb), self._pad_block,
                               jnp.int32)
-                self.pool, _t, self._tok_dev, self._len_dev = \
-                    self._decode_paged(
-                        self.params, self.pool, self._tok_dev,
-                        self._len_dev, ov, ov, ovm, active, bt,
-                        k=self.decode_chunk)
-                kb = jnp.zeros((nb,) + self.pool["k"].shape[1:],
-                               self.pool["k"].dtype)
+                if self.spec_k:
+                    # The spec scheduler replaces decode chunks with
+                    # verify passes — warm those per bucket instead.
+                    self.pool, _t = self._spec_verify(
+                        self.params, self.pool,
+                        jnp.zeros((self.max_slots, self.spec_k),
+                                  jnp.int32),
+                        jnp.zeros((self.max_slots, self.spec_k),
+                                  jnp.int32), active, bt)
+                else:
+                    self.pool, _t, self._tok_dev, self._len_dev = \
+                        self._decode_paged(
+                            self.params, self.pool, self._tok_dev,
+                            self._len_dev, ov, ov, ovm, active, bt,
+                            k=self.decode_chunk)
+                kb = jnp.zeros(
+                    (nb, self.cfg.n_layers, self.block_size,
+                     self.cfg.n_kv_heads, self.cfg.head_dim),
+                    self.cfg.dtype)
                 dest = jnp.full(nb, self._pad_block, jnp.int32)
                 self.pool = self._inject(self.pool, kb, kb, dest)
+            if self.spec_k:
+                for sa in self.decode_buckets:
+                    self.draft_cache, _t = self._draft_propose(
+                        self.draft_params, self.draft_cache, ov, ov,
+                        active, k=self.spec_k, s_active=int(sa))
             jax.block_until_ready(self.pool["k"])
         else:
             for sa in self.decode_buckets:
@@ -691,7 +958,13 @@ class LLMServer:
         if self._chunk_ema is None:
             return None
         prefill = self._prefill_ema or self._chunk_ema
-        chunks = -(-req.max_new_tokens // self.decode_chunk)
+        if self.spec_k:
+            # Chunk EMA measures one draft+verify round; tokens per
+            # round vary with the accept rate, so divide by its EMA.
+            per_round = max(1.0, self._spec_tok_ema or 1.0)
+            chunks = -(-req.max_new_tokens // int(per_round))
+        else:
+            chunks = -(-req.max_new_tokens // self.decode_chunk)
         return prefill + chunks * self._chunk_ema
 
     def _admission_pass(self):
@@ -937,6 +1210,28 @@ class LLMServer:
             self.pool, first = self._prefill_cold(
                 self.params, self.pool, jnp.asarray(toks),
                 jnp.asarray(lens), jnp.asarray(write_bt))
+        if self.spec_k:
+            # The draft always prefills the FULL prompt (its dense
+            # cache is per-slot; prefix-cache hits only skip TARGET
+            # compute) — so a warm target group still drafts cold.
+            # _bucket(full P) cannot raise here: generate() rejects
+            # prompts longer than the largest bucket at ingress, and
+            # spec engines refuse decode_ingest (the only prompt path
+            # that bypasses that guard).
+            fb = self._bucket(max(len(req.prompt)
+                                  for _s, req, _p in group))
+            dtoks = np.zeros((g, fb), np.int32)
+            dlens = np.ones(g, np.int32)
+            dslots = np.full(g, -1, np.int32)
+            for j, (slot, req, _pos0) in enumerate(group):
+                P = len(req.prompt)
+                dtoks[j, :P] = req.prompt
+                dlens[j] = P
+                dslots[j] = slot
+            self.draft_cache = self._draft_prefill(
+                self.draft_params, self.draft_cache,
+                jnp.asarray(dtoks), jnp.asarray(dlens),
+                jnp.asarray(dslots))
         self._pending_prefills.append((first, members, t0))
 
     def _harvest_prefills(self):
@@ -982,8 +1277,21 @@ class LLMServer:
         jnp = self._jnp
         n = -(-len(req.prompt) // self.block_size)
         idx = jnp.asarray(np.asarray(table.blocks[:n], np.int32))
-        req.kv = (np.asarray(jnp.take(self.pool["k"], idx, axis=0)),
-                  np.asarray(jnp.take(self.pool["v"], idx, axis=0)))
+        kb = jnp.take(self.pool["k"], idx, axis=0)
+        vb = jnp.take(self.pool["v"], idx, axis=0)
+        if self._kv_fmt is not None:
+            # Handoffs travel FULL PRECISION so a quantized prefill
+            # replica can feed a bf16 decode replica (and vice versa);
+            # the ingest side requantizes on inject.
+            from ray_tpu.models import llama
+
+            kb = llama.dequantize_kv_blocks(
+                kb, jnp.take(self.pool["k_scale"], idx, axis=0),
+                self.cfg.dtype)
+            vb = llama.dequantize_kv_blocks(
+                vb, jnp.take(self.pool["v_scale"], idx, axis=0),
+                self.cfg.dtype)
+        req.kv = (np.asarray(kb), np.asarray(vb))
 
     def _finish(self, slot: int):
         req = self.slot_req[slot]
@@ -1047,6 +1355,8 @@ class LLMServer:
             req.finish_notify()
 
     def _loop(self):
+        if self.spec_k:
+            return self._loop_spec()
         pending = None  # (toks_device, [(slot, req)], k, t0) in flight
         try:
             while not self._stop.is_set():
@@ -1073,6 +1383,149 @@ class LLMServer:
         except BaseException as e:  # noqa: BLE001
             self._fatal(e)
 
+    def _loop_spec(self):
+        """Speculative scheduler: same iteration-level EDF admission,
+        but each iteration is a SYNCHRONOUS draft+verify round (the
+        next round's inputs depend on this round's host-side
+        accept/reject decision, so the one-deep pipeline does not
+        apply — the round itself already amortizes the device
+        round-trip over up to spec_k tokens × batch width)."""
+        try:
+            while not self._stop.is_set():
+                self._admit_wave()
+                self._harvest_prefills()
+                did = self._spec_round()
+                if not did and not any(
+                        r is not None for r in self.slot_req) \
+                        and not self._backlog:
+                    try:
+                        self._backlog.append(
+                            self._queue.get(timeout=0.05))
+                    except queue.Empty:
+                        pass
+        except BaseException as e:  # noqa: BLE001
+            self._fatal(e)
+
+    def _slot_ctx(self, req: _Request) -> int:
+        return len(req.prompt) + len(req.tokens)
+
+    def _spec_round(self) -> bool:
+        """One accept/rollback iteration: draft proposes ``spec_k``
+        greedy tokens per active slot (k in-graph steps of the small
+        model), the target verifies ALL proposals in one batched pass
+        over the block-gathered layout, and the host emits the longest
+        matching prefix plus — on a mismatch — the target's own
+        correction token.  Emitted tokens are greedy-exact by
+        induction: every target argmax is computed from a context of
+        already-verified tokens (see docs/serving.md for the
+        near-tie-vs-fusion caveat the gates encode).  Rejected suffixes
+        hand their freshly grown blocks straight back
+        (``BlockTable.trim``), so pool pressure tracks ACCEPTED
+        tokens only."""
+        jnp = self._jnp
+        k = self.spec_k
+        snapshot, active = self._active_snapshot()
+        while snapshot and not self._grow_tables(snapshot, spec=True):
+            snapshot, active = self._active_snapshot()
+        if not snapshot:
+            return False
+        try:
+            from ..observability.metrics import kv_cache_counters
+
+            kv_cache_counters()["batch_occupancy"].set(
+                len(snapshot),
+                tags={"deployment": self._deployment or "llm"})
+        except Exception:
+            pass
+        B = self.max_slots
+        tok = np.zeros(B, np.int32)
+        pos = np.zeros(B, np.int32)
+        high = 1
+        for s, req, _l in snapshot:
+            tok[s] = req.tokens[-1]
+            pos[s] = self._slot_ctx(req) - 1
+            high = max(high, int(pos[s]) + k + 1)
+        t0 = time.perf_counter()
+        sa = next((b for b in self.decode_buckets if high <= b),
+                  self.decode_buckets[-1])
+        self.draft_cache, dts = self._draft_propose(
+            self.draft_params, self.draft_cache, jnp.asarray(tok),
+            jnp.asarray(pos), jnp.asarray(active), k=int(k),
+            s_active=int(sa))
+        dtoks = np.asarray(dts)  # (k, B): d1..dk per slot
+        # Verify inputs: [last accepted, d1..d_{k-1}] — outputs are
+        # the target's tokens for positions pos+1..pos+k, lining up
+        # 1:1 with the k proposals.  (No Leviathan "bonus" token: the
+        # draft cache would be left with an unprocessed-position gap.)
+        vtoks = np.zeros((B, k), np.int32)
+        vpos = np.zeros((B, k), np.int32)
+        for s, _req, _l in snapshot:
+            vtoks[s, 0] = tok[s]
+            if k > 1:
+                vtoks[s, 1:] = dtoks[:k - 1, s]
+            vpos[s] = pos[s] + np.arange(k, dtype=np.int32)
+        nb = self._nb_bucket(max(
+            len(self.slot_table[s]) for s, _r, _l in snapshot))
+        bt = np.full((B, nb), self._pad_block, np.int32)
+        for s, _req, _l in snapshot:
+            blocks = self.slot_table[s].blocks[:nb]
+            bt[s, :len(blocks)] = blocks
+        self.pool, g_dev = self._spec_verify(
+            self.params, self.pool, jnp.asarray(vtoks),
+            jnp.asarray(vpos), jnp.asarray(active), jnp.asarray(bt))
+        g = np.asarray(g_dev)  # (B, k) target tokens for pos+1..pos+k
+        now = time.perf_counter()
+        dt = now - t0
+        self._chunk_ema = (dt if self._chunk_ema is None
+                           else 0.8 * self._chunk_ema + 0.2 * dt)
+        proposed = accepted = emitted_total = 0
+        for s, req, _l in snapshot:
+            if self.slot_req[s] is not req or req.done:
+                continue
+            a = 0
+            while a < k and int(dtoks[a, s]) == int(g[s, a]):
+                a += 1
+            proposed += k
+            accepted += a
+            emit = [int(x) for x in dtoks[:a, s]]
+            if a < k:
+                emit.append(int(g[s, a]))
+            finished = False
+            for t_tok in emit:
+                req.tokens.append(t_tok)
+                emitted_total += 1
+                if (len(req.tokens) >= req.max_new_tokens
+                        or self._slot_ctx(req) >= self.max_len - 1):
+                    finished = True
+                    break
+            if finished:
+                self._finish(s)
+            else:
+                ctx = self._slot_ctx(req)
+                self.slot_table[s].trim(ctx)
+                self.slot_len[s] = ctx
+        per_slot = emitted_total / max(1, len(snapshot))
+        self._spec_tok_ema = (per_slot if self._spec_tok_ema is None
+                              else 0.8 * self._spec_tok_ema
+                              + 0.2 * per_slot)
+        self._count_spec(proposed, accepted)
+        return True
+
+    def _count_spec(self, proposed: int, accepted: int) -> None:
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        if not proposed:
+            return
+        try:
+            from ..observability.metrics import kv_cache_counters
+
+            m = kv_cache_counters()
+            tags = {"deployment": self._deployment or "llm"}
+            m["spec_proposed"].inc(proposed, tags=tags)
+            m["spec_accepted"].inc(accepted, tags=tags)
+        except Exception:
+            pass
+
     def _active_snapshot(self):
         snapshot = []  # (slot, req, len_at_launch)
         active = np.zeros(self.max_slots, bool)
@@ -1083,11 +1536,13 @@ class LLMServer:
                 snapshot.append((s, req, int(self.slot_len[s])))
         return snapshot, active
 
-    def _grow_tables(self, snapshot) -> bool:
+    def _grow_tables(self, snapshot, spec: bool = False) -> bool:
         """Ensure every active slot's table covers this chunk's writes;
         preempt latest-deadline requests under pool pressure.  Returns
-        False when the snapshot changed (caller re-snapshots)."""
-        k = self.decode_chunk
+        False when the snapshot changed (caller re-snapshots).
+        ``spec``: size for one verify pass (inputs at positions
+        ctx-1 .. ctx+spec_k-2) instead of a decode chunk."""
+        k = self.spec_k if spec else self.decode_chunk
         for s, req, _len0 in snapshot:
             while True:
                 try:
@@ -1097,8 +1552,13 @@ class LLMServer:
                     # kept step will touch (writes beyond the table
                     # drop, reads stay under lens), so growing for
                     # them would over-allocate one block per request.
+                    if spec:
+                        base = (len(req.prompt) + len(req.tokens)
+                                + k - 1)
+                    else:
+                        base = int(self.slot_len[s]) + k
                     self.slot_table[s].ensure(min(
-                        int(self.slot_len[s]) + k, self.max_len,
+                        base, self.max_len,
                         len(req.prompt) + req.max_new_tokens))
                     break
                 except BackPressureError as e:
@@ -1248,6 +1708,11 @@ class LLMServer:
 
         if self.role == "prefill":
             raise RuntimeError("prefill-role replica cannot ingest")
+        if self.spec_k:
+            raise RuntimeError(
+                "speculative-decoding engine cannot ingest "
+                "disaggregated handoffs (the draft cache has no K/V "
+                "for the handed-off prompt)")
         with self._kv_lock:
             if self._kv_receiver is None:
                 self._kv_receiver = KVReceiver()
@@ -1379,12 +1844,23 @@ class LLMServer:
         from ..observability.metrics import metrics_summary
 
         out = {k: v for k, v in metrics_summary().items()
-               if k.startswith(("ray_tpu_kv_", "ray_tpu_prefix_"))}
+               if k.startswith(("ray_tpu_kv_", "ray_tpu_prefix_",
+                                "ray_tpu_spec_"))}
         if self.paged:
             out["allocator"] = {
                 "used": self.allocator.used_blocks,
                 "free": self.allocator.free_blocks,
                 "prefix_blocks": self.prefix_cache.num_blocks,
+            }
+            out["kv_quant"] = self.kv_quant
+        if self.spec_k:
+            out["spec"] = {
+                "k": self.spec_k,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "accept_rate": round(
+                    self._spec_accepted / self._spec_proposed, 4)
+                if self._spec_proposed else None,
             }
         return out
 
@@ -1437,7 +1913,9 @@ class LLMServer:
             pass
 
     def __del__(self):
-        self._stop.set()
+        stop = getattr(self, "_stop", None)  # init may have raised
+        if stop is not None:
+            stop.set()
 
 
 def _masked_attend(q, keys, vals, q_pos, key_abs, key_valid, scale,

@@ -11,6 +11,8 @@ Public surface mirrors ray.train:
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import (CheckpointConfig, FailureConfig, Result, RunConfig,
                      ScalingConfig)
+from .optim import (FusedAdamWState, fused_adamw_init,
+                    fused_adamw_update)
 from .session import (allreduce_gradients, get_checkpoint,
                       get_collective_group, get_context,
                       get_dataset_shard, make_temp_checkpoint_dir,
@@ -34,4 +36,7 @@ __all__ = [
     "make_temp_checkpoint_dir",
     "allreduce_gradients",
     "get_collective_group",
+    "FusedAdamWState",
+    "fused_adamw_init",
+    "fused_adamw_update",
 ]

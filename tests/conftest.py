@@ -79,6 +79,48 @@ def _chaos_hang_guard(request):
         signal.signal(signal.SIGALRM, old)
 
 
+_BOX_FACTOR = None
+
+
+def box_speed_factor() -> float:
+    """Measured per-run capacity probe for the box-speed-sensitive
+    tests (disagg flat-TTFT soak, dag perf comparison, vcluster
+    smoke): one small single-thread compute loop plus a burst of
+    thread round-trips, compared against the reference fast box.
+    Returns >= 1.0 (1.0 = reference speed or better, clamped at 8x);
+    perf-sensitive bars SCALE their absolute constants by it so a
+    loaded 1-core CI container passes the same assertions a fast box
+    does, instead of each test carrying hand-tuned slack.
+
+    Measured once per pytest run (module cache): probing inside each
+    test would itself be load-sensitive noise."""
+    global _BOX_FACTOR
+    if _BOX_FACTOR is None:
+        import threading
+        import time
+
+        import numpy as np
+
+        best = float("inf")
+        for _ in range(2):  # best-of-2: absorb one scheduling hiccup
+            a = np.random.default_rng(0).standard_normal((256, 256))
+            t0 = time.perf_counter()
+            for _ in range(30):
+                a = np.tanh(a @ a.T * 1e-3)
+            for _ in range(100):
+                ev = threading.Event()
+                threading.Thread(target=ev.set).start()
+                ev.wait()
+            best = min(best, time.perf_counter() - t0)
+        _BOX_FACTOR = min(8.0, max(1.0, best / 0.02))
+    return _BOX_FACTOR
+
+
+@pytest.fixture
+def box_factor() -> float:
+    return box_speed_factor()
+
+
 @pytest.fixture
 def ray_start_regular():
     """Fresh runtime per test (reference: conftest.py:463)."""

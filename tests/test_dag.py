@@ -311,7 +311,7 @@ def test_compiled_dag_channel_beats_object_plane_cross_process(
         def step(self, x):
             return x
 
-    def run(n=60, **opts):
+    def build(**opts):
         payload = np.zeros(16384, dtype=np.float32)  # 64 KiB
         with InputNode() as inp:
             a = Stage.options(resources={"n0": 1}).bind()
@@ -324,22 +324,34 @@ def test_compiled_dag_channel_beats_object_plane_cross_process(
         assert np.array_equal(out, payload)
         for _ in range(10):
             ray_tpu.get(compiled.execute(payload))
+        return compiled, payload
+
+    def one_pass(compiled, payload):
         t0 = time.perf_counter()
-        for _ in range(n):
-            ray_tpu.get(compiled.execute(payload))
-        dt = time.perf_counter() - t0
-        compiled.teardown()
-        return dt / n
+        ray_tpu.get(compiled.execute(payload))
+        return time.perf_counter() - t0
 
     try:
-        chan = run()
-        plane = run(channel_transport=False)
-        # Loose margin: the channel path must be at least parity on a
-        # noisy CI box; typical is 1.5-2x faster (measured 10.7ms vs
-        # 19.1ms per pass).
-        assert chan < plane * 1.05, \
-            f"channel {chan*1e6:.0f}us not faster than plane " \
-            f"{plane*1e6:.0f}us"
+        # PAIRED ADJACENT passes (the obs-overhead bench's deflake
+        # pattern): both planes stay live and alternate pass-for-pass,
+        # so box-load drift between two sequential timed phases — the
+        # box-speed flake class this test used to be in — cancels out
+        # of the per-pair ratio.  Trimmed median of ratios, not a
+        # ratio of sums: one descheduled pass can't swing the verdict.
+        chan_c, payload = build()
+        plane_c, _ = build(channel_transport=False)
+        ratios = sorted(
+            one_pass(chan_c, payload) / one_pass(plane_c, payload)
+            for _ in range(40))
+        chan_c.teardown()
+        plane_c.teardown()
+        trimmed = ratios[4:-4]
+        median = trimmed[len(trimmed) // 2]
+        # Parity bar with a small margin; typical is 1.5-2x faster
+        # (measured 10.7ms vs 19.1ms per pass).
+        assert median < 1.05, \
+            f"channel/plane per-pass ratio {median:.2f} " \
+            f"(pairs {ratios[0]:.2f}..{ratios[-1]:.2f})"
     finally:
         ray_tpu.shutdown()
         c.shutdown()

@@ -122,15 +122,22 @@ class TestFlatTTFTSoak:
 
     # A deliberately capacity-limited decode engine (one slot, short
     # chunks, long generations) so the pytest-side driver can actually
-    # saturate it: ~9 req/s on CI hardware.  The budget sits within
-    # the latency-sensitive band, so queueing beyond ~one service time
-    # sheds at admission — the mechanism under test.
+    # saturate it.  The WORKLOAD is calibrated against the measured
+    # per-run capacity probe instead of absolute constants (the
+    # box-speed flake class PRs 10/12 flagged — a fixed deadline/count
+    # pair is simultaneously too tight for a loaded 1-core container,
+    # where service time balloons and everything sheds, and too loose
+    # for a fast box, where 2x of a ~28 req/s plane never builds a
+    # 1.5 s backlog and NOTHING sheds): the request budget is a fixed
+    # multiple of the measured per-request service time, and the 2x
+    # phase runs long enough that its queueing delay provably exceeds
+    # that budget — so overload sheds on every box, at 1x-like
+    # admitted latency, by construction.
     _MAX_NEW = 48
-    _DEADLINE_S = 1.5
     _ENGINE_OVERRIDE = dict(max_slots=1, decode_chunk=4,
                             prefill_groups=(4,))
 
-    def _drive(self, handle, n, interval_s):
+    def _drive(self, handle, n, interval_s, deadline_s):
         """Submit n requests at a fixed offered rate; returns
         (ttfts_of_admitted_ms, typed_shed_count)."""
         results = []
@@ -142,7 +149,7 @@ class TestFlatTTFTSoak:
                 out = handle.generate.remote({
                     "prompt": [(i * 7 + j) % 97 + 1 for j in range(8)],
                     "max_new_tokens": self._MAX_NEW,
-                    "deadline_s": self._DEADLINE_S,
+                    "deadline_s": deadline_s,
                 }).result(timeout=60)
                 results.append(out["ttft_ms"])
             except (DeadlineExceededError, BackPressureError):
@@ -160,7 +167,8 @@ class TestFlatTTFTSoak:
         assert not untyped, untyped[:3]
         return results, len(errors)
 
-    def test_flat_ttft_at_2x_saturation(self, serve_session):
+    def test_flat_ttft_at_2x_saturation(self, serve_session,
+                                        box_factor):
         """Also carries the same-host transport acceptance (one
         deployment cycle instead of two): every handoff in this test
         rides the PR 1 shm ring, asserted from the decode replica's
@@ -205,19 +213,31 @@ class TestFlatTTFTSoak:
             cap_rps = max(cap_rps,
                           n_cal / (time.perf_counter() - t0))
 
-        n = 40
-        ttfts_1x, shed_1x = self._drive(handle, n, 1.0 / cap_rps)
+        # Capacity-calibrated workload: budget = 8 measured service
+        # times (generous at 1x on a serial 1-slot plane), and n sized
+        # so the 2x phase's terminal backlog delay (n/2 requests at
+        # cap_rps) is >= 2 budgets — overload MUST shed, yet admitted
+        # requests keep 1x-like latency, on any box speed.
+        deadline_s = min(4.0, max(0.5, 8.0 / cap_rps))
+        n = min(200, max(40, int(4 * deadline_s * cap_rps) + 1))
+        ttfts_1x, shed_1x = self._drive(handle, n, 1.0 / cap_rps,
+                                        deadline_s)
         ttfts_2x, shed_2x = self._drive(handle, n,
-                                        1.0 / (2 * cap_rps))
+                                        1.0 / (2 * cap_rps),
+                                        deadline_s)
         assert len(ttfts_1x) >= n * 0.5, (len(ttfts_1x), shed_1x)
         assert len(ttfts_2x) >= 5, "everything was shed at 2x"
         p99_1x = sorted(ttfts_1x)[int(len(ttfts_1x) * 0.99) - 1]
         p99_2x = sorted(ttfts_2x)[int(len(ttfts_2x) * 0.99) - 1]
         # The flat-TTFT bar: early typed shedding keeps the ADMITTED
-        # stream at 1x-like latency (80 ms absolute floor so ms-scale
-        # CI noise can't fail a healthy run).
-        assert p99_2x <= max(1.2 * p99_1x, p99_1x + 80.0), \
-            (p99_1x, p99_2x, shed_2x)
+        # stream at 1x-like latency.  The absolute floor (80 ms on the
+        # reference box, so ms-scale noise can't fail a healthy run)
+        # scales with the measured box-speed probe: a loaded 1-core
+        # container's scheduling jitter alone exceeds a fast box's
+        # whole floor.
+        assert p99_2x <= max(1.2 * p99_1x,
+                             p99_1x + 80.0 * box_factor), \
+            (p99_1x, p99_2x, shed_2x, box_factor)
         # 2x offered load over a saturated plane MUST shed — and
         # everything it shed was typed (asserted inside _drive).
         assert shed_2x > 0, (len(ttfts_2x), p99_1x, p99_2x)

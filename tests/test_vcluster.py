@@ -12,6 +12,7 @@ import time
 
 import pytest
 
+from conftest import box_speed_factor
 from ray_tpu.experimental import chaos
 from tools.vcluster import VCluster
 
@@ -40,8 +41,16 @@ def test_vcluster_smoke_kill_head_mid_load(vcluster):
     """25 virtual nodes, mixed load, head kill -9 + restart mid-load:
     every acked mutation survives, the fleet reconverges, and no
     stale-epoch write lands.  Fast enough for tier-1 — the 300-node
-    version below is the stress soak."""
-    vc = vcluster(25)
+    version below is the stress soak.
+
+    The lease TTL scales with the measured box-speed probe: on a
+    loaded 1-core container the head's subprocess restart + snapshot
+    replay can exceed a FIXED 1.5 s TTL, mass-expiring healthy nodes
+    mid-recovery — the box-speed flake class PRs 10/12 flagged.  The
+    kill/restart window stays fixed, so the scenario (dead head,
+    surviving leases, full replay) is unchanged; only the wall-clock
+    budget tracks the box."""
+    vc = vcluster(25, lease_ttl_s=1.5 * box_speed_factor())
     vc.start()
     assert vc.alive_nodes() == 25
     vc.load(3.0, threads=4)
