@@ -559,6 +559,123 @@ def _log_plane_overhead_bench(n_pairs: int = 220) -> dict:
         "log_on_roundtrip_us", "log_off_roundtrip_us", n_pairs)
 
 
+def _tsdb_bench(n_nodes: int = 3, n_flushes: int = 120,
+                n_queries: int = 50, n_pairs: int = 120) -> dict:
+    """Metrics TSDB phases: ``metrics_query_us`` (end-to-end RPC
+    latency of a windowed p99 + rate query against ingested history)
+    and ``tsdb_ingest_overhead_pct`` (the paired-adjacent-trimmed-
+    median method of the ``*_overhead_pct`` phases, applied at the
+    ingest boundary: push_events with the TSDB enabled vs disabled —
+    guard < 5%)."""
+    import time as _time
+
+    from ray_tpu.cluster.head import HeadServer
+    from ray_tpu.cluster.rpc import RpcClient
+    from ray_tpu.observability import tsdb as tsdb_mod
+
+    def snapshot(node: str, n: int, ts: float) -> dict:
+        # Shaped like a real export_state: a tagged counter family, a
+        # gauge, and a multi-bucket histogram per node.
+        return {"ts": ts, "incarnation": f"inc-{node}", "state": {
+            "bench_requests": {
+                "kind": "counter", "description": "",
+                "tag_keys": ("where",),
+                "values": {("ingress",): float(3 * n),
+                           ("dispatch",): float(2 * n)}},
+            "bench_depth": {
+                "kind": "gauge", "description": "", "tag_keys": (),
+                "values": {(): float(n % 17)}},
+            "bench_latency": {
+                "kind": "histogram", "description": "",
+                "tag_keys": (), "values": {(): 0.05 * n},
+                "boundaries": [0.001, 0.01, 0.1, 1.0, 10.0],
+                "counts": {(): [n, 4 * n, 2 * n, n, 0, 0]}},
+        }}
+
+    # A realistic flush: the metrics snapshot rides ONE RPC with the
+    # interval's timeline events + log records (the EventShipper
+    # payload shape) — that whole ingest is the denominator the
+    # overhead guard is about, not an empty ping.
+    def flush_payload(node: str, n: int, ts: float) -> dict:
+        return {
+            "node_id": node, "pid": 1,
+            "events": [{"name": "task::step", "ph": "X",
+                        "pid": f"{node}-1", "tid": "main",
+                        "ts": (ts + i * 1e-3) * 1e6, "dur": 800,
+                        "args": {"trace_id": f"t{n}-{i}"}}
+                       for i in range(150)],
+            "logs": [{"msg": f"record {i}", "levelno": 20,
+                      "level": "INFO", "logger": "bench",
+                      "created": ts} for i in range(30)],
+            "metrics": snapshot(node, n, ts), "flush_s": 1.0,
+            "dropped": 0, "logs_dropped": 0}
+
+    def push(cl, node, n, ts):
+        cl.call("push_events", flush_payload(node, n, ts))
+
+    head = HeadServer("127.0.0.1", 0)
+    cl = RpcClient(head.address)
+    try:
+        t0 = _time.time() - n_flushes
+        for i in range(n_flushes):
+            for node in range(n_nodes):
+                push(cl, f"node{node}", i, t0 + i)
+
+        # --- metrics_query_us: median over p99-from-buckets and a
+        # grouped rate (the two expensive evaluator paths).
+        exprs = ["p99(bench_latency)[60s] by (node_id)",
+                 "rate(bench_requests)[60s] by (node_id)"]
+        lat: list = []
+        for i in range(n_queries):
+            expr = exprs[i % len(exprs)]
+            q0 = _time.perf_counter()
+            out = cl.call("metrics_query", {"expr": expr})
+            lat.append((_time.perf_counter() - q0) * 1e6)
+            assert out["rows"], "bench query returned no rows"
+        lat.sort()
+
+        # --- ingest overhead: paired adjacent push_events with the
+        # TSDB toggled (head is in-process, so the module flag
+        # applies), trimmed-median per-pair ratio like the other
+        # overhead phases.
+        ratios: list = []
+        seq = n_flushes
+        now = _time.time()
+        try:
+            for i in range(n_pairs):
+                def one(on: bool) -> float:
+                    tsdb_mod.enable() if on else tsdb_mod.disable()
+                    p0 = _time.perf_counter()
+                    push(cl, "node0", seq, now + 0.001 * seq)
+                    return _time.perf_counter() - p0
+                if i % 2 == 0:
+                    on_c = one(True)
+                    seq += 1
+                    off_c = one(False)
+                else:
+                    off_c = one(False)
+                    seq += 1
+                    on_c = one(True)
+                seq += 1
+                ratios.append(on_c / off_c)
+        finally:
+            tsdb_mod.enable()
+        kept = [r for r in ratios if 0.5 <= r <= 2.0] or ratios
+        kept.sort()
+        med = kept[len(kept) // 2]
+        stats = cl.call("metrics_query", {"names": True})["stats"]
+        return {
+            "metrics_query_us": round(lat[len(lat) // 2], 1),
+            "tsdb_ingest_overhead_pct": round((med - 1.0) * 100.0, 2),
+            "tsdb_series": stats["series"],
+            "tsdb_bytes_per_sample": round(
+                stats["bytes"] / max(1, stats["ingested_samples"]), 2),
+        }
+    finally:
+        cl.close()
+        head.shutdown()
+
+
 def _broadcast_bench(size_bytes: int, n_nodes: int = 3) -> dict:
     """Push-based broadcast tree (push_manager.h:30 analogue): driver
     fans one object out to ``n_nodes`` workers; aggregate GB/s =
@@ -1246,6 +1363,12 @@ def main():
         extra.update(_log_plane_overhead_bench())
     except Exception as e:  # noqa: BLE001
         extra["log_plane_overhead_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: tsdb phase start", file=sys.stderr, flush=True)
+    try:
+        extra.update(_tsdb_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["tsdb_error"] = f"{type(e).__name__}: {e}"
 
     print("bench: overload goodput phase start", file=sys.stderr,
           flush=True)

@@ -46,6 +46,8 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..exceptions import StaleEpochError
+from ..observability import alerts as alerts_mod
+from ..observability import tsdb as tsdb_mod
 from . import journal as journal_mod
 from .replication import ReplicationSender, _repl_metrics
 from .retention import DiskRing
@@ -291,6 +293,7 @@ class HeadServer:
         # replication side-stream feeds ITS rings).
         self._events_ring: Optional[DiskRing] = None
         self._logs_ring: Optional[DiskRing] = None
+        self._metrics_ring: Optional[DiskRing] = None
         if storage_path:
             retain = int(_env_f("RAY_TPU_HEAD_RETAIN_BYTES", 32 << 20))
             if retain > 0:
@@ -298,6 +301,27 @@ class HeadServer:
                     storage_path + ".events", retain)
                 self._logs_ring = DiskRing(
                     storage_path + ".logs", retain)
+                self._metrics_ring = DiskRing(
+                    storage_path + ".metrics", retain)
+        # Metrics time-series store (observability/tsdb.py): every
+        # push_events snapshot lands here as compressed history, the
+        # metrics_query RPC answers windowed reads, and the alert
+        # loop evaluates its rules against it.  Restart recovery
+        # replays the on-disk metrics ring (same pattern as the
+        # event/log rings; a promoted standby's ring was fed by the
+        # replication side-stream, so it answers pre-failover
+        # queries).
+        self._tsdb = tsdb_mod.TSDB()
+        if self._metrics_ring is not None:
+            cutoff = time.time() - self._tsdb.retain_s
+            for rec in self._metrics_ring.scan():
+                try:
+                    if float(rec.get("ts") or 0.0) >= cutoff:
+                        self._tsdb.ingest(rec["node"], rec["state"],
+                                          rec["ts"],
+                                          rec.get("inc", ""))
+                except (KeyError, TypeError, ValueError):
+                    continue  # torn/foreign record: skip, keep rest
         if storage_path and not self._is_primary:
             # Standby: local state is stale by definition — it seeds
             # fresh from the primary below; _apply_seed folds the seed
@@ -413,6 +437,12 @@ class HeadServer:
             "cluster_timeline": self._cluster_timeline,
             "cluster_metrics": self._cluster_metrics,
             "cluster_logs": self._cluster_logs,
+            # Windowed metric history + alert plane (read surfaces:
+            # CLI `ray_tpu metrics`, dashboard /api/metrics/query +
+            # /api/alerts, tsdb.query_cluster).
+            "metrics_query": self._metrics_query,
+            "alerts_status": self._alerts_status,
+            "alert_rules": self._alert_rules,  # raylint: disable=rpc-protocol -- rule add/remove is driven by tests and ops tooling (out of package); the read surfaces ride metrics_query/alerts_status
             # Replicated-head protocol (replication.py is the caller
             # for the repl_* stream; promote/repl_status/repl_control
             # are driven by tools/vcluster.py and ops tooling).
@@ -438,6 +468,16 @@ class HeadServer:
 
         self._publisher = Publisher()
         self.address = self._server.address
+        # Alert/SLO plane: declarative windowed rules evaluated over
+        # the TSDB in a head loop; transitions fan out through the
+        # "alerts" pubsub channel, a merged-timeline instant, a
+        # ray_tpu.alerts log record, and the alerts_firing gauge.
+        self._alert_eval_s = _env_f("RAY_TPU_ALERT_EVAL_S", 2.0)
+        self._alerts = alerts_mod.AlertManager(
+            self._tsdb, on_transition=self._on_alert_transition)
+        for _rule in alerts_mod.default_rules():
+            self._alerts.add_rule(_rule)
+        self._alert_thread: Optional[threading.Thread] = None
         # Actor restart machinery (reference: gcs_actor_manager.h:308
         # FSM — ALIVE → RESTARTING → ALIVE/DEAD with max_restarts).
         self._pool = ClientPool()
@@ -449,6 +489,12 @@ class HeadServer:
         self._restarter.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        if os.environ.get("RAY_TPU_ALERTS", "1").lower() not in (
+                "0", "false"):
+            self._alert_thread = threading.Thread(
+                target=self._alert_loop, daemon=True,
+                name="head-alerts")
+            self._alert_thread.start()
         self._compactor: Optional[threading.Thread] = None
         if self._log is not None:
             self._ensure_compactor()
@@ -1352,6 +1398,21 @@ class HeadServer:
             # Stamp the origin node ONCE at ingest (cheaper than every
             # worker resolving it per record on its emit path).
             r.setdefault("node", node_id)
+        # Unwrap the metrics snapshot: new shippers send
+        # {ts, incarnation, state} (metrics.export_snapshot); a bare
+        # state dict is a legacy/raw-push snapshot, stamped with
+        # arrival time and no incarnation (rate() then falls back to
+        # value-drop reset detection).
+        m = p.get("metrics")
+        m_state = m_ts = None
+        m_inc = ""
+        if isinstance(m, dict) and "incarnation" in m \
+                and isinstance(m.get("state"), dict):
+            m_state = m["state"]
+            m_ts = float(m.get("ts") or time.time())
+            m_inc = str(m["incarnation"])
+        elif m is not None:
+            m_state, m_ts = m, time.time()
         with self._events_lock:
             store = self._node_events.get(node_id)
             if store is None:
@@ -1373,8 +1434,10 @@ class HeadServer:
             meta["logs_received"] = (meta.get("logs_received", 0)
                                      + len(records))
             meta["ts"] = time.monotonic()
-            if p.get("metrics") is not None:
-                self._node_metrics[node_id] = p["metrics"]
+            if m_state is not None:
+                self._node_metrics[node_id] = m_state
+                meta["metrics_ts"] = time.monotonic()
+                meta["flush_s"] = p.get("flush_s")
         # Historical retention: every ingest also lands in the
         # size-capped disk rings next to the journal (history=True
         # queries outlive the bounded in-memory windows).
@@ -1386,6 +1449,15 @@ class HeadServer:
                 [{**e, "node": node_id} for e in events])
         if self._logs_ring is not None and records:
             self._logs_ring.append_many(records)
+        if m_state is not None:
+            # Time-series ingest + on-disk metrics ring (outside the
+            # store lock: the TSDB serializes itself, and the ring
+            # write must not stall concurrent event queries).
+            self._tsdb.ingest(node_id, m_state, m_ts, m_inc)
+            if self._metrics_ring is not None:
+                self._metrics_ring.append_many([
+                    {"node": node_id, "ts": m_ts, "inc": m_inc,
+                     "state": m_state}])
         # Observability side-stream to the standby (best-effort,
         # bounded, never blocks this ack): a promoted standby can
         # answer timeline/log queries about the pre-failover cluster.
@@ -1504,11 +1576,107 @@ class HeadServer:
         return {"events": events, "nodes": nodes, "meta": meta}
 
     def _cluster_metrics(self, _p):
-        """Latest per-node metric snapshots ({node_id: export_state}),
-        for the aggregated /metrics exposition."""
+        """Latest per-node metric snapshots ({node_id: export_state})
+        for the aggregated /metrics exposition.  STALENESS-AWARE: a
+        node whose last snapshot is older than
+        ``RAY_TPU_METRICS_STALE_FACTOR`` of its own flush interval is
+        dropped from the live exposition — a dead node's final
+        snapshot must not export as live values forever (its history
+        stays queryable through ``metrics_query``)."""
+        factor = _env_f("RAY_TPU_METRICS_STALE_FACTOR", 5.0)
+        now = time.monotonic()
+        head_pid = os.getpid()
+        hosted = False   # does a LIVE shipper cover this process?
+        out: Dict[str, Dict] = {}
         with self._events_lock:
-            return {nid: state
-                    for nid, state in self._node_metrics.items()}
+            for nid, state in self._node_metrics.items():
+                meta = self._node_event_meta.get(nid) or {}
+                ts = meta.get("metrics_ts")
+                flush_s = float(meta.get("flush_s") or 1.0)
+                if (factor > 0 and ts is not None
+                        and now - ts > factor * max(flush_s, 0.05)):
+                    continue
+                if meta.get("pid") == head_pid:
+                    hosted = True
+                out[nid] = state
+        if not hosted:
+            # Standalone head process (no EventShipper of its own —
+            # `ray_tpu start --head`): export its registry too, else
+            # the journal/lease/replication/alert series it mints are
+            # invisible to the aggregated exposition.  When the head
+            # rides the driver process, that driver's shipper already
+            # covers the shared registry.
+            from ..observability import metrics as _metrics
+
+            out["__head__"] = _metrics.export_state()
+        return out
+
+    # ------------------------------------------- metric history + alerts
+    def _metrics_query(self, p):
+        """Windowed TSDB query (read-only; standbys answer too — the
+        replication side-stream feeds their store, so a promoted
+        standby serves pre-failover history).  ``{"expr": ...}``
+        evaluates one expression; ``{"names": true}`` lists stored
+        series names + store stats instead."""
+        p = p or {}
+        if p.get("names"):
+            return {"names": self._tsdb.series_names(),
+                    "stats": self._tsdb.stats()}
+        return self._tsdb.query(p.get("expr", ""))
+
+    def _alerts_status(self, _p):
+        """Declared rules + currently pending/firing instances."""
+        return self._alerts.status()
+
+    def _alert_rules(self, p):
+        """Rule management: {"action": "add", "rule": {...}} /
+        {"action": "remove", "name": ...} / default: list."""
+        p = p or {}
+        action = p.get("action", "list")
+        if action == "add":
+            rule = alerts_mod.AlertRule.from_dict(p["rule"])
+            self._alerts.add_rule(rule)
+            return {"ok": True, "rule": rule.to_dict()}
+        if action == "remove":
+            return {"ok": self._alerts.remove_rule(p["name"])}
+        return {"rules": self._alerts.rules()}
+
+    def _alert_loop(self):
+        """Evaluate the rule set every RAY_TPU_ALERT_EVAL_S seconds.
+        Standbys and deposed primaries keep their state machines
+        quiet — after promotion the new primary's loop takes over
+        against its side-stream-fed TSDB."""
+        while not self._stop.wait(self._alert_eval_s):
+            if not self._is_primary or self._deposed:
+                continue
+            self._alerts.evaluate()
+
+    def _on_alert_transition(self, ev: Dict[str, Any]) -> None:
+        """Fan one firing/cleared transition out: pubsub channel +
+        merged-timeline instant on the head's own lane (the gauge and
+        the ray_tpu.alerts log record are emitted by AlertManager)."""
+        self._publisher.publish("alerts", dict(ev), retain=256)
+        instant = {"name": f"alert:{ev['rule']}", "ph": "i", "s": "p",
+                   "pid": f"head-{os.getpid()}", "tid": "alerts",
+                   "ts": float(ev["ts"]) * 1e6,
+                   "args": {"state": ev["state"], "value": ev["value"],
+                            "labels": ev["labels"],
+                            "threshold": ev["threshold"],
+                            "alert": True}}
+        with self._events_lock:
+            store = self._node_events.get("__head__")
+            if store is None:
+                store = self._node_events["__head__"] = self._deque(
+                    maxlen=self._events_max)
+                self._prune_event_nodes_locked(keep="__head__")
+            store.append(instant)
+            meta = self._node_event_meta.setdefault("__head__", {})
+            meta["pid"] = os.getpid()
+            meta["ts"] = time.monotonic()
+            meta["received"] = meta.get("received", 0) + 1
+        if self._events_ring is not None:
+            self._events_ring.append_many(
+                [{**instant, "node": "__head__"}])
 
     def _publish_node_death(self, node_id: str, address: str = ""):
         self._publisher.publish("node_death",
@@ -2088,13 +2256,16 @@ class HeadServer:
         self._pool.close_all()
         self._restarter.join(timeout=2.0)
         self._reaper.join(timeout=2.0)
+        if self._alert_thread is not None:
+            self._alert_thread.join(timeout=2.0)
         if self._standby_watch is not None:
             self._standby_watch.join(timeout=2.0)
         if self._compactor is not None:
             self._compactor.join(timeout=2.0)
         if self._log is not None:
             self._log.close()
-        for ring in (self._events_ring, self._logs_ring):
+        for ring in (self._events_ring, self._logs_ring,
+                     self._metrics_ring):
             if ring is not None:
                 ring.close()
 

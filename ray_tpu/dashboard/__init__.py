@@ -23,6 +23,11 @@ Endpoints:
   /api/memory       object store stats per node
   /api/logs         structured log query (?trace_id=&node=&actor=
                     &level=&since=&until=&text=&limit=)
+  /api/metrics/query  windowed TSDB query (?q=<expr>, e.g.
+                    q=p99(ray_tpu_channel_write_wait_seconds)[30s]
+                    %20by%20(node_id)); cluster mode only
+  /api/alerts       alert plane: declared rules + pending/firing
+                    instances (head alerts_status)
   /api/profile      sampling profile (?node=&duration=&thread=
                     &format=collapsed|chrome)
   /api/timeline     Chrome trace JSON (open in perfetto)
@@ -53,6 +58,7 @@ _PAGE = """<!doctype html>
 <body>
 <h1>ray_tpu dashboard</h1>
 <div id="summary"></div>
+<h2>Alerts</h2><div id="alerts"></div>
 <h2>Nodes</h2><div id="nodes"></div>
 <h2>Actors</h2><div id="actors"></div>
 <h2>Jobs</h2><div id="jobs"></div>
@@ -92,6 +98,14 @@ async function refresh() {
     document.getElementById("memory").innerHTML =
       table(Array.isArray(mem) ? mem : [mem]);
   } catch (e) { console.error(e); }
+  try {
+    const al = await fetch("/api/alerts").then(r => r.json());
+    document.getElementById("alerts").innerHTML = (al.active || [])
+      .length
+      ? table(al.active.map(a => ({rule: a.rule, state: a.state,
+          labels: a.labels, value: a.value})))
+      : `<i>none firing (${(al.rules || []).length} rules)</i>`;
+  } catch (e) { /* local mode: no alert plane */ }
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
@@ -248,6 +262,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, body, "application/json")
             if self.path == "/api/logs":
                 return self._send_json(_logs_api(params))
+            if self.path == "/api/metrics/query":
+                return self._metrics_query_api(params)
+            if self.path == "/api/alerts":
+                from ..core.runtime import get_runtime
+
+                rt = get_runtime()
+                if rt.cluster is None:
+                    return self._send_json(
+                        {"error": "alerts need cluster mode"},
+                        code=400)
+                return self._send_json(rt.cluster.head.call(
+                    "alerts_status", {}, timeout=15.0))
             if self.path == "/api/profile":
                 prof = _profile_api(params)
                 if params.get("format") == "collapsed":
@@ -270,6 +296,28 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             return self._send(500, f"{type(e).__name__}: {e}".encode(),
                               "text/plain")
+
+    def _metrics_query_api(self, params: Dict[str, str]):
+        """GET /api/metrics/query?q=<expr> — the head TSDB's windowed
+        query surface (same rows as the `ray_tpu metrics query` CLI
+        and the metrics_query RPC)."""
+        from ..core.runtime import get_runtime
+
+        expr = params.get("q") or params.get("expr") or ""
+        if not expr:
+            return self._send_json(
+                {"error": "missing ?q=<expr>"}, code=400)
+        rt = get_runtime()
+        if rt.cluster is None:
+            return self._send_json(
+                {"error": "metric history needs cluster mode "
+                          "(the TSDB lives on the head)"}, code=400)
+        try:
+            resp = rt.cluster.head.call(
+                "metrics_query", {"expr": expr}, timeout=30.0)
+        except ValueError as e:
+            return self._send_json({"error": str(e)}, code=400)
+        return self._send_json(resp)
 
     def _job_get(self, rest: str):
         """GET /api/jobs/<id> (status record) and /api/jobs/<id>/logs

@@ -1,0 +1,317 @@
+"""Declarative alert/SLO rules evaluated over the head TSDB.
+
+A rule is a windowed query expression (tsdb.py grammar), a comparison
+against a threshold, and a **for-duration**: every evaluation tick the
+head runs the expression, and a result row that breaches continuously
+for ``for_s`` seconds transitions to FIRING; a firing row that stops
+breaching (or disappears) transitions back to CLEARED.  Each
+transition fires through every observability surface at once:
+
+- the head's ``alerts`` pubsub channel (the autoscaler/ops
+  subscription surface — ``ray_tpu metrics alerts`` and the dashboard
+  read the same state via ``alerts_status``);
+- a merged-timeline instant event (``alert:<rule>`` on the head lane);
+- a ``ray_tpu.alerts`` log record (WARNING on fire, INFO on clear);
+- the ``ray_tpu_alerts_firing{rule}`` gauge (1 while firing) and the
+  ``ray_tpu_alerts_transitions_total{rule,state}`` counter.
+
+Alert instances are **per result row**: a rule grouped ``by
+(node_id)`` tracks one independent pending/firing state per node.
+The default rule set (:func:`default_rules`) covers the signals the
+stack already emits — stuck-detector snapshots, circuit-breaker
+trips, shed/backpressure rates, KV-block exhaustion, head replication
+lag.  Thresholds are env-tunable (``RAY_TPU_ALERT_<NAME>``) and the
+whole plane disables with ``RAY_TPU_ALERTS=0``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import tsdb as tsdb_mod
+
+logger = logging.getLogger("ray_tpu.alerts")
+
+
+def _alert_metrics():
+    from . import metrics as _metrics
+
+    return _metrics.metric_group("alerts", lambda: {
+        "firing": _metrics.Gauge(
+            "ray_tpu_alerts_firing",
+            "1 while the named alert rule has >= 1 firing instance",
+            tag_keys=("rule",)),
+        "transitions": _metrics.Counter(
+            "ray_tpu_alerts_transitions_total",
+            "alert state transitions (state=firing|cleared)",
+            tag_keys=("rule", "state")),
+        "eval_errors": _metrics.Counter(
+            "ray_tpu_alert_eval_errors_total",
+            "rule evaluations that raised (bad expression, "
+            "evaluator bug)", tag_keys=("rule",)),
+    })
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class AlertRule:
+    """One declarative rule: ``expr <op> threshold for for_s``."""
+
+    __slots__ = ("name", "expr", "op", "threshold", "for_s",
+                 "severity", "description", "_query")
+
+    def __init__(self, name: str, expr: str, op: str,
+                 threshold: float, for_s: float = 0.0,
+                 severity: str = "warning", description: str = ""):
+        if op not in (">", "<", ">=", "<="):
+            raise ValueError(f"bad comparison op {op!r}")
+        self.name = name
+        self.expr = expr
+        self.op = op
+        self.threshold = float(threshold)
+        self.for_s = float(for_s)
+        self.severity = severity
+        self.description = description
+        self._query = tsdb_mod.parse_query(expr)  # validates eagerly
+
+    def breaches(self, value: float) -> bool:
+        if self.op == ">":
+            return value > self.threshold
+        if self.op == "<":
+            return value < self.threshold
+        if self.op == ">=":
+            return value >= self.threshold
+        return value <= self.threshold
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "expr": self.expr, "op": self.op,
+                "threshold": self.threshold, "for_s": self.for_s,
+                "severity": self.severity,
+                "description": self.description}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AlertRule":
+        return cls(d["name"], d["expr"], d.get("op", ">"),
+                   d["threshold"], d.get("for_s", 0.0),
+                   d.get("severity", "warning"),
+                   d.get("description", ""))
+
+
+class AlertManager:
+    """Tracks per-(rule, labelset) pending/firing state across
+    evaluation ticks and emits transition events through
+    ``on_transition`` (the head wires pubsub/timeline there; gauge +
+    log record are emitted here)."""
+
+    def __init__(self, tsdb: tsdb_mod.TSDB,
+                 on_transition: Optional[
+                     Callable[[Dict[str, Any]], None]] = None,
+                 now: Callable[[], float] = time.time):
+        self._tsdb = tsdb
+        self._on_transition = on_transition
+        self._now = now
+        self._rules: Dict[str, AlertRule] = {}
+        self._lock = threading.Lock()
+        # (rule, labels-tuple) -> {"state": pending|firing,
+        #  "since": ts, "value": float, "labels": {...}}
+        self._active: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+
+    # -------------------------------------------------------- rules
+    def add_rule(self, rule: AlertRule) -> None:
+        with self._lock:
+            self._rules[rule.name] = rule
+
+    def remove_rule(self, name: str) -> bool:
+        with self._lock:
+            gone = self._rules.pop(name, None)
+            stale = [k for k in self._active if k[0] == name]
+            for k in stale:
+                self._active.pop(k)
+            if gone is not None:
+                _alert_metrics()["firing"].set(
+                    0.0, tags={"rule": name})
+            return gone is not None
+
+    def rules(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.to_dict() for r in self._rules.values()]
+
+    # --------------------------------------------------- evaluation
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """One tick: run every rule, advance state machines, emit
+        transitions.  Returns the transition events of this tick."""
+        now = self._now()
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            rules = list(self._rules.values())
+        for rule in rules:
+            try:
+                result = self._tsdb.query(rule._query, now=now)
+                rows = result["rows"]
+            except Exception:
+                _alert_metrics()["eval_errors"].inc(
+                    tags={"rule": rule.name})
+                logger.warning("alert rule %s evaluation failed",
+                               rule.name, exc_info=True)
+                continue
+            transitions.extend(self._advance(rule, rows, now))
+        for ev in transitions:
+            self._emit(ev)
+        return transitions
+
+    def _advance(self, rule: AlertRule, rows: List[Dict[str, Any]],
+                 now: float) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        seen = set()
+        with self._lock:
+            if self._rules.get(rule.name) is not rule:
+                # Removed (or replaced) while this tick's query ran:
+                # mutating state now would resurrect instances that
+                # no future tick evaluates — a firing gauge stuck at
+                # 1 forever.  remove_rule already cleaned up.
+                return out
+            for row in rows:
+                labels = row["labels"]
+                key = (rule.name, tuple(sorted(labels.items())))
+                seen.add(key)
+                st = self._active.get(key)
+                if rule.breaches(row["value"]):
+                    if st is None:
+                        st = self._active[key] = {
+                            "state": "pending", "since": now,
+                            "labels": dict(labels)}
+                    st["value"] = row["value"]
+                    if (st["state"] == "pending"
+                            and now - st["since"] >= rule.for_s):
+                        st["state"] = "firing"
+                        st["fired_at"] = now
+                        out.append(self._event(rule, st, "firing",
+                                               now))
+                else:
+                    if st is not None:
+                        st["value"] = row["value"]
+                        if st["state"] == "firing":
+                            out.append(self._event(rule, st,
+                                                   "cleared", now))
+                        self._active.pop(key)
+            # Instances whose row vanished (series aged out, node
+            # gone): a firing instance clears, a pending one drops.
+            gone = [k for k, st in self._active.items()
+                    if k[0] == rule.name and k not in seen]
+            for k in gone:
+                st = self._active.pop(k)
+                if st["state"] == "firing":
+                    out.append(self._event(rule, st, "cleared", now))
+            if any(ev["state"] == "firing" for ev in out) or any(
+                    st["state"] == "firing"
+                    for k, st in self._active.items()
+                    if k[0] == rule.name):
+                _alert_metrics()["firing"].set(
+                    1.0, tags={"rule": rule.name})
+            else:
+                _alert_metrics()["firing"].set(
+                    0.0, tags={"rule": rule.name})
+        return out
+
+    @staticmethod
+    def _event(rule: AlertRule, st: Dict[str, Any], state: str,
+               now: float) -> Dict[str, Any]:
+        return {"rule": rule.name, "state": state,
+                "labels": dict(st["labels"]),
+                "value": st.get("value"),
+                "expr": rule.expr, "op": rule.op,
+                "threshold": rule.threshold,
+                "severity": rule.severity, "ts": now}
+
+    def _emit(self, ev: Dict[str, Any]) -> None:
+        _alert_metrics()["transitions"].inc(
+            tags={"rule": ev["rule"], "state": ev["state"]})
+        log = (logger.warning if ev["state"] == "firing"
+               else logger.info)
+        log("alert %s %s labels=%s value=%s threshold=%s %s",
+            ev["rule"], ev["state"].upper(), ev["labels"],
+            ev["value"], ev["threshold"], ev["expr"])
+        if self._on_transition is not None:
+            try:
+                self._on_transition(ev)
+            except Exception:
+                logger.warning("alert transition sink failed",
+                               exc_info=True)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "rules": [r.to_dict() for r in self._rules.values()],
+                "active": [
+                    {"rule": k[0], **{kk: vv for kk, vv in st.items()
+                                      if kk != "labels"},
+                     "labels": dict(st["labels"])}
+                    for k, st in self._active.items()],
+            }
+
+
+def default_rules() -> List[AlertRule]:
+    """The shipped rule set, over signals the stack already emits.
+    Thresholds tune via ``RAY_TPU_ALERT_<NAME>`` env knobs (see
+    docs/observability.md for the reference table)."""
+    stuck_win = _env_f("RAY_TPU_ALERT_STUCK_WINDOW_S", 60.0)
+    return [
+        AlertRule(
+            "stuck-detector",
+            f"increase(ray_tpu_stuck_detector_snapshots)"
+            f"[{stuck_win:g}s] by (node_id)",
+            ">", _env_f("RAY_TPU_ALERT_STUCK_SNAPSHOTS", 0.0),
+            for_s=0.0, severity="critical",
+            description="a guarded dispatch ran STUCK_FACTOR x past "
+                        "its budget and a stack snapshot was "
+                        "captured on this node"),
+        AlertRule(
+            "breaker-tripping",
+            "increase(ray_tpu_circuit_breaker_trips)[60s] "
+            "by (deployment)",
+            ">", _env_f("RAY_TPU_ALERT_BREAKER_TRIPS", 0.0),
+            for_s=0.0, severity="warning",
+            description="serve router circuit breakers opened "
+                        "against sick replicas of this deployment"),
+        AlertRule(
+            "shed-rate",
+            "rate(ray_tpu_requests_expired_shed)[30s]",
+            ">", _env_f("RAY_TPU_ALERT_SHED_RATE", 5.0),
+            for_s=5.0, severity="warning",
+            description="deadline-expired work is being shed faster "
+                        "than the threshold (req/s, cluster-wide) — "
+                        "sustained overload"),
+        AlertRule(
+            "backpressure-rate",
+            "rate(ray_tpu_backpressure_rejections)[30s]",
+            ">", _env_f("RAY_TPU_ALERT_BACKPRESSURE_RATE", 5.0),
+            for_s=5.0, severity="warning",
+            description="typed admission-control rejections are "
+                        "sustained above threshold (req/s) — "
+                        "capacity, not a blip"),
+        AlertRule(
+            "kv-blocks-low",
+            "min_over_time(ray_tpu_kv_blocks_free)[60s] by (pool)",
+            "<", _env_f("RAY_TPU_ALERT_KV_BLOCKS_FREE_MIN", 2.0),
+            for_s=5.0, severity="warning",
+            description="a paged-KV pool is running out of free "
+                        "blocks — decode batches are about to "
+                        "preempt/shed"),
+        AlertRule(
+            "head-repl-lag",
+            "max_over_time(ray_tpu_head_repl_lag_entries)[30s]",
+            ">", _env_f("RAY_TPU_ALERT_REPL_LAG_ENTRIES", 1000.0),
+            for_s=5.0, severity="critical",
+            description="the hot standby is falling behind the "
+                        "journal stream — the async-mode loss "
+                        "window is growing"),
+    ]

@@ -97,7 +97,16 @@ class EventShipper:
                     "pid": os.getpid(),
                     "events": chunk,
                     "logs": log_chunk,
-                    "metrics": _metrics.export_state() if last else None,
+                    # Timestamped + incarnation-stamped snapshot: the
+                    # head TSDB needs both to place samples in time
+                    # and to spot counter resets across worker
+                    # restarts (metrics.export_snapshot).
+                    "metrics": (_metrics.export_snapshot()
+                                if last else None),
+                    # The head judges snapshot staleness in units of
+                    # OUR flush cadence (a node silent for N flushes
+                    # is a dead-node ghost, not a live exporter).
+                    "flush_s": self._interval,
                     "dropped": _timeline.dropped_events(),
                     "logs_dropped": _logs.dropped_records(),
                 }

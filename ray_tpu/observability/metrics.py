@@ -17,11 +17,20 @@ renderer.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _lock = threading.Lock()
 _registry: Dict[str, "_Metric"] = {}
+
+# Per-process incarnation id, minted once at import: pid alone recycles,
+# so the start time rides along.  Shipped with every metrics snapshot
+# (export_snapshot) so the head's TSDB can tell a *restarted* worker's
+# counter reset from a decrementing series — without it, a restart
+# looks like a huge negative rate() delta.
+INCARNATION = f"{os.getpid():x}-{int(time.time() * 1000) & 0xFFFFFFFF:x}"
 
 
 class _Metric:
@@ -160,6 +169,14 @@ def export_state() -> Dict[str, Dict]:
                                    for k, v in m._counts.items()}
         out[name] = entry
     return out
+
+
+def export_snapshot() -> Dict:
+    """``export_state`` wrapped with its wall-clock timestamp and this
+    process's :data:`INCARNATION` — the unit the event shipper pushes
+    and the head TSDB ingests (observability/tsdb.py)."""
+    return {"ts": time.time(), "incarnation": INCARNATION,
+            "state": export_state()}
 
 
 def render_exposition(states: Dict[Optional[str], Dict[str, Dict]]) -> str:

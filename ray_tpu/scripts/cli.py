@@ -12,6 +12,8 @@ Commands:
   client-proxy --address H:P [--port 10001]     thin-driver proxy
   list (nodes|actors|jobs|tasks|objects) ...    state listings
   timeline --address H:P -o trace.json          Chrome-trace export
+  metrics (query|names|alerts) --address H:P    windowed TSDB queries
+                                                + alert states
   memory --address H:P                          object-store stats
   job (submit|status|logs|stop|list) ...        job control
   lint [PATH] [--format json|sarif] [--changed] [--lock-graph dot|json]
@@ -290,6 +292,62 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Windowed queries over the head's metrics TSDB + the alert
+    plane (docs/observability.md has the query-language cookbook):
+
+    - ``metrics query 'p99(ray_tpu_channel_write_wait_seconds)[30s]
+      by (node_id)'`` — evaluate one expression against the shipped
+      history;
+    - ``metrics names`` — stored series names + store stats;
+    - ``metrics alerts`` — declared rules and pending/firing
+      instances."""
+    rt = _connect(args.address)
+    head = rt.cluster.head
+    if args.metrics_cmd == "query":
+        try:
+            resp = head.call("metrics_query", {"expr": args.expr},
+                             timeout=30.0)
+        except ValueError as e:
+            print(f"query error: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(resp, indent=2, default=str))
+            return 0
+        rows = resp["rows"]
+        for row in rows:
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted(row["labels"].items()))
+            print(f"{{{labels}}} {row['value']:.6g}")
+        if not rows:
+            print("(no matching series in the window)",
+                  file=sys.stderr)
+        return 0
+    if args.metrics_cmd == "names":
+        resp = head.call("metrics_query", {"names": True},
+                         timeout=30.0)
+        for name in resp["names"]:
+            print(name)
+        print(json.dumps(resp["stats"]), file=sys.stderr)
+        return 0
+    if args.metrics_cmd == "alerts":
+        resp = head.call("alerts_status", {}, timeout=30.0)
+        if args.json:
+            print(json.dumps(resp, indent=2, default=str))
+            return 0
+        for st in resp["active"]:
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted(st["labels"].items()))
+            print(f"{st['state'].upper():8s} {st['rule']} "
+                  f"{{{labels}}} value={st.get('value')}")
+        if not resp["active"]:
+            print("(no pending or firing alerts)")
+        print(f"{len(resp['rules'])} rules declared",
+              file=sys.stderr)
+        return 0
+    return 2
+
+
 def cmd_dashboard(args) -> int:
     """Attach to the cluster and serve the web dashboard
     (dashboard/head.py:61 analogue) until interrupted."""
@@ -437,6 +495,27 @@ def main(argv=None) -> int:
     p.add_argument("-f", "--follow", action="store_true",
                    help="stream new records to this terminal")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser(
+        "metrics",
+        help="windowed metric queries + alert states (head TSDB)")
+    msub = p.add_subparsers(dest="metrics_cmd", required=True)
+    mq = msub.add_parser(
+        "query", help="evaluate 'fn(metric{label=v})[window] "
+                      "by (label)' over the shipped history")
+    mq.add_argument("expr")
+    mq.add_argument("--address", required=True)
+    mq.add_argument("--json", action="store_true",
+                    help="full JSON response instead of one row "
+                         "per line")
+    mn = msub.add_parser("names",
+                         help="stored series names + store stats")
+    mn.add_argument("--address", required=True)
+    ma = msub.add_parser(
+        "alerts", help="declared rules + pending/firing instances")
+    ma.add_argument("--address", required=True)
+    ma.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser(
         "profile", help="sampling profile of a node or actor "

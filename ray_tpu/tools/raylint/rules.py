@@ -923,6 +923,101 @@ def rule_log_hygiene(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: metric-cardinality
+# --------------------------------------------------------------------------
+
+_METRIC_TAG_METHODS = {"inc", "set", "observe"}
+# Identifier names that mint per-operation in this codebase: a tag
+# value carrying one creates a new metric series per op — the registry,
+# the exposition page, and the head TSDB all grow without bound.
+_UNBOUNDED_ID_RE = re.compile(
+    r"(?:^|_)(trace|span|task|object|obj|request|req|session|job)"
+    r"_?id$|^(oid|uuid|idem_key)$")
+
+
+def _unbounded_tag_reason(expr: ast.AST) -> Optional[str]:
+    """Why this tag-value expression is an unbounded identifier, or
+    None when it looks bounded (node names, kind/where enums, ...)."""
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Attribute) and f.attr == "hex":
+            return "a .hex() identity rendering"
+        callee = (f.attr if isinstance(f, ast.Attribute)
+                  else getattr(f, "id", ""))
+        if callee in ("uuid1", "uuid4", "token_hex"):
+            return f"a fresh {callee}()"
+        if callee == "str" and expr.args:
+            return _unbounded_tag_reason(expr.args[0])
+        return None
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        name = expr.id if isinstance(expr, ast.Name) else expr.attr
+        if _UNBOUNDED_ID_RE.search(name):
+            return f"identifier {name!r}"
+        return None
+    if isinstance(expr, ast.Subscript):
+        # spec["trace_id"] names the id in the key; task_id[:8]
+        # (a truncated id is still 16^8 values) recurses on the value.
+        sl = expr.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+                and _UNBOUNDED_ID_RE.search(sl.value):
+            return f"identifier {sl.value!r}"
+        return _unbounded_tag_reason(expr.value)
+    if isinstance(expr, ast.JoinedStr):
+        for part in expr.values:
+            if isinstance(part, ast.FormattedValue):
+                reason = _unbounded_tag_reason(part.value)
+                if reason is not None:
+                    return reason
+        return None
+    if isinstance(expr, ast.BinOp):
+        for side in (expr.left, expr.right):
+            reason = _unbounded_tag_reason(side)
+            if reason is not None:
+                return reason
+    return None
+
+
+def rule_metric_cardinality(model: ProjectModel) -> List[Finding]:
+    """Instrumentation sites feeding unbounded identifiers (object/
+    trace/task/request ids, uuids, .hex() renderings) into metric tag
+    values.  Metrics aggregate; ids enumerate — an id-valued tag turns
+    a bounded series family into one series per operation, growing
+    every process registry, the /metrics exposition, and the head
+    TSDB until the cardinality cap starts dropping REAL series."""
+    out = _Collector(model, "metric-cardinality")
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _METRIC_TAG_METHODS):
+                continue
+            tags = None
+            for kw in node.keywords:
+                if kw.arg == "tags":
+                    tags = kw.value
+            if tags is None and len(node.args) >= 2:
+                tags = node.args[1]  # inc/set/observe(value, tags)
+            if not isinstance(tags, ast.Dict):
+                continue
+            for key, value in zip(tags.keys, tags.values):
+                reason = _unbounded_tag_reason(value)
+                if reason is None:
+                    continue
+                label = (repr(key.value)
+                         if isinstance(key, ast.Constant)
+                         else "<dynamic>")
+                out.add(info, node.lineno, fi.qualname,
+                        f"metric tag {label} feeds {reason} — "
+                        f"per-operation ids explode series "
+                        f"cardinality (one series per id); use a "
+                        f"bounded label or drop the tag")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
 # rule: suppression-syntax (meta): disables must carry a reason and
 # name real rules — a typo'd disable that silently fails to suppress
 # (or a reasonless one) is itself a finding
@@ -1425,6 +1520,7 @@ RULES = {
     "thread-hygiene": rule_thread_hygiene,
     "unbounded-mailbox": rule_unbounded_mailbox,
     "log-hygiene": rule_log_hygiene,
+    "metric-cardinality": rule_metric_cardinality,
     "suppression-syntax": rule_suppression_syntax,
     "journaled-mutation": rule_journaled_mutation,
     "lock-order-inversion": rule_lock_order_inversion,
@@ -1479,6 +1575,12 @@ RULE_DOCS = {
         "the cost is paid even when the level is off), and runtime "
         "modules must not use bare print() (unleveled, untraced, "
         "unshipped output; CLI entry points are exempt)."),
+    "metric-cardinality": (
+        "Metric tag values must be bounded: a tag fed an unbounded "
+        "identifier (object/trace/task/request id, uuid, .hex() "
+        "rendering) mints one series per operation, growing every "
+        "process registry, the /metrics exposition, and the head "
+        "TSDB until the cardinality cap drops real series."),
     "suppression-syntax": (
         "raylint disables must name real rules and carry a "
         "'-- reason'; a reasonless or typo'd disable does not "

@@ -41,6 +41,10 @@ class ClientProxyServer:
     # every ~30s) for this long is presumed dead and its refs/actors
     # are released — the proxier's channel-drop cleanup, lease-style.
     SESSION_TTL_S = 120.0
+    # Reaper tick.  A class attribute (not a literal in the loop) so
+    # tests shrinking SESSION_TTL_S can shrink the tick with it —
+    # otherwise a 0.5s-TTL test still waits out a full 10s tick.
+    REAP_INTERVAL_S = 10.0
 
     def __init__(self, host: str = "127.0.0.1", port: int = 10001):
         self._lock = threading.Lock()
@@ -84,7 +88,7 @@ class ClientProxyServer:
         return {"ok": ok}
 
     def _reap_loop(self):
-        while not self._stopped.wait(10.0):
+        while not self._stopped.wait(self.REAP_INTERVAL_S):
             cutoff = time.monotonic() - self.SESSION_TTL_S
             with self._lock:
                 dead = [s for s, t in self._last_seen.items()

@@ -57,9 +57,13 @@ def _chaos_hang_guard(request):
     # waiters queued forever under sustained load; a wedged collective
     # ring blocks every member on a recv that never lands; a vcluster
     # soak whose head never recovers blocks every load thread).
+    # tsdb cluster tests poll shipped history with bounded deadlines;
+    # the guard catches the same failure mode (a wedged flush/standby
+    # pump blocking the poll loop forever).
     if request.node.get_closest_marker("chaos") is None and \
             request.node.get_closest_marker("overload") is None and \
             request.node.get_closest_marker("net") is None and \
+            request.node.get_closest_marker("tsdb") is None and \
             request.node.get_closest_marker("stress") is None:
         yield
         return

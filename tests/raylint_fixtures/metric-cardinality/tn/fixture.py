@@ -1,0 +1,26 @@
+"""True negatives: bounded tag values (node names, enums, method
+names), id-free calls, and id-shaped code outside metric calls."""
+
+from mymetrics import Counter, Gauge  # noqa: F401
+
+requests = Counter("app_requests")
+depth = Gauge("app_depth")
+
+
+class Pipeline:
+    def record(self, node_id, kind, method):
+        # node ids are bounded by cluster size — allowed
+        requests.inc(tags={"node_id": node_id})
+        # enum-ish strings and method names are bounded
+        requests.inc(tags={"kind": kind, "where": "dispatch"})
+        depth.set(2, tags={"method": method})
+        # no tags at all
+        requests.inc()
+        depth.set(7)
+
+    def elsewhere(self, task_id, ref):
+        # id usage OUTSIDE a metric call is fine
+        key = ref.hex()
+        self.index = {key: task_id}
+        # a non-dict second positional is not a tags dict
+        self.cache.set("task_result", task_id)

@@ -1,0 +1,26 @@
+"""True positives: unbounded identifiers fed into metric tag values
+(every variant mints one series per operation)."""
+
+import uuid
+
+from mymetrics import Counter, Gauge, Histogram  # noqa: F401
+
+requests = Counter("app_requests")
+depth = Gauge("app_depth")
+latency = Histogram("app_latency")
+
+
+class Pipeline:
+    def record(self, task_id, spec, ref):
+        # finding: bare id-named variable
+        requests.inc(tags={"task": task_id})
+        # finding: f-string wrapping an id
+        depth.set(3, tags={"req": f"req-{task_id}"})
+        # finding: positional tags dict + .hex() identity
+        latency.observe(0.5, {"object": ref.hex()})
+        # finding: subscript naming the id in the key
+        requests.inc(tags={"trace": spec["trace_id"]})
+        # finding: truncated ids are still unbounded
+        depth.set(1, tags={"span": task_id[:8]})
+        # finding: a fresh uuid per call
+        requests.inc(tags={"probe": str(uuid.uuid4())})

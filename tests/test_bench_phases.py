@@ -69,3 +69,19 @@ def test_train_phase_emits_mfu_field():
     assert subprocess.run(
         [sys.executable, "-c", "import bench"],
         capture_output=True).returncode == 0
+
+
+def test_tsdb_bench_phase_smoke():
+    """The TSDB phase emits its query latency + ingest-overhead keys
+    from a real head RPC round (small sizes — the real numbers come
+    from the BENCH round's full run)."""
+    from bench import _tsdb_bench
+
+    out = _tsdb_bench(n_nodes=2, n_flushes=25, n_queries=8,
+                      n_pairs=10)
+    assert out["metrics_query_us"] > 0
+    assert out["tsdb_series"] > 0
+    assert out["tsdb_bytes_per_sample"] > 0
+    # The overhead key exists and is a sane percentage; the <5 guard
+    # is asserted on the full-size BENCH run, not a 10-pair smoke.
+    assert -50.0 < out["tsdb_ingest_overhead_pct"] < 100.0

@@ -84,6 +84,13 @@ class TestSerializationBoundary:
 @pytest.fixture(scope="module")
 def cluster():
     ray_tpu.shutdown()
+    # Shrink the lease TTL for this module's head: the kill-a-node
+    # tests (actor restart, pubsub death fan-out) each wait out a
+    # full lease before the reaper declares the victim dead — 10s of
+    # pure fixture clock per kill at the default.  5s still gives a
+    # 1 Hz heartbeat five missed beats of margin.
+    old_ttl = os.environ.get("RAY_TPU_LEASE_TTL_S")
+    os.environ["RAY_TPU_LEASE_TTL_S"] = "5.0"
     c = Cluster()
     c.add_node(num_cpus=2, resources={"worker0": 1}, name="w0")
     c.add_node(num_cpus=2, resources={"worker1": 1}, name="w1")
@@ -91,6 +98,10 @@ def cluster():
     yield c
     ray_tpu.shutdown()
     c.shutdown()
+    if old_ttl is None:
+        os.environ.pop("RAY_TPU_LEASE_TTL_S", None)
+    else:
+        os.environ["RAY_TPU_LEASE_TTL_S"] = old_ttl
 
 
 @ray_tpu.remote
@@ -608,6 +619,11 @@ def test_heartbeat_synced_resource_view():
     from ray_tpu.cluster.cluster_utils import Cluster
 
     ray_tpu.shutdown()
+    # Fresh head for this test: a short lease turns the
+    # dead-node-drops-out half from a 10s+ fixture-clock wait into
+    # ~4s (heartbeats stay at 1 Hz — four beats of margin).
+    old_ttl = os.environ.get("RAY_TPU_LEASE_TTL_S")
+    os.environ["RAY_TPU_LEASE_TTL_S"] = "4.0"
     c = Cluster()
     c.connect(num_cpus=2)
     try:
@@ -626,7 +642,7 @@ def test_heartbeat_synced_resource_view():
                     if prev is not None and cur == prev:
                         return cur
                     prev = cur
-                time.sleep(1.0)
+                time.sleep(0.5)
             return prev
 
         base = settled_cpu()
@@ -651,3 +667,7 @@ def test_heartbeat_synced_resource_view():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+        if old_ttl is None:
+            os.environ.pop("RAY_TPU_LEASE_TTL_S", None)
+        else:
+            os.environ["RAY_TPU_LEASE_TTL_S"] = old_ttl

@@ -140,13 +140,23 @@ class TestClientProxyEdges:
         finally:
             ctx.disconnect()
 
-    def test_dead_session_reaped(self, proxy, monkeypatch):
-        monkeypatch.setattr(type(proxy), "SESSION_TTL_S", 0.5)
-        ctx = rc.connect(proxy.address)
-        ctx._closed.set()  # simulate a client that died silently
-        sid = ctx._session
-        ctx.put([1])
-        deadline = time.time() + 30
-        while time.time() < deadline and sid in proxy._refs:
-            time.sleep(0.3)
-        assert sid not in proxy._refs  # lease expired, refs released
+    def test_dead_session_reaped(self, ray_start_regular, monkeypatch):
+        # Patch BOTH clocks before construction: the reaper parks in
+        # a full REAP_INTERVAL_S wait from its first tick, so a proxy
+        # built by the shared fixture would still sleep out the
+        # default 10s once before a shrunken interval applied.
+        monkeypatch.setattr(rc.ClientProxyServer, "SESSION_TTL_S", 0.5)
+        monkeypatch.setattr(rc.ClientProxyServer, "REAP_INTERVAL_S",
+                            0.2)
+        srv = rc.ClientProxyServer(port=0)
+        try:
+            ctx = rc.connect(srv.address)
+            ctx._closed.set()  # simulate a client that died silently
+            sid = ctx._session
+            ctx.put([1])
+            deadline = time.time() + 30
+            while time.time() < deadline and sid in srv._refs:
+                time.sleep(0.1)
+            assert sid not in srv._refs  # lease expired, refs released
+        finally:
+            srv.shutdown()

@@ -1,0 +1,818 @@
+"""Metrics TSDB + windowed query engine + alert/SLO plane (ISSUE 14).
+
+Unit tiers: Gorilla compression round-trips exactly, retention evicts,
+rate() survives counter resets (incarnation-stamped restarts included),
+the query grammar parses/rejects, quantiles interpolate from histogram
+buckets, and the alert state machine fires after its for-duration and
+clears.  Integration tiers: the head ingests shipped snapshots and
+answers `metrics_query` with staleness-aware /metrics aggregation, an
+alert fires and clears end-to-end (pubsub + timeline instant + gauge),
+query parity holds across CLI / RPC / dashboard on a 2-node cluster's
+shipped history, and a promoted standby (replication side-stream) plus
+a restarted head (on-disk metrics ring) both answer pre-failover /
+pre-restart history.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.head import HeadServer
+from ray_tpu.cluster.rpc import RpcClient
+from ray_tpu.observability import alerts as alerts_mod
+from ray_tpu.observability import tsdb as tsdb_mod
+from ray_tpu.observability.tsdb import (GorillaChunk, QueryError, TSDB,
+                                        parse_query)
+
+pytestmark = pytest.mark.tsdb
+
+
+def counter_state(name, value, tags=None, tag_keys=()):
+    key = tuple(tags or ())
+    return {name: {"kind": "counter", "description": "",
+                   "tag_keys": tuple(tag_keys),
+                   "values": {key: float(value)}}}
+
+
+def hist_state(name, counts, boundaries, sum_=0.0):
+    return {name: {"kind": "histogram", "description": "",
+                   "tag_keys": (), "values": {(): float(sum_)},
+                   "boundaries": list(boundaries),
+                   "counts": {(): list(counts)}}}
+
+
+def push(client, node, state, ts, inc="inc-1", flush_s=0.2):
+    client.call("push_events", {
+        "node_id": node, "pid": 4242, "events": [], "logs": [],
+        "metrics": {"ts": ts, "incarnation": inc, "state": state},
+        "flush_s": flush_s, "dropped": 0, "logs_dropped": 0})
+
+
+# ---------------------------------------------------------------------------
+# Compression
+# ---------------------------------------------------------------------------
+
+class TestGorillaCompression:
+    def test_round_trip_exact(self):
+        import random
+
+        rng = random.Random(7)
+        c = GorillaChunk()
+        ts, v = 1_700_000_000.0, 100.0
+        expect = []
+        for _ in range(tsdb_mod.CHUNK_SAMPLES):
+            ts += rng.choice([1.0, 1.0, 0.25, 2.5, 61.0])
+            v += rng.choice([0.0, 0.0, 1.0, -3.75, 1e9 * rng.random(),
+                             -rng.random()])
+            c.append(ts, v)
+            expect.append((round(ts * 1000) / 1000.0, v))
+        got = c.samples()
+        assert len(got) == len(expect)
+        for (t0, v0), (t1, v1) in zip(expect, got):
+            assert abs(t0 - t1) < 1e-9
+            assert v0 == v1  # bit-exact values
+
+    def test_steady_counter_compresses_hard(self):
+        """The common case — a counter ticking at a steady cadence —
+        must cost a small fraction of raw 16-byte samples."""
+        c = GorillaChunk()
+        for i in range(tsdb_mod.CHUNK_SAMPLES):
+            c.append(1_700_000_000.0 + i, float(i))
+        raw = 16 * tsdb_mod.CHUNK_SAMPLES
+        assert c.nbytes() < raw / 3
+
+    def test_series_seals_chunks_and_reads_across(self):
+        s = tsdb_mod.Series("m", "gauge", {})
+        n = tsdb_mod.CHUNK_SAMPLES * 2 + 17
+        for i in range(n):
+            s.append(1000.0 + i, float(i % 11))
+        assert len(s.chunks) == 2    # sealed; 17 staged in the tail
+        assert len(s.open) == 17
+        got = s.samples_between(999.0, 1000.0 + n)
+        assert len(got) == n
+        assert [v for _t, v in got] == [float(i % 11)
+                                        for i in range(n)]
+
+    def test_out_of_order_sample_dropped(self):
+        s = tsdb_mod.Series("m", "gauge", {})
+        s.append(1000.0, 1.0)
+        s.append(999.0, 2.0)   # regressed clock: dropped
+        s.append(1001.0, 3.0)
+        assert [v for _t, v in s.samples_between(0, 2000)] == [1.0, 3.0]
+
+
+# ---------------------------------------------------------------------------
+# Retention + cardinality bounds
+# ---------------------------------------------------------------------------
+
+class TestRetention:
+    def test_sealed_chunks_age_out(self):
+        db = TSDB(retain_s=60)
+        for i in range(600):
+            db.ingest("n", counter_state("c", i), ts=1000.0 + i)
+        s = next(iter(db._series.values()))
+        kept = s.sample_count()
+        # Window is 60 samples; granularity is whole sealed chunks.
+        assert 60 <= kept <= 60 + 2 * tsdb_mod.CHUNK_SAMPLES
+        assert db.query("increase(c)[30s]", now=1599.0)[
+            "rows"][0]["value"] == pytest.approx(30.0)
+
+    def test_idle_series_evicted_entirely(self):
+        db = TSDB(retain_s=60)
+        db.ingest("n", counter_state("old_metric", 1), ts=1000.0)
+        for i in range(600):
+            db.ingest("n", counter_state("live_metric", i),
+                      ts=1001.0 + i)
+        assert "old_metric" not in db.series_names()
+        assert "live_metric" in db.series_names()
+
+    def test_max_series_cap_counts_drops(self):
+        db = TSDB(max_series=5)
+        for i in range(9):
+            db.ingest("n", counter_state(f"m{i}", 1.0), ts=1000.0 + i)
+        assert len(db.series_names()) == 5
+        assert db.dropped_series == 4
+        assert db.stats()["dropped_series"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Reset-aware rate (satellite: incarnation stamping)
+# ---------------------------------------------------------------------------
+
+class TestResetAwareRate:
+    def test_negative_delta_fallback_without_incarnation(self):
+        db = TSDB()
+        for i, v in enumerate([10, 20, 30, 5, 15]):
+            db.ingest("n", counter_state("c", v), ts=1000.0 + i)
+        # Born in window at 10, 10->30 = 20, reset-to-5 contributes
+        # 5, 5->15 = 10.
+        row = db.query("increase(c)[60s]", now=1004.0)["rows"][0]
+        assert row["value"] == pytest.approx(45.0)
+        # Window starting mid-life: no birth bonus; the anchored
+        # boundary pair (10->20) still counts its full delta.
+        row = db.query("increase(c)[3.5s]", now=1004.0)["rows"][0]
+        assert row["value"] == pytest.approx(10 + 10 + 5 + 10)
+
+    def test_series_born_in_window_counts_first_value(self):
+        """The first increment must be visible to increase()/rate():
+        a counter whose first-ever sample lands in the window went
+        0 -> v since birth (the alert-on-first-stuck-snapshot case)."""
+        db = TSDB()
+        db.ingest("n", counter_state("c", 1.0), ts=1000.0)
+        row = db.query("increase(c)[30s]", now=1001.0)["rows"][0]
+        assert row["value"] == pytest.approx(1.0)
+
+    def test_incarnation_change_detected_even_when_value_grows(self):
+        """The insidious case: a restarted worker re-accumulates PAST
+        the old value between flushes — value-drop detection misses
+        it, the incarnation stamp does not."""
+        db = TSDB()
+        db.ingest("n", counter_state("c", 10), ts=1000.0, incarnation="a")
+        db.ingest("n", counter_state("c", 12), ts=1001.0, incarnation="a")
+        # restart: new process counted 14 from zero before its flush
+        db.ingest("n", counter_state("c", 14), ts=1002.0, incarnation="b")
+        row = db.query("increase(c)[60s]", now=1002.0)["rows"][0]
+        # Born at 10, 10->12 = 2, then the FULL post-restart 14
+        # (not 14-12=2).
+        assert row["value"] == pytest.approx(26.0)
+
+    def test_lazily_created_counter_still_resets(self):
+        """Incarnation tracking is PER SERIES: a counter absent from
+        the restarted process's first flush (metric groups build
+        lazily) but present in a later one still gets its reset
+        marker — per-node tracking would have consumed the
+        incarnation change on the first flush and missed it."""
+        db = TSDB()
+        db.ingest("n", {**counter_state("c", 10),
+                        **counter_state("other", 1)},
+                  ts=1000.0, incarnation="a")
+        # First post-restart flush lacks "c" entirely.
+        db.ingest("n", counter_state("other", 1), ts=1001.0,
+                  incarnation="b")
+        # "c" re-appears later, already past its old value.
+        db.ingest("n", {**counter_state("c", 14),
+                        **counter_state("other", 1)},
+                  ts=1002.0, incarnation="b")
+        row = db.query("increase(c)[60s]", now=1002.0)["rows"][0]
+        assert row["value"] == pytest.approx(10.0 + 14.0)
+
+    def test_rate_never_negative_across_restart(self):
+        db = TSDB()
+        db.ingest("n", counter_state("c", 1000), ts=1000.0,
+                  incarnation="a")
+        db.ingest("n", counter_state("c", 3), ts=1001.0,
+                  incarnation="b")
+        val = db.query("rate(c)[10s]", now=1001.0)["rows"][0]["value"]
+        # Born at 1000 (in window) + the post-restart 3: positive.
+        assert val == pytest.approx(100.3)
+
+
+# ---------------------------------------------------------------------------
+# Query grammar + engine
+# ---------------------------------------------------------------------------
+
+class TestQueryParsing:
+    def test_full_form(self):
+        q = parse_query(
+            'p99(ray_tpu_channel_write_wait_seconds'
+            '{node_id="ab12", ring=r0})[30s] by (node_id, ring)')
+        assert q.fn == "p99" and q.quantile == 0.99
+        assert q.metric == "ray_tpu_channel_write_wait_seconds"
+        assert q.matchers == {"node_id": "ab12", "ring": "r0"}
+        assert q.window_s == 30.0
+        assert q.by == ("node_id", "ring")
+
+    def test_windows_units(self):
+        assert parse_query("rate(m)[500ms]").window_s == 0.5
+        assert parse_query("rate(m)[2m]").window_s == 120.0
+        assert parse_query("rate(m)[1h]").window_s == 3600.0
+
+    @pytest.mark.parametrize("bad", [
+        "rate(m)",                      # no window
+        "frobnicate(m)[30s]",           # unknown fn
+        "rate(m)[30s] by node_id",      # by needs parens
+        "rate(m)[0s]",                  # empty window
+        "p0(m)[30s]",                   # quantile out of range
+        "rate(m{a=})[30s][30s]",        # trailing junk
+        "",
+    ])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(QueryError):
+            parse_query(bad)
+
+
+class TestQueryEngine:
+    def _db(self):
+        db = TSDB()
+        for i in range(30):
+            db.ingest("nodeA", {
+                **counter_state("reqs", 2 * i, tags=("http",),
+                                tag_keys=("kind",)),
+                "depth": {"kind": "gauge", "description": "",
+                          "tag_keys": (), "values": {(): 10.0 + i}},
+            }, ts=1000.0 + i)
+            db.ingest("nodeB", counter_state(
+                "reqs", i, tags=("grpc",), tag_keys=("kind",)),
+                ts=1000.0 + i)
+        return db
+
+    def test_rate_and_increase_per_series(self):
+        db = self._db()
+        out = db.query("rate(reqs)[20s]", now=1029.0)
+        by_kind = {r["labels"]["kind"]: r["value"]
+                   for r in out["rows"]}
+        assert by_kind["http"] == pytest.approx(2.0)
+        assert by_kind["grpc"] == pytest.approx(1.0)
+        inc = db.query("increase(reqs)[10s] by (node_id)", now=1029.0)
+        vals = {r["labels"]["node_id"]: r["value"]
+                for r in inc["rows"]}
+        assert vals == {"nodeA": pytest.approx(20.0),
+                        "nodeB": pytest.approx(10.0)}
+
+    def test_by_grouping_sums_across_series(self):
+        db = self._db()
+        # One group: both kinds fold into the cluster-wide rate.
+        out = db.query("rate(reqs)[20s] by (le)", now=1029.0)
+        assert len(out["rows"]) == 1
+        assert out["rows"][0]["value"] == pytest.approx(3.0)
+
+    def test_gauge_over_time_fns(self):
+        db = self._db()
+        assert db.query("min_over_time(depth)[5s]",
+                        now=1029.0)["rows"][0]["value"] == 35.0
+        assert db.query("max_over_time(depth)[5s]",
+                        now=1029.0)["rows"][0]["value"] == 39.0
+        assert db.query("avg_over_time(depth)[5s]",
+                        now=1029.0)["rows"][0]["value"] == 37.0
+        assert db.query("last(depth)[5s]",
+                        now=1029.0)["rows"][0]["value"] == 39.0
+
+    def test_matcher_filters_series(self):
+        db = self._db()
+        out = db.query('rate(reqs{kind="http"})[20s]', now=1029.0)
+        assert len(out["rows"]) == 1
+        assert out["rows"][0]["labels"]["kind"] == "http"
+
+    def test_quantiles_from_histogram_buckets(self):
+        db = TSDB()
+        # 10/s in (0, 0.01], 60/s in (0.01, 0.1], 30/s in (0.1, 1].
+        for i in range(20):
+            db.ingest("n", hist_state(
+                "lat", [10 * i, 60 * i, 30 * i, 0],
+                [0.01, 0.1, 1.0]), ts=1000.0 + i)
+        p50 = db.query("p50(lat)[10s]", now=1019.0)["rows"][0]["value"]
+        # rank 50 of 100 lands in the second bucket: 0.01 +
+        # (0.1-0.01) * (50-10)/60
+        assert p50 == pytest.approx(0.01 + 0.09 * 40 / 60, rel=1e-6)
+        p99 = db.query("p99(lat)[10s]", now=1019.0)["rows"][0]["value"]
+        assert 0.1 < p99 <= 1.0
+
+    def test_empty_window_no_rows(self):
+        db = self._db()
+        out = db.query("rate(reqs)[5s]", now=5000.0)
+        assert out["rows"] == []
+        out = db.query("rate(never_seen)[5s]", now=1029.0)
+        assert out["rows"] == []
+
+    def test_disable_stops_ingest(self):
+        db = TSDB()
+        tsdb_mod.disable()
+        try:
+            db.ingest("n", counter_state("c", 1), ts=1000.0)
+        finally:
+            tsdb_mod.enable()
+        assert db.series_names() == []
+        db.ingest("n", counter_state("c", 1), ts=1000.0)
+        assert db.series_names() == ["c"]
+
+
+# ---------------------------------------------------------------------------
+# Alert state machine
+# ---------------------------------------------------------------------------
+
+class TestAlertManager:
+    def _mgr(self, db, clock):
+        events = []
+        mgr = alerts_mod.AlertManager(db, on_transition=events.append,
+                                      now=lambda: clock[0])
+        return mgr, events
+
+    def test_fires_after_for_duration_and_clears(self):
+        db = TSDB()
+        clock = [1010.0]
+        mgr, events = self._mgr(db, clock)
+        mgr.add_rule(alerts_mod.AlertRule(
+            "hot", "rate(c)[10s]", ">", 1.0, for_s=5.0))
+        for i in range(12):
+            db.ingest("n", counter_state("c", 10 * i), ts=1000.0 + i)
+        mgr.evaluate()          # breach starts: pending, not firing
+        assert events == []
+        st = mgr.status()["active"]
+        assert st and st[0]["state"] == "pending"
+        clock[0] += 3.0
+        mgr.evaluate()
+        assert events == []     # 3s < for_s
+        clock[0] += 2.5
+        for i in range(12, 18):
+            db.ingest("n", counter_state("c", 10 * i), ts=1000.0 + i)
+        mgr.evaluate()
+        assert [e["state"] for e in events] == ["firing"]
+        assert events[0]["rule"] == "hot"
+        assert events[0]["labels"]["node_id"] == "n"
+        # flat counter → rate 0 → cleared
+        clock[0] = 1040.0
+        for i in range(5):
+            db.ingest("n", counter_state("c", 170), ts=1035.0 + i)
+        mgr.evaluate()
+        assert [e["state"] for e in events] == ["firing", "cleared"]
+        assert mgr.status()["active"] == []
+
+    def test_pending_resets_when_breach_stops(self):
+        db = TSDB()
+        clock = [1005.0]
+        mgr, events = self._mgr(db, clock)
+        mgr.add_rule(alerts_mod.AlertRule(
+            "hot", "last(g)[10s]", ">", 5.0, for_s=10.0))
+        db.ingest("n", {"g": {"kind": "gauge", "description": "",
+                              "tag_keys": (), "values": {(): 9.0}}},
+                  ts=1004.0)
+        mgr.evaluate()
+        assert mgr.status()["active"][0]["state"] == "pending"
+        db.ingest("n", {"g": {"kind": "gauge", "description": "",
+                              "tag_keys": (), "values": {(): 1.0}}},
+                  ts=1005.0)
+        clock[0] += 1
+        mgr.evaluate()          # breach gone before for_s: dropped
+        assert mgr.status()["active"] == []
+        assert events == []     # pending → gone is silent
+
+    def test_vanished_row_clears_firing_instance(self):
+        db = TSDB(retain_s=30)
+        clock = [1010.0]
+        mgr, events = self._mgr(db, clock)
+        mgr.add_rule(alerts_mod.AlertRule(
+            "hot", "last(g)[10s] by (node_id)", ">", 0.0, for_s=0.0))
+        db.ingest("n", {"g": {"kind": "gauge", "description": "",
+                              "tag_keys": (), "values": {(): 2.0}}},
+                  ts=1009.0)
+        mgr.evaluate()
+        assert [e["state"] for e in events] == ["firing"]
+        clock[0] = 1100.0       # series aged out of the window
+        mgr.evaluate()
+        assert [e["state"] for e in events] == ["firing", "cleared"]
+
+    def test_bad_rule_counts_error_not_crash(self):
+        db = TSDB()
+        clock = [1000.0]
+        mgr, _ = self._mgr(db, clock)
+        rule = alerts_mod.AlertRule("ok", "rate(c)[10s]", ">", 1.0)
+        rule._query = None      # simulate evaluator blowup
+        mgr.add_rule(rule)
+        mgr.evaluate()          # must not raise
+
+    def test_default_rules_parse(self):
+        rules = alerts_mod.default_rules()
+        names = {r.name for r in rules}
+        assert {"stuck-detector", "breaker-tripping", "shed-rate",
+                "kv-blocks-low", "head-repl-lag"} <= names
+
+
+# ---------------------------------------------------------------------------
+# Head integration: ingest, staleness, alert plane end-to-end
+# ---------------------------------------------------------------------------
+
+class TestHeadIntegration:
+    def test_staleness_drops_dead_node_from_exposition(self):
+        """Satellite: a node whose last snapshot is older than N
+        flush intervals vanishes from the LIVE aggregation (no
+        dead-node ghosts) while its history stays queryable."""
+        head = HeadServer("127.0.0.1", 0)
+        cl = RpcClient(head.address)
+        try:
+            now = time.time()
+            push(cl, "ghost", counter_state("c", 5), now,
+                 flush_s=0.05)
+            push(cl, "alive", counter_state("c", 1), now,
+                 flush_s=10.0)
+            deadline = time.monotonic() + 10.0
+            while True:
+                states = cl.call("cluster_metrics", {})
+                if "ghost" not in states:
+                    break
+                assert time.monotonic() < deadline, \
+                    "stale node never dropped"
+                time.sleep(0.05)
+            assert "alive" in states
+            # History survives the exposition drop.
+            out = cl.call("metrics_query", {
+                "expr": 'last(c{node_id="ghost"})[120s]'})
+            assert out["rows"] and out["rows"][0]["value"] == 5.0
+        finally:
+            cl.close()
+            head.shutdown()
+
+    def test_headless_process_exports_own_registry(self):
+        """A head with no co-resident shipper exports its own series
+        (__head__) so journal/lease/alert gauges reach /metrics."""
+        head = HeadServer("127.0.0.1", 0)
+        cl = RpcClient(head.address)
+        try:
+            states = cl.call("cluster_metrics", {})
+            assert "__head__" in states
+        finally:
+            cl.close()
+            head.shutdown()
+
+    def test_alert_fires_and_clears_end_to_end(self, monkeypatch):
+        """Acceptance core: a declarative rule over pushed history
+        transitions pending → firing → cleared, and every surface
+        shows it — pubsub event, merged-timeline instant, firing
+        gauge, alerts_status."""
+        monkeypatch.setenv("RAY_TPU_ALERT_EVAL_S", "0.1")
+        head = HeadServer("127.0.0.1", 0)
+        cl = RpcClient(head.address)
+        try:
+            cl.call("alert_rules", {"action": "add", "rule": {
+                "name": "test-hot", "expr": "rate(c)[4s]",
+                "op": ">", "threshold": 1.0, "for_s": 0.0}})
+            t0 = time.time()
+            for i in range(8):
+                push(cl, "w1", counter_state("c", 10 * i),
+                     t0 - 1.6 + 0.2 * i)
+            # --- firing: pubsub + status + gauge + timeline instant
+            deadline = time.monotonic() + 10.0
+            fired = None
+            cursor = 0
+            while fired is None:
+                assert time.monotonic() < deadline, "never fired"
+                out = cl.call("pubsub_poll", {
+                    "cursors": {"alerts": cursor}, "timeout_s": 1.0})
+                ch = (out or {}).get("alerts")
+                if not ch:
+                    continue
+                cursor = ch["seq"]
+                for ev in ch["events"]:
+                    if (ev["rule"] == "test-hot"
+                            and ev["state"] == "firing"):
+                        fired = ev
+            assert fired["labels"]["node_id"] == "w1"
+            st = cl.call("alerts_status", {})
+            firing = [a for a in st["active"]
+                      if a["rule"] == "test-hot"]
+            assert firing and firing[0]["state"] == "firing"
+            tl = cl.call("cluster_timeline", {"with_logs": False})
+            instants = [e for e in tl["events"]
+                        if e["name"] == "alert:test-hot"]
+            assert instants and instants[0]["ph"] == "i"
+            assert instants[0]["args"]["state"] == "firing"
+            states = cl.call("cluster_metrics", {})
+            gauges = states["__head__"]["ray_tpu_alerts_firing"]
+            assert gauges["values"][("test-hot",)] == 1.0
+            # --- clearing: flat counter → rate decays to 0
+            deadline = time.monotonic() + 15.0
+            cleared = None
+            while cleared is None:
+                assert time.monotonic() < deadline, "never cleared"
+                push(cl, "w1", counter_state("c", 70), time.time())
+                out = cl.call("pubsub_poll", {
+                    "cursors": {"alerts": cursor}, "timeout_s": 0.5})
+                ch = (out or {}).get("alerts")
+                if not ch:
+                    continue
+                cursor = ch["seq"]
+                for ev in ch["events"]:
+                    if (ev["rule"] == "test-hot"
+                            and ev["state"] == "cleared"):
+                        cleared = ev
+            states = cl.call("cluster_metrics", {})
+            gauges = states["__head__"]["ray_tpu_alerts_firing"]
+            assert gauges["values"][("test-hot",)] == 0.0
+            tl = cl.call("cluster_timeline", {"with_logs": False})
+            assert len([e for e in tl["events"]
+                        if e["name"] == "alert:test-hot"]) >= 2
+        finally:
+            cl.close()
+            head.shutdown()
+
+    def test_restart_replays_metrics_ring(self, tmp_path):
+        """The on-disk metrics ring (PR 12 DiskRing) makes history
+        survive a head restart."""
+        storage = str(tmp_path / "head.bin")
+        head = HeadServer("127.0.0.1", 0, storage_path=storage)
+        cl = RpcClient(head.address)
+        t0 = time.time()
+        for i in range(10):
+            push(cl, "w1", counter_state("c", 5 * i), t0 - 10 + i)
+        out = cl.call("metrics_query", {"expr": "increase(c)[60s]"})
+        assert out["rows"][0]["value"] == pytest.approx(45.0)
+        cl.close()
+        head.shutdown()
+        head2 = HeadServer("127.0.0.1", 0, storage_path=storage)
+        cl2 = RpcClient(head2.address)
+        try:
+            out = cl2.call("metrics_query",
+                           {"expr": "increase(c)[60s]"})
+            assert out["rows"] and \
+                out["rows"][0]["value"] == pytest.approx(45.0)
+        finally:
+            cl2.close()
+            head2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Replicated head: promoted standby answers pre-failover history
+# ---------------------------------------------------------------------------
+
+class TestStandbyHistory:
+    def test_promoted_standby_serves_prefailover_metrics(self,
+                                                         tmp_path):
+        primary = HeadServer(
+            "127.0.0.1", 0, storage_path=str(tmp_path / "p.bin"),
+            repl_mode="sync", primary_ttl_s=0.8, repl_timeout_s=2.0)
+        standby = HeadServer(
+            "127.0.0.1", 0, storage_path=str(tmp_path / "s.bin"),
+            standby_of=primary.address, primary_ttl_s=0.8,
+            repl_timeout_s=2.0)
+        pcl = RpcClient(primary.address)
+        scl = RpcClient(standby.address)
+        try:
+            t0 = time.time()
+            for i in range(10):
+                push(pcl, "w1", counter_state("c", 3 * i), t0 - 9 + i)
+            # The observability side-stream is async + best-effort:
+            # poll the standby until the history lands.
+            deadline = time.monotonic() + 15.0
+            while True:
+                out = scl.call("metrics_query",
+                               {"expr": "increase(c)[60s]"})
+                if out["rows"] and out["rows"][0]["value"] >= 27.0:
+                    break
+                assert time.monotonic() < deadline, \
+                    f"standby never ingested: {out}"
+                time.sleep(0.1)
+            # Fail over; the promoted standby still answers.
+            pcl.close()
+            primary.shutdown()
+            deadline = time.monotonic() + 15.0
+            while True:
+                st = scl.call("repl_status", {})
+                if st["role"] == "primary":
+                    break
+                assert time.monotonic() < deadline, st
+                time.sleep(0.1)
+            out = scl.call("metrics_query",
+                           {"expr": "increase(c)[60s]"})
+            assert out["rows"][0]["value"] == pytest.approx(27.0)
+        finally:
+            scl.close()
+            standby.shutdown()
+            primary.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Cluster acceptance: shipped history + CLI/RPC/dashboard parity
+# ---------------------------------------------------------------------------
+
+def _channels_or_skip():
+    from ray_tpu.experimental.channel import channels_available
+
+    if not channels_available():
+        pytest.skip("native channel lib unavailable")
+
+
+class TestClusterQueries:
+    def test_windowed_query_from_shipped_history_all_surfaces(
+            self, shutdown_only):
+        """Acceptance: a 2-node cluster's ring traffic lands in the
+        head TSDB via the shipped snapshots;
+        `p99(ray_tpu_channel_write_wait_seconds)[30s] by (node_id)`
+        returns windowed values for BOTH workers (3-stage chain: each
+        worker produces into a ring), and the CLI, the RPC, and the
+        dashboard route agree."""
+        _channels_or_skip()
+        from ray_tpu.cluster.cluster_utils import Cluster
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+        c = Cluster()
+        env = {"RAY_TPU_EVENT_FLUSH_S": "0.2"}
+        c.add_node(num_cpus=2, resources={"d0": 10}, env=env)
+        c.add_node(num_cpus=2, resources={"d1": 10}, env=env)
+        rt = c.connect(num_cpus=2)
+        expr = ("p99(ray_tpu_channel_write_wait_seconds)[30s] "
+                "by (node_id)")
+        try:
+            @ray_tpu.remote
+            class Stage:
+                def step(self, x):
+                    return x + 1
+
+            # a(d0) -> b(d1) -> c2(d0): both worker nodes write into
+            # a ring, so both record write-wait histograms.
+            with InputNode() as inp:
+                a = Stage.options(resources={"d0": 1}).bind()
+                b = Stage.options(resources={"d1": 1}).bind()
+                c2 = Stage.options(resources={"d0": 1}).bind()
+                dag = c2.step.bind(b.step.bind(a.step.bind(inp)))
+            compiled = dag.experimental_compile()
+            assert compiled._channel_edges
+            for i in range(6):
+                assert ray_tpu.get(compiled.execute(i)) == i + 3
+
+            workers = {n["NodeID"] for n in ray_tpu.nodes()
+                       if n["NodeID"] != rt.cluster.node_id}
+            deadline = time.monotonic() + 40.0
+            while True:
+                out = tsdb_mod.query_cluster(rt.cluster, expr)
+                got = {r["labels"].get("node_id")
+                       for r in out["rows"]}
+                if workers <= got:
+                    break
+                assert time.monotonic() < deadline, \
+                    f"windowed rows incomplete: {out} vs {workers}"
+                ray_tpu.get(compiled.execute(0))
+                time.sleep(0.3)
+            for row in out["rows"]:
+                assert row["value"] > 0.0
+
+            # Dashboard route: same engine behind the HTTP surface.
+            dash = start_dashboard(port=0)
+            try:
+                url = (dash.url + "/api/metrics/query?q="
+                       + urllib.parse.quote(expr))
+                body = json.loads(urllib.request.urlopen(
+                    url, timeout=15).read().decode())
+                assert body["fn"] == "p99"
+                dash_nodes = {r["labels"].get("node_id")
+                              for r in body["rows"]}
+                assert workers <= dash_nodes
+                # Bad expressions surface as HTTP 400, not a 500.
+                bad = (dash.url + "/api/metrics/query?q="
+                       + urllib.parse.quote("nope(m)[1s]"))
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(bad, timeout=15)
+                assert ei.value.code == 400
+                alerts = json.loads(urllib.request.urlopen(
+                    dash.url + "/api/alerts",
+                    timeout=15).read().decode())
+                assert {r["name"] for r in alerts["rules"]} >= {
+                    "stuck-detector", "shed-rate"}
+            finally:
+                stop_dashboard()
+
+            # CLI route (own driver process, like a real operator).
+            proc = subprocess.run(
+                [sys.executable, "-m", "ray_tpu", "metrics",
+                 "query", expr, "--address", c.head_address,
+                 "--json"],
+                capture_output=True, text=True, timeout=60)
+            assert proc.returncode == 0, proc.stderr
+            cli_out = json.loads(proc.stdout)
+            cli_nodes = {r["labels"].get("node_id")
+                         for r in cli_out["rows"]}
+            assert workers <= cli_nodes
+            proc = subprocess.run(
+                [sys.executable, "-m", "ray_tpu", "metrics",
+                 "alerts", "--address", c.head_address, "--json"],
+                capture_output=True, text=True, timeout=60)
+            assert proc.returncode == 0, proc.stderr
+            assert "stuck-detector" in proc.stdout
+            compiled.teardown()
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+
+    @pytest.mark.chaos
+    def test_default_stuck_alert_fires_under_chaos_stall(
+            self, shutdown_only, monkeypatch):
+        """Acceptance: the SHIPPED stuck-detector rule (no bespoke
+        rule installed) fires during a chaos-stalled dispatch — the
+        snapshot counter travels worker registry → EventShipper →
+        head TSDB → alert loop → pubsub — and CLEARS once the stall's
+        snapshots age out of the (env-shrunk) window."""
+        monkeypatch.setenv("RAY_TPU_ALERT_EVAL_S", "0.2")
+        monkeypatch.setenv("RAY_TPU_ALERT_STUCK_WINDOW_S", "5")
+        from ray_tpu.cluster.cluster_utils import Cluster
+        from ray_tpu.exceptions import DeadlineExceededError
+        from ray_tpu.experimental import chaos
+        from ray_tpu.observability import profiling
+
+        profiling.clear_stuck_snapshots()
+        ray_tpu.shutdown()
+        c = Cluster()
+        rt = c.connect(num_cpus=4)
+        try:
+            @ray_tpu.remote
+            class Slow:
+                def work(self):
+                    return "done"
+
+            s = Slow.remote()
+            sched = chaos.schedule().slow_method("work", 2.5)
+            with sched:
+                with pytest.raises(DeadlineExceededError):
+                    ray_tpu.get(
+                        s.work.options(deadline_s=0.3).remote(),
+                        timeout=30)
+            assert sched.fired("actor_slow") == 1
+            head = rt.cluster.head
+            cursor = 0
+            deadline = time.monotonic() + 40.0
+            fired = None
+            while fired is None:
+                assert time.monotonic() < deadline, \
+                    "stuck-detector alert never fired"
+                out = head.call("pubsub_poll", {
+                    "cursors": {"alerts": cursor}, "timeout_s": 1.0})
+                ch = (out or {}).get("alerts")
+                if not ch:
+                    continue
+                cursor = ch["seq"]
+                for ev in ch["events"]:
+                    if (ev["rule"] == "stuck-detector"
+                            and ev["state"] == "firing"):
+                        fired = ev
+            out = tsdb_mod.query_cluster(
+                rt.cluster,
+                "increase(ray_tpu_stuck_detector_snapshots)[60s] "
+                "by (node_id)")
+            assert out["rows"] and out["rows"][0]["value"] >= 1.0
+            # --- and CLEARS: the snapshot ages out of the 5s window.
+            deadline = time.monotonic() + 40.0
+            cleared = None
+            while cleared is None:
+                assert time.monotonic() < deadline, \
+                    "stuck-detector alert never cleared"
+                out = head.call("pubsub_poll", {
+                    "cursors": {"alerts": cursor}, "timeout_s": 1.0})
+                ch = (out or {}).get("alerts")
+                if not ch:
+                    continue
+                cursor = ch["seq"]
+                for ev in ch["events"]:
+                    if (ev["rule"] == "stuck-detector"
+                            and ev["state"] == "cleared"):
+                        cleared = ev
+            st = head.call("alerts_status", {})
+            assert not [a for a in st["active"]
+                        if a["rule"] == "stuck-detector"]
+            # Both transitions visible as merged-timeline instants on
+            # the head lane, and the gauge is back to 0.
+            tl = head.call("cluster_timeline", {"with_logs": False})
+            states = [e["args"]["state"] for e in tl["events"]
+                      if e["name"] == "alert:stuck-detector"]
+            assert "firing" in states and "cleared" in states
+            from ray_tpu.observability.metrics import metrics_summary
+
+            gauge = metrics_summary()["ray_tpu_alerts_firing"]
+            assert gauge.get("stuck-detector") == 0.0
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
