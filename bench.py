@@ -17,23 +17,13 @@ import time
 
 
 def _peak_bf16_flops(device_kind: str):
-    """Per-chip bf16 peak by device kind (public TPU spec sheets)."""
-    kind = device_kind.lower()
-    table = [
-        ("v6", 918e12),          # Trillium / v6e
-        ("v5 lite", 197e12),     # v5e (394 is the int8 number)
-        ("v5litepod", 197e12),
-        ("v5e", 197e12),
-        ("v5p", 459e12),
-        ("v5", 459e12),          # bare v5 → assume v5p
-        ("v4", 275e12),
-        ("v3", 123e12),
-        ("v2", 46e12),
-    ]
-    for key, flops in table:
-        if key in kind:
-            return flops
-    return None
+    """Per-chip bf16 peak by device kind — ONE table, owned by the
+    device plane (observability/device.py) so the live MFU gauges and
+    these offline bench/profile_mfu numbers can never disagree about
+    the same hardware."""
+    from ray_tpu.observability.device import peak_bf16_flops
+
+    return peak_bf16_flops(device_kind)
 
 
 # The paged baseline's pool shape, written ONCE: the dense cache's
@@ -547,6 +537,31 @@ def _obs_overhead_bench(n_pairs: int = 220) -> dict:
         "ray_tpu.observability.tracing", "obs_overhead_pct",
         "obs_traced_roundtrip_us", "obs_untraced_roundtrip_us",
         n_pairs)
+
+
+def _device_telemetry_overhead_bench(n_pairs: int = 220) -> dict:
+    """Device-plane overhead on ``dag_roundtrip_us`` (guard:
+    device_telemetry_overhead_pct < 5).  The plane's steady-state cost
+    is the sampler tick (live-arrays walk / memory_stats) plus the
+    per-hot-loop annotation probe; sampling is forced to 20 Hz
+    cluster-wide (workers inherit the env) so the paired passes
+    actually overlap sampler ticks — at the production 1 Hz default
+    the phase would mostly measure nothing."""
+    import os as _os
+
+    prev = _os.environ.get("RAY_TPU_DEVICE_SAMPLE_S")
+    _os.environ["RAY_TPU_DEVICE_SAMPLE_S"] = "0.05"
+    try:
+        return _paired_overhead_bench(
+            "ray_tpu.observability.device",
+            "device_telemetry_overhead_pct",
+            "device_on_roundtrip_us", "device_off_roundtrip_us",
+            n_pairs)
+    finally:
+        if prev is None:
+            _os.environ.pop("RAY_TPU_DEVICE_SAMPLE_S", None)
+        else:
+            _os.environ["RAY_TPU_DEVICE_SAMPLE_S"] = prev
 
 
 def _log_plane_overhead_bench(n_pairs: int = 220) -> dict:
@@ -1363,6 +1378,14 @@ def main():
         extra.update(_log_plane_overhead_bench())
     except Exception as e:  # noqa: BLE001
         extra["log_plane_overhead_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: device telemetry overhead phase start",
+          file=sys.stderr, flush=True)
+    try:
+        extra.update(_device_telemetry_overhead_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["device_telemetry_overhead_error"] = \
+            f"{type(e).__name__}: {e}"
 
     print("bench: tsdb phase start", file=sys.stderr, flush=True)
     try:

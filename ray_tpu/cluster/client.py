@@ -2203,6 +2203,7 @@ class NodeServer:
             "tail_log": self._tail_log,
             "node_state": self._node_state,
             "profile": self._profile,
+            "device_trace": self._device_trace,
             "ping": lambda p: "pong",  # raylint: disable=rpc-protocol -- liveness probe for out-of-package callers (tests, ops tooling, channel peer probing)
         }, ordered={"actor_call"})
         self.address = self._server.address
@@ -2554,6 +2555,36 @@ class NodeServer:
             duration_s=float(p.get("duration_s", 1.0)),
             interval_s=float(p.get("interval_s", 0.01)),
             thread_filter=p.get("thread_filter"))
+
+    def _device_trace(self, p):
+        """Capture a device profile of THIS node process
+        (jax.profiler start/stop_trace, observability/device.py) and
+        ship the zipped artifact to the head's bounded store, where
+        `ray_tpu profile --device` / /api/profile?device=1 download
+        it.  ``inline=True`` ALSO returns the bytes in this reply —
+        capture-and-download callers (dashboard, CLI -o) then move
+        the zip once, node→caller, instead of re-fetching it from a
+        store that may have already evicted it."""
+        from ..observability.device import capture_device_trace
+
+        art = capture_device_trace(
+            duration_s=float(p.get("duration_s", 1.0)))
+        reply = {"name": art["name"], "bytes": len(art["data"]),
+                 "files": art["files"], "trace_id": art["trace_id"],
+                 "node_id": self.client.node_id, "shipped": False}
+        if p.get("ship", True):
+            self.client.head.call("put_artifact", {
+                "name": art["name"], "data": art["data"],
+                "meta": {"kind": "device_trace",
+                         "node_id": self.client.node_id,
+                         "files": art["files"],
+                         "trace_id": art["trace_id"],
+                         "duration_s": art["duration_s"]}},
+                timeout=60.0)
+            reply["shipped"] = True
+        if p.get("inline"):
+            reply["data"] = art["data"]
+        return reply
 
     def _tail_log(self, p):
         """Tail this node's log file (reference: the dashboard log

@@ -243,6 +243,15 @@ class HeadServer:
             "RAY_TPU_HEAD_LOGS_MAX", "50000"))
         self._node_logs: Dict[str, Any] = {}
         self._events_lock = threading.Lock()
+        # Device-plane profile artifacts (zipped jax.profiler trace
+        # bundles shipped by the node ``device_trace`` RPC): a
+        # byte-capped drop-oldest store — artifacts are a download
+        # window, not a ledger (observability/device.py).
+        self._artifact_bytes_max = int(_os.environ.get(
+            "RAY_TPU_HEAD_ARTIFACT_BYTES", str(64 << 20)))
+        self._artifacts: "_collections.OrderedDict[str, Dict]" = \
+            _collections.OrderedDict()
+        self._artifacts_lock = threading.Lock()
         self._deque = _collections.deque
         # After a restart, actors replay before their nodes reattach:
         # give nodes one lease of grace before declaring them dead.
@@ -441,6 +450,12 @@ class HeadServer:
             # CLI `ray_tpu metrics`, dashboard /api/metrics/query +
             # /api/alerts, tsdb.query_cluster).
             "metrics_query": self._metrics_query,
+            # Device-trace artifact store (put: the node device_trace
+            # RPC after a capture; get/list: CLI `ray_tpu profile
+            # --device` and the dashboard /api/profile?device=1).
+            "put_artifact": self._put_artifact,
+            "get_artifact": self._get_artifact,
+            "list_artifacts": self._list_artifacts,
             "alerts_status": self._alerts_status,
             "alert_rules": self._alert_rules,  # raylint: disable=rpc-protocol -- rule add/remove is driven by tests and ops tooling (out of package); the read surfaces ride metrics_query/alerts_status
             # Replicated-head protocol (replication.py is the caller
@@ -1623,6 +1638,41 @@ class HeadServer:
             return {"names": self._tsdb.series_names(),
                     "stats": self._tsdb.stats()}
         return self._tsdb.query(p.get("expr", ""))
+
+    # ----------------------------------------- device-trace artifacts
+    def _put_artifact(self, p):
+        """Store one profile artifact (device-trace zip) in the
+        byte-capped drop-oldest window.  Re-putting a name replaces
+        it (a retried ship must not double-count the cap)."""
+        name = str(p["name"])
+        data = p.get("data") or b""
+        meta = dict(p.get("meta") or {})
+        meta.setdefault("ts", time.time())
+        meta["bytes"] = len(data)
+        with self._artifacts_lock:
+            self._artifacts.pop(name, None)
+            self._artifacts[name] = {"data": data, "meta": meta}
+            total = sum(a["meta"]["bytes"]
+                        for a in self._artifacts.values())
+            while total > self._artifact_bytes_max \
+                    and len(self._artifacts) > 1:
+                _old, dropped = self._artifacts.popitem(last=False)
+                total -= dropped["meta"]["bytes"]
+        return {"ok": True, "name": name, "bytes": len(data)}
+
+    def _get_artifact(self, p):
+        name = str(p.get("name", ""))
+        with self._artifacts_lock:
+            art = self._artifacts.get(name)
+            if art is None:
+                return {"found": False}
+            return {"found": True, "name": name,
+                    "data": art["data"], "meta": dict(art["meta"])}
+
+    def _list_artifacts(self, _p):
+        with self._artifacts_lock:
+            return [{"name": name, **a["meta"]}
+                    for name, a in self._artifacts.items()]
 
     def _alerts_status(self, _p):
         """Declared rules + currently pending/firing instances."""

@@ -111,6 +111,12 @@ class Runtime:
         from ..observability import logs as _logs_mod
 
         _logs_mod.install()
+        # Device-plane telemetry (observability/device.py): a sampler
+        # thread that idles until this process imports jax, then ships
+        # HBM gauges + XLA compile events on the EventShipper rails.
+        from ..observability import device as _device_mod
+
+        _device_mod.install()
 
         self._driver_task_id = TaskID.for_driver(self.job_id)
         self._put_counters: Dict[TaskID, int] = {}

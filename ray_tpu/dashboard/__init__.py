@@ -29,7 +29,9 @@ Endpoints:
   /api/alerts       alert plane: declared rules + pending/firing
                     instances (head alerts_status)
   /api/profile      sampling profile (?node=&duration=&thread=
-                    &format=collapsed|chrome)
+                    &format=collapsed|chrome); ?device=1 captures /
+                    downloads a DEVICE trace zip (&artifact=<name>
+                    fetches one from the head store)
   /api/timeline     Chrome trace JSON (open in perfetto)
   /metrics          Prometheus text exposition
 """
@@ -221,6 +223,47 @@ def _profile_api(params: Dict[str, str]):
     return profile_process(duration, interval, thread)
 
 
+def _device_profile_api(params: Dict[str, str]):
+    """``/api/profile?device=1``: download a stored device-trace
+    artifact (``&artifact=<name>``, the head store), or capture one
+    now (``&node=&duration=`` drives the node ``device_trace`` RPC —
+    the artifact also lands in the head store) and return its zip
+    bytes.  Returns (filename, bytes)."""
+    from ..core.runtime import get_runtime
+
+    rt = get_runtime()
+    name = params.get("artifact")
+    if name:
+        if rt.cluster is None:
+            raise KeyError("artifact store needs cluster mode")
+        art = rt.cluster.head.call("get_artifact", {"name": name},
+                                   timeout=60.0)
+        if not art.get("found"):
+            raise KeyError(f"no artifact {name!r}")
+        return name, art["data"]
+    duration = min(float(params.get("duration", 1.0)), 30.0)
+    node = params.get("node") or None
+    if rt.cluster is None:
+        from ..observability.device import capture_device_trace
+
+        art = capture_device_trace(duration)
+        return art["name"], art["data"]
+    for n in rt.cluster.list_nodes():
+        if node and not (n["node_id"].startswith(node)
+                         or n.get("name") == node):
+            continue
+        if not node and n["node_id"] != rt.cluster.node_id:
+            continue
+        # inline=True: the zip rides the capture reply (one transfer,
+        # no race against store eviction); it ALSO lands in the head
+        # store for later ?artifact= fetches.
+        prof = rt.cluster.pool.get(n["address"]).call(
+            "device_trace", {"duration_s": duration, "inline": True},
+            timeout=duration + 60.0)
+        return prof["name"], prof["data"]
+    raise KeyError(f"no node matching {node!r}")
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -274,6 +317,17 @@ class _Handler(BaseHTTPRequestHandler):
                         code=400)
                 return self._send_json(rt.cluster.head.call(
                     "alerts_status", {}, timeout=15.0))
+            if self.path == "/api/profile" and \
+                    params.get("device") not in (None, "", "0"):
+                name, data = _device_profile_api(params)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/zip")
+                self.send_header("Content-Disposition",
+                                 f'attachment; filename="{name}"')
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             if self.path == "/api/profile":
                 prof = _profile_api(params)
                 if params.get("format") == "collapsed":

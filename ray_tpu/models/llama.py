@@ -718,7 +718,8 @@ def make_train_step(config: LlamaConfig, optimizer=None,
             return new_state, {"loss": loss, "grad_norm": gnorm,
                                "step": new_state["step"]}
 
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
+        return _annotate_step(
+            jax.jit(step, donate_argnums=(0,) if donate else ()))
 
     if optimizer is None:
         optimizer = default_optimizer(learning_rate)
@@ -735,7 +736,37 @@ def make_train_step(config: LlamaConfig, optimizer=None,
         return new_state, {"loss": loss, "grad_norm": gnorm,
                            "step": new_state["step"]}
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return _annotate_step(
+        jax.jit(step, donate_argnums=(0,) if donate else ()))
+
+
+class _AnnotatedStep:
+    """Stamp each dispatch of the jitted train step with a
+    ``jax.profiler.TraceAnnotation`` carrying the ambient trace id
+    (observability/device.py): a device trace captured mid-training
+    shows ``train.step#trace=<id>`` slices that correlate with the
+    cluster timeline.  No-op cost when the device plane is disabled
+    (shared nullcontext); everything else of the jitted program's
+    surface (``lower``/``trace``/donation semantics) passes through
+    untouched via delegation."""
+
+    __slots__ = ("_jitted",)
+
+    def __init__(self, jitted: Callable):
+        self._jitted = jitted
+
+    def __call__(self, state, batch):
+        from ray_tpu.observability import device as _device
+
+        with _device.annotation("train.step"):
+            return self._jitted(state, batch)
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+def _annotate_step(jitted: Callable) -> Callable:
+    return _AnnotatedStep(jitted)
 
 
 # ---------------------------------------------------------------------------

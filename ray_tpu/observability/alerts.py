@@ -264,6 +264,7 @@ def default_rules() -> List[AlertRule]:
     Thresholds tune via ``RAY_TPU_ALERT_<NAME>`` env knobs (see
     docs/observability.md for the reference table)."""
     stuck_win = _env_f("RAY_TPU_ALERT_STUCK_WINDOW_S", 60.0)
+    xla_win = _env_f("RAY_TPU_ALERT_XLA_WINDOW_S", 120.0)
     return [
         AlertRule(
             "stuck-detector",
@@ -306,6 +307,25 @@ def default_rules() -> List[AlertRule]:
             description="a paged-KV pool is running out of free "
                         "blocks — decode batches are about to "
                         "preempt/shed"),
+        AlertRule(
+            "xla-recompile-storm",
+            f"increase(ray_tpu_xla_compiles_total)[{xla_win:g}s] "
+            f"by (node_id)",
+            ">", _env_f("RAY_TPU_ALERT_XLA_COMPILES", 30.0),
+            for_s=0.0, severity="warning",
+            description="sustained XLA recompilation on this node — "
+                        "shapes/buckets are churning and device time "
+                        "is going to the compiler, not the model "
+                        "(jit-in-hot-path hazard)"),
+        AlertRule(
+            "hbm-pressure",
+            "max_over_time(ray_tpu_device_hbm_utilization)[60s] "
+            "by (node_id)",
+            ">", _env_f("RAY_TPU_ALERT_HBM_UTIL", 0.92),
+            for_s=5.0, severity="critical",
+            description="a device on this node is near its HBM limit "
+                        "— allocations are about to OOM (or the paged "
+                        "KV pool is about to preempt)"),
         AlertRule(
             "head-repl-lag",
             "max_over_time(ray_tpu_head_repl_lag_entries)[30s]",

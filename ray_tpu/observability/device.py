@@ -1,0 +1,506 @@
+"""Device-plane telemetry: the accelerator half of the observability
+story.
+
+The host tiers (tracing/timeline, logs/profiling, TSDB/alerts) watch
+the *framework*; this module watches the *chips* it exists to drive —
+the signals MegaScale-style production diagnosis and Pathways-scale
+scheduling decisions read.  Four surfaces, one module:
+
+1. **HBM sampler** — a per-process daemon thread enumerates the local
+   JAX devices every ``RAY_TPU_DEVICE_SAMPLE_S`` seconds and sets the
+   ``ray_tpu_device_hbm_bytes_{used,peak,limit}`` /
+   ``ray_tpu_device_live_buffers`` gauges, which ride the existing
+   EventShipper flushes into the head TSDB (``last(...) by (node_id)``
+   answers "how much HBM does each node hold RIGHT NOW").  On TPU the
+   numbers come from ``device.memory_stats()`` (PJRT allocator stats);
+   on the CPU backend — where tier-1 runs — a live-arrays fallback
+   attributes ``jax.live_arrays()`` bytes per device, so the whole
+   pipeline (sampler → gauges → shipper → TSDB → query/alert) is
+   exercised without a chip.  The sampler NEVER imports jax itself: it
+   idles until the process does (``sys.modules`` check), so non-jax
+   workers pay one sleeping thread and nothing else.
+
+2. **XLA compile tracking** — a ``jax.monitoring`` duration listener
+   turns every backend compilation into a timeline span
+   (``xla_compile`` on this process's lane, stamped with the ambient
+   trace id) plus ``ray_tpu_xla_compiles_total`` and a duration
+   histogram.  The shipped ``xla-recompile-storm`` default alert
+   (observability/alerts.py) fires on a sustained compile rate — the
+   "my bucketing is churning shapes" failure mode that silently turns
+   a serving replica into a compile farm.
+
+3. **Device-trace capture** — :func:`capture_device_trace` drives
+   ``jax.profiler.start_trace``/``stop_trace`` and zips the resulting
+   TensorBoard-loadable bundle (xplane.pb + trace.json.gz) into one
+   artifact; the node RPC ``device_trace`` (cluster/client.py) runs it
+   remotely and ships the artifact to the head's bounded store, where
+   ``ray_tpu profile --device`` and ``/api/profile?device=1`` fetch
+   it.  :func:`annotation` stamps host-side hot loops (train step,
+   serve decode chunk) with ``jax.profiler.TraceAnnotation`` carrying
+   the ambient trace id, so a device trace correlates with the cluster
+   timeline by id.
+
+4. **Model-plane series** — :func:`record_train_step` (per-step
+   tokens/s + MFU from the train loop) and :func:`record_program_ema`
+   (the serve engine's per-program execution-time EMAs) make the
+   numbers ``profile_mfu.py`` measures offline continuously queryable;
+   ``ray_tpu top`` renders them live.
+
+``disable()`` turns sampling, the compile listener, and annotations
+into no-ops — the ``device_telemetry_overhead_pct`` bench phase
+measures the plane's cost that way (guard < 5%).
+
+Env knobs:
+  RAY_TPU_DEVICE_TELEMETRY       0 disables the whole plane
+  RAY_TPU_DEVICE_SAMPLE_S        sampler period (default 1.0)
+  RAY_TPU_DEVICE_HBM_LIMIT_BYTES fallback per-device limit when the
+                                 backend reports none (CPU; 0 = unknown)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_enabled = os.environ.get("RAY_TPU_DEVICE_TELEMETRY", "1").lower() \
+    not in ("0", "false", "off")
+
+DEFAULT_SAMPLE_S = 1.0
+
+
+def _sample_period() -> float:
+    """Sampler period, re-read per tick: tests and the overhead bench
+    retune RAY_TPU_DEVICE_SAMPLE_S on a process whose sampler thread
+    already runs."""
+    try:
+        return max(0.02, float(os.environ.get(
+            "RAY_TPU_DEVICE_SAMPLE_S", DEFAULT_SAMPLE_S)))
+    except ValueError:
+        return DEFAULT_SAMPLE_S
+
+# Fallback per-device byte limit for backends whose memory_stats() is
+# unavailable (CPU): lets the hbm-pressure alert and the utilization
+# gauge be exercised in tier-1 by pointing the knob at a small number.
+_FALLBACK_LIMIT = int(os.environ.get(
+    "RAY_TPU_DEVICE_HBM_LIMIT_BYTES", "0"))
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    """No-op the plane (bench: measures its cost paired on/off)."""
+    global _enabled
+    _enabled = False
+
+
+def _device_metrics():
+    from . import metrics as _metrics
+
+    return _metrics.metric_group("device", lambda: {
+        "hbm_used": _metrics.Gauge(
+            "ray_tpu_device_hbm_bytes_used",
+            "accelerator memory in use (device.memory_stats on TPU; "
+            "live-array bytes on the CPU fallback)",
+            tag_keys=("device",)),
+        "hbm_peak": _metrics.Gauge(
+            "ray_tpu_device_hbm_bytes_peak",
+            "peak accelerator memory in use since process start",
+            tag_keys=("device",)),
+        "hbm_limit": _metrics.Gauge(
+            "ray_tpu_device_hbm_bytes_limit",
+            "accelerator memory capacity (0 when the backend reports "
+            "none and no fallback limit is configured)",
+            tag_keys=("device",)),
+        "hbm_util": _metrics.Gauge(
+            "ray_tpu_device_hbm_utilization",
+            "used / limit (only exported when the limit is known) — "
+            "the hbm-pressure default alert reads this",
+            tag_keys=("device",)),
+        "live_buffers": _metrics.Gauge(
+            "ray_tpu_device_live_buffers",
+            "live device buffers (allocator count on TPU; live "
+            "jax.Array count on the CPU fallback)",
+            tag_keys=("device",)),
+        "xla_compiles": _metrics.Counter(
+            "ray_tpu_xla_compiles_total",
+            "XLA backend compilations observed via jax.monitoring "
+            "(a sustained rate is a recompilation storm)",
+            tag_keys=("kind",)),
+        "xla_compile_seconds": _metrics.Histogram(
+            "ray_tpu_xla_compile_seconds",
+            "XLA backend compilation wall time",
+            boundaries=[0.01, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0]),
+    })
+
+
+def model_plane_metrics():
+    """The model-plane gauges (train step + serve engine hot loops):
+    the live counterpart of ``profile_mfu.py``'s offline numbers."""
+    from . import metrics as _metrics
+
+    return _metrics.metric_group("model_plane", lambda: {
+        "train_tokens_per_s": _metrics.Gauge(
+            "ray_tpu_train_tokens_per_s",
+            "per-step training throughput (tokens processed / step "
+            "wall time), set by the train hot loop every step"),
+        "train_mfu": _metrics.Gauge(
+            "ray_tpu_train_mfu",
+            "per-step model FLOP/s utilization (6N approximation "
+            "against the chip's bf16 roofline; only exported where "
+            "the roofline is known — not on CPU)"),
+        "train_step_seconds": _metrics.Gauge(
+            "ray_tpu_train_step_seconds",
+            "last training step wall time"),
+        "program_ema": _metrics.Gauge(
+            "ray_tpu_serve_program_seconds",
+            "serve engine per-program execution-time EMA (prefill / "
+            "decode_chunk / spec_round)",
+            tag_keys=("deployment", "program")),
+    })
+
+
+def peak_bf16_flops(device_kind: str) -> Optional[float]:
+    """Per-chip bf16 peak by device kind (public TPU spec sheets);
+    None for unknown kinds (CPU) — callers skip the MFU gauge then."""
+    kind = (device_kind or "").lower()
+    table = [
+        ("v6", 918e12),          # Trillium / v6e
+        ("v5 lite", 197e12),     # v5e (394 is the int8 number)
+        ("v5litepod", 197e12),
+        ("v5e", 197e12),
+        ("v5p", 459e12),
+        ("v5", 459e12),          # bare v5 -> assume v5p
+        ("v4", 275e12),
+        ("v3", 123e12),
+        ("v2", 46e12),
+    ]
+    for key, flops in table:
+        if key in kind:
+            return flops
+    return None
+
+
+# ------------------------------------------------------------- sampler
+
+# Peak tracking for the live-arrays fallback (memory_stats carries its
+# own peak; the fallback must remember the high-water mark itself).
+_fallback_peak: Dict[str, int] = {}
+
+
+def sample_devices() -> Optional[List[Dict[str, Any]]]:
+    """One sample of every local device: ``{device, platform, used,
+    peak, limit, live_buffers}`` per device.  Returns None when jax is
+    not loaded in this process (the sampler must never force the
+    import — that is the worker's decision)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return None  # backend not initialized yet
+    stats_by_dev = {}
+    for dev in devices:
+        try:
+            stats_by_dev[dev] = dev.memory_stats()
+        except Exception:
+            stats_by_dev[dev] = None
+    if any(s is None for s in stats_by_dev.values()):
+        fallback = _live_array_bytes(jax)
+    else:
+        fallback = {}
+    out = []
+    for dev in devices:
+        name = str(dev)
+        stats = stats_by_dev[dev]
+        if stats:
+            used = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", used))
+            limit = int(stats.get("bytes_limit")
+                        or stats.get("bytes_reservable_limit") or 0)
+            bufs = int(stats.get("num_allocs", 0))
+        else:
+            used, bufs = fallback.get(name, (0, 0))
+            peak = max(_fallback_peak.get(name, 0), used)
+            _fallback_peak[name] = peak
+            limit = _FALLBACK_LIMIT
+        out.append({"device": name, "platform": dev.platform,
+                    "used": used, "peak": peak, "limit": limit,
+                    "live_buffers": bufs})
+    return out
+
+
+def _live_array_bytes(jax) -> Dict[str, tuple]:
+    """{device name: (bytes, count)} attributed from jax.live_arrays()
+    — the CPU-backend stand-in for allocator stats.  Multi-device
+    (sharded) arrays split their bytes evenly across holders."""
+    acc: Dict[str, List[int]] = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return {}
+    for arr in arrays:
+        try:
+            devs = list(arr.devices())
+            per = int(arr.nbytes) // max(1, len(devs))
+        except Exception:
+            continue
+        for d in devs:
+            slot = acc.setdefault(str(d), [0, 0])
+            slot[0] += per
+            slot[1] += 1
+    return {k: (v[0], v[1]) for k, v in acc.items()}
+
+
+def sample_once() -> Optional[List[Dict[str, Any]]]:
+    """Sample and publish the device gauges (one sampler tick).  Also
+    the moment the compile listener installs — jax just proved it is
+    importable."""
+    if not _enabled:
+        return None
+    samples = sample_devices()
+    if samples is None:
+        return None
+    _install_compile_listener()
+    m = _device_metrics()
+    for s in samples:
+        tags = {"device": s["device"]}
+        m["hbm_used"].set(float(s["used"]), tags=tags)
+        m["hbm_peak"].set(float(s["peak"]), tags=tags)
+        m["hbm_limit"].set(float(s["limit"]), tags=tags)
+        m["live_buffers"].set(float(s["live_buffers"]), tags=tags)
+        if s["limit"] > 0:
+            m["hbm_util"].set(s["used"] / s["limit"], tags=tags)
+    return samples
+
+
+_sampler_lock = threading.Lock()
+_sampler_stop: Optional[threading.Event] = None
+
+
+def install() -> None:
+    """Start the per-process sampler thread (idempotent; called at
+    Runtime boot next to the structured-log handler).  The thread
+    no-ops until jax is imported, so boot stays jax-free."""
+    global _sampler_stop
+    with _sampler_lock:
+        if _sampler_stop is not None:
+            return
+        _sampler_stop = threading.Event()
+        t = threading.Thread(target=_sampler_loop,
+                             args=(_sampler_stop,), daemon=True,
+                             name="device-sampler")
+        t.start()
+
+
+def uninstall() -> None:
+    """Stop the sampler thread (tests)."""
+    global _sampler_stop
+    with _sampler_lock:
+        if _sampler_stop is not None:
+            _sampler_stop.set()
+            _sampler_stop = None
+
+
+def _sampler_loop(stop: threading.Event) -> None:
+    while not stop.wait(_sample_period()):
+        try:
+            sample_once()
+        except Exception:
+            pass  # one bad tick must not kill the plane
+
+
+# ---------------------------------------------------- compile tracking
+
+_listener_installed = False
+_listener_lock = threading.Lock()
+
+# The jax.monitoring event that marks one XLA backend compilation.
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+
+def _install_compile_listener() -> None:
+    """Register the jax.monitoring duration listener once per process.
+    jax offers no unregister, so the callback itself gates on
+    ``_enabled`` (disable() must be a true no-op for the bench)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    with _listener_lock:
+        if _listener_installed:
+            return
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return
+        try:
+            from jax import monitoring
+        except Exception:
+            return
+        monitoring.register_event_duration_secs_listener(_on_xla_event)
+        _listener_installed = True
+
+
+def _on_xla_event(name: str, duration_s: float, **_kw) -> None:
+    """One jax.monitoring duration event.  Only backend compiles are
+    counted (jaxpr tracing / MLIR lowering are host-side sub-phases of
+    the same compilation and would triple-count it)."""
+    if not _enabled or not name.endswith(_COMPILE_EVENT_SUFFIX):
+        return
+    try:
+        m = _device_metrics()
+        m["xla_compiles"].inc(tags={"kind": "backend_compile"})
+        m["xla_compile_seconds"].observe(float(duration_s))
+        from . import tracing
+        from .timeline import process_pid, record_span
+
+        now = time.time()
+        args: Dict[str, Any] = {"duration_s": round(duration_s, 4)}
+        ctx = tracing.current()
+        if ctx is not None:
+            args["trace_id"] = ctx[0]
+        record_span("xla_compile", now - duration_s, now,
+                    pid=process_pid(), tid="xla-compile", args=args)
+    except Exception:
+        pass  # telemetry must never break a compile
+
+
+# ------------------------------------------------ device-trace capture
+
+_capture_lock = threading.Lock()
+
+
+def capture_device_trace(duration_s: float = 1.0,
+                         tmp_root: Optional[str] = None
+                         ) -> Dict[str, Any]:
+    """Capture a device profile of THIS process for ``duration_s``:
+    ``jax.profiler.start_trace`` → sleep → ``stop_trace``, then zip
+    the TensorBoard-loadable output directory into one artifact.
+    Returns ``{name, data (zip bytes), files, duration_s, trace_id}``.
+    Serialized per process — jax allows one active trace."""
+    import shutil
+    import tempfile
+    import zipfile
+
+    import jax  # explicit request: importing here is the point
+
+    from . import tracing
+
+    duration_s = min(max(float(duration_s), 0.05), 60.0)
+    ctx = tracing.current()
+    trace_id = ctx[0] if ctx else None
+    with _capture_lock:
+        out_dir = tempfile.mkdtemp(prefix="ray_tpu_devtrace_",
+                                   dir=tmp_root)
+        try:
+            jax.profiler.start_trace(out_dir)
+            try:
+                # The sleep IS the capture window, and the lock exists
+                # exactly to serialize it: jax allows one active trace
+                # per process, and nothing else ever takes this lock.
+                time.sleep(duration_s)  # raylint: disable=blocking-under-lock -- dedicated capture lock; the bounded sleep is the capture window itself
+            finally:
+                jax.profiler.stop_trace()
+            buf = io.BytesIO()
+            files = 0
+            with zipfile.ZipFile(buf, "w",
+                                 zipfile.ZIP_DEFLATED) as zf:
+                for root, _dirs, names in os.walk(out_dir):
+                    for fname in sorted(names):
+                        path = os.path.join(root, fname)
+                        zf.write(path, os.path.relpath(path, out_dir))
+                        files += 1
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+    name = "device-trace-%d-%d.zip" % (os.getpid(),
+                                       int(time.time() * 1000))
+    return {"name": name, "data": buf.getvalue(), "files": files,
+            "duration_s": duration_s, "trace_id": trace_id}
+
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` carrying the ambient trace
+    id (``name#trace=<id>``) so device-trace slices correlate with the
+    cluster timeline — or a shared no-op context when the plane is
+    disabled or jax is not loaded.  Cheap enough for per-chunk hot
+    loops: one dict probe + one string concat when active."""
+    if not _enabled:
+        return _NULL_CTX
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return _NULL_CTX
+    try:
+        from . import tracing
+
+        ctx = tracing.current()
+        if ctx is not None:
+            name = f"{name}#trace={ctx[0]}"
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return _NULL_CTX
+
+
+# ---------------------------------------------------- model-plane emit
+
+def record_train_step(tokens: int, step_s: float,
+                      n_params: Optional[int] = None,
+                      device_kind: Optional[str] = None,
+                      n_devices: int = 1) -> None:
+    """Publish one training step's model-plane gauges: tokens/s
+    always, MFU when the chip roofline is known (6N dense-LM
+    approximation — the same convention as bench.py /
+    profile_mfu.py).  ``tokens`` is the WHOLE step's token count, so
+    a multi-chip gang must pass its ``device_kind`` and distinct
+    chip count — the roofline denominator is per chip, and
+    resolving it from THIS process's devices would be the driver's
+    CPU, not the gang's accelerators.  Called from the train hot
+    loop; must never raise."""
+    if not _enabled or step_s <= 0:
+        return
+    try:
+        m = model_plane_metrics()
+        tps = tokens / step_s
+        m["train_tokens_per_s"].set(tps)
+        m["train_step_seconds"].set(step_s)
+        if device_kind is None:
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                try:
+                    device_kind = jax.local_devices()[0].device_kind
+                except Exception:
+                    device_kind = None
+        if n_params and device_kind:
+            peak = peak_bf16_flops(device_kind)
+            if peak:
+                m["train_mfu"].set(
+                    tps * 6 * n_params / (peak * max(1, n_devices)))
+    except Exception:
+        pass
+
+
+def record_program_ema(deployment: str, program: str,
+                       seconds: Optional[float]) -> None:
+    """Publish a serve-engine per-program execution-time EMA gauge
+    (prefill / decode_chunk / spec_round).  Must never raise."""
+    if not _enabled or seconds is None:
+        return
+    try:
+        model_plane_metrics()["program_ema"].set(
+            float(seconds), tags={"deployment": deployment,
+                                  "program": program})
+    except Exception:
+        pass

@@ -8,6 +8,9 @@ Commands:
   start --head [--port P] [--storage PATH]      run a head (blocking)
   start --address H:P [--num-cpus N] [...]      run a worker node
   status --address H:P                          cluster summary
+                                                (+ per-node device HBM)
+  top --address H:P [--once] [--interval S]     live cluster view
+                                                (HBM/occupancy/queues)
   dashboard --address H:P [--port 8265]         web dashboard
   client-proxy --address H:P [--port 10001]     thin-driver proxy
   list (nodes|actors|jobs|tasks|objects) ...    state listings
@@ -82,7 +85,51 @@ def cmd_status(args) -> int:
             print(f"  {k}: {avail.get(k, 0):g}/{totals[k]:g} available")
     actors = rt.cluster.head.call("list_actors", {})
     print(f"{len(actors)} registered actors")
+    _print_device_summary(rt, nodes)
     return 0
+
+
+def _query_by_node(rt, expr: str):
+    """{node_id: value} for one head TSDB expression grouped by
+    node_id; {} when the head has no matching history (device plane
+    idle, jax never imported, pre-TSDB head)."""
+    try:
+        resp = rt.cluster.head.call("metrics_query", {"expr": expr},
+                                    timeout=15.0)
+        return {r["labels"].get("node_id", ""): r["value"]
+                for r in resp["rows"]}
+    except Exception:  # raylint: disable=ft-exception-swallow -- any-failure → empty column is the design: status/top must render on clusters with no TSDB rows (or a pre-TSDB head)
+        return {}
+
+
+def _fmt_gb(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v / 1e9:.2f}G" if v >= 1e8 else f"{v / 1e6:.1f}M"
+
+
+def _print_device_summary(rt, nodes) -> None:
+    """The per-node device column of ``ray_tpu status``: HBM
+    used/limit + live buffers from the shipped device-plane series
+    (observability/device.py).  Silent when no node ever sampled a
+    device — status must not regress on jax-free clusters."""
+    used = _query_by_node(rt, "last(ray_tpu_device_hbm_bytes_used)"
+                              "[120s] by (node_id)")
+    if not used:
+        return
+    limit = _query_by_node(rt, "last(ray_tpu_device_hbm_bytes_limit)"
+                               "[120s] by (node_id)")
+    bufs = _query_by_node(rt, "last(ray_tpu_device_live_buffers)"
+                              "[120s] by (node_id)")
+    print("device hbm (used/limit, live buffers):")
+    by_id = {n["node_id"]: n for n in nodes}
+    for nid in sorted(used):
+        n = by_id.get(nid, {})
+        label = n.get("name") or nid[:12]
+        lim = limit.get(nid)
+        lim_s = _fmt_gb(lim) if lim else "?"
+        print(f"  {label}: hbm {_fmt_gb(used[nid])}/{lim_s} "
+              f"buffers {bufs.get(nid, 0):g}")
 
 
 def cmd_list(args) -> int:
@@ -119,6 +166,15 @@ def cmd_list(args) -> int:
             filters={"trace_id": trace_id, "state": state_f})
     elif args.what == "objects":
         rows = _gather_node_state(rt, "objects", node=node)
+    elif args.what == "artifacts":
+        # Profile artifacts in the head store (device-trace zips):
+        # names here feed `profile --device -o` downloads and
+        # /api/profile?device=1&artifact=<name>.
+        rows = rt.cluster.head.call("list_artifacts", {},
+                                    timeout=15.0)
+        if node:
+            rows = [a for a in rows
+                    if str(a.get("node_id", "")).startswith(node)]
     else:
         print(f"unknown listing {args.what!r}", file=sys.stderr)
         return 2
@@ -264,9 +320,14 @@ def cmd_profile(args) -> int:
             return 1
         target_node = found["node_id"]
         thread_filter = thread_filter or f"actor-{args.actor}"
-    payload = {"duration_s": args.duration,
-               "interval_s": args.interval,
-               "thread_filter": thread_filter}
+    rpc = "device_trace" if args.device else "profile"
+    payload = ({"duration_s": args.duration,
+                # -o: bytes ride the capture reply (one transfer, no
+                # race against head-store eviction).
+                "inline": bool(args.output)} if args.device else
+               {"duration_s": args.duration,
+                "interval_s": args.interval,
+                "thread_filter": thread_filter})
     prof = None
     for n in rt.cluster.list_nodes():
         if target_node and not (n["node_id"].startswith(target_node)
@@ -275,11 +336,22 @@ def cmd_profile(args) -> int:
         if not target_node and n["node_id"] != rt.cluster.node_id:
             continue
         prof = rt.cluster.pool.get(n["address"]).call(
-            "profile", payload, timeout=args.duration + 30.0)
+            rpc, payload, timeout=args.duration + 60.0)
         break
     if prof is None:
         print(f"no node matching {target_node!r}", file=sys.stderr)
         return 1
+    if args.device:
+        # The capture shipped its zipped trace bundle to the head's
+        # artifact store; -o additionally downloads it here.
+        print(f"device trace {prof['name']}: {prof['bytes']} bytes, "
+              f"{prof['files']} files, node {prof['node_id'][:12]} "
+              f"(fetch: /api/profile?device=1&artifact={prof['name']})")
+        if args.output:
+            with open(args.output, "wb") as f:
+                f.write(prof["data"])
+            print(f"wrote {args.output}")
+        return 0
     body = (json.dumps(prof["chrome"]) if args.chrome
             else prof["collapsed"])
     if args.output:
@@ -346,6 +418,98 @@ def cmd_metrics(args) -> int:
               file=sys.stderr)
         return 0
     return 2
+
+
+def _top_snapshot(rt):
+    """One data frame for ``ray_tpu top``: node table + actor counts
+    + the device/model-plane series, all grouped by node_id (every
+    read is one head RPC — the view costs the cluster a handful of
+    TSDB queries per refresh, not a per-node fanout)."""
+    nodes = rt.cluster.list_nodes()
+    actors: dict = {}
+    try:
+        for a in rt.cluster.head.call("list_actors", {},
+                                      timeout=15.0):
+            if a.get("state", "ALIVE") == "ALIVE":
+                nid = str(a.get("node_id", ""))
+                actors[nid] = actors.get(nid, 0) + 1
+    except Exception:  # raylint: disable=ft-exception-swallow -- the actor column degrades to 0s rather than killing the live view mid-refresh
+        pass
+    q = lambda expr: _query_by_node(rt, expr)  # noqa: E731
+    return {
+        "nodes": nodes,
+        "actors": actors,
+        "hbm_used": q("last(ray_tpu_device_hbm_bytes_used)[120s] "
+                      "by (node_id)"),
+        "hbm_limit": q("last(ray_tpu_device_hbm_bytes_limit)[120s] "
+                       "by (node_id)"),
+        "bufs": q("last(ray_tpu_device_live_buffers)[120s] "
+                  "by (node_id)"),
+        "xla": q("increase(ray_tpu_xla_compiles_total)[60s] "
+                 "by (node_id)"),
+        "occupancy": q("last(ray_tpu_decode_batch_occupancy)[60s] "
+                       "by (node_id)"),
+        "qdepth": q("last(ray_tpu_queue_depth)[60s] by (node_id)"),
+        "train_tps": q("last(ray_tpu_train_tokens_per_s)[60s] "
+                       "by (node_id)"),
+    }
+
+
+def render_top(snap) -> str:
+    """Render one ``ray_tpu top`` frame as a fixed-column table
+    (pure: the render smoke test feeds it synthetic snapshots)."""
+    cols = ["NODE", "STATE", "ACTORS", "HBM USED/LIMIT", "BUFS",
+            "XLA/60s", "DECODE OCC", "QDEPTH", "TRAIN TOK/S"]
+    rows = []
+    for n in snap["nodes"]:
+        nid = n["node_id"]
+        used = snap["hbm_used"].get(nid)
+        limit = snap["hbm_limit"].get(nid)
+        hbm = "-"
+        if used is not None:
+            hbm = _fmt_gb(used) + "/" + (_fmt_gb(limit) if limit
+                                         else "?")
+        fmt = lambda d, g="%g": (  # noqa: E731
+            "-" if d.get(nid) is None else g % d[nid])
+        rows.append([
+            (n.get("name") or nid[:12]),
+            "ALIVE" if n.get("alive") else "DEAD",
+            str(snap["actors"].get(nid, 0)),
+            hbm,
+            fmt(snap["bufs"]),
+            fmt(snap["xla"], "%.0f"),
+            fmt(snap["occupancy"], "%.0f"),
+            fmt(snap["qdepth"], "%.0f"),
+            fmt(snap["train_tps"], "%.0f"),
+        ])
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
+              else len(c) for i, c in enumerate(cols)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in rows:
+        lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    alive = sum(1 for n in snap["nodes"] if n.get("alive"))
+    lines.append(f"{alive}/{len(snap['nodes'])} nodes alive · "
+                 f"{sum(snap['actors'].values())} actors · "
+                 f"{time.strftime('%H:%M:%S')}")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live cluster view: nodes x actors x HBM x decode occupancy x
+    queue depth, polling the head TSDB (``--once`` prints a single
+    frame for scripts/CI)."""
+    rt = _connect(args.address)
+    if args.once:
+        print(render_top(_top_snapshot(rt)))
+        return 0
+    try:
+        while True:
+            frame = render_top(_top_snapshot(rt))
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_dashboard(args) -> int:
@@ -430,6 +594,16 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_status)
 
+    p = sub.add_parser(
+        "top", help="live cluster view (nodes x actors x HBM x "
+                    "decode occupancy x queue depth, via the head "
+                    "TSDB)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (scripts/CI)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.set_defaults(fn=cmd_top)
+
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address", required=True)
     p.add_argument("--host", default="127.0.0.1")
@@ -445,7 +619,7 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("what", choices=["nodes", "actors", "jobs",
-                                    "tasks", "objects"])
+                                    "tasks", "objects", "artifacts"])
     p.add_argument("--address", required=True)
     p.add_argument("--trace-id", default="",
                    help="tasks: only rows of this distributed trace "
@@ -535,8 +709,14 @@ def main(argv=None) -> int:
     p.add_argument("--chrome", action="store_true",
                    help="emit Chrome-trace JSON instead of "
                         "collapsed stacks")
+    p.add_argument("--device", action="store_true",
+                   help="capture a DEVICE trace instead "
+                        "(jax.profiler start/stop_trace on the "
+                        "target node; the zipped TensorBoard bundle "
+                        "ships to the head artifact store)")
     p.add_argument("-o", "--output", default="",
-                   help="write to a file instead of stdout")
+                   help="write to a file instead of stdout "
+                        "(--device: download the trace zip here)")
     p.set_defaults(fn=cmd_profile)
 
     from ray_tpu.tools.raylint.cli import add_lint_parser

@@ -89,6 +89,7 @@ import numpy as np
 
 from ..core import deadlines as _deadlines
 from ..exceptions import BackPressureError, DeadlineExceededError
+from ..observability import device as _device
 
 # Prefill group sizes (prompts per call, padded with slot=-1).  Each
 # call costs a device round trip serialized against decode chunks, so
@@ -1177,9 +1178,10 @@ class LLMServer:
                 slots[j] = slot
                 members.append((j, slot, req))
             t0 = time.perf_counter()
-            self.cache, first = self._prefill(
-                self.params, self.cache, jnp.asarray(toks),
-                jnp.asarray(lens), jnp.asarray(slots))
+            with _device.annotation("serve.prefill"):
+                self.cache, first = self._prefill(
+                    self.params, self.cache, jnp.asarray(toks),
+                    jnp.asarray(lens), jnp.asarray(slots))
             self._pending_prefills.append((first, members, t0))
             return
         bs = self.block_size
@@ -1201,15 +1203,16 @@ class LLMServer:
                 pre_bt[j, :first_w] = table.blocks[:first_w]
             members.append((j, slot, req))
         t0 = time.perf_counter()
-        if warm:
-            self.pool, first = self._prefill_warm(
-                self.params, self.pool, jnp.asarray(toks),
-                jnp.asarray(lens), jnp.asarray(pos0s),
-                jnp.asarray(pre_bt), jnp.asarray(write_bt))
-        else:
-            self.pool, first = self._prefill_cold(
-                self.params, self.pool, jnp.asarray(toks),
-                jnp.asarray(lens), jnp.asarray(write_bt))
+        with _device.annotation("serve.prefill"):
+            if warm:
+                self.pool, first = self._prefill_warm(
+                    self.params, self.pool, jnp.asarray(toks),
+                    jnp.asarray(lens), jnp.asarray(pos0s),
+                    jnp.asarray(pre_bt), jnp.asarray(write_bt))
+            else:
+                self.pool, first = self._prefill_cold(
+                    self.params, self.pool, jnp.asarray(toks),
+                    jnp.asarray(lens), jnp.asarray(write_bt))
         if self.spec_k:
             # The draft always prefills the FULL prompt (its dense
             # cache is per-slot; prefix-cache hits only skip TARGET
@@ -1244,6 +1247,7 @@ class LLMServer:
             self._prefill_ema = (dt if self._prefill_ema is None
                                  else 0.8 * self._prefill_ema
                                  + 0.2 * dt)
+            self._emit_ema("prefill", self._prefill_ema)
             for j, slot, req in members:
                 if self.slot_req[slot] is not req:
                     continue  # preempted while the prefill was in flight
@@ -1448,10 +1452,11 @@ class LLMServer:
         t0 = time.perf_counter()
         sa = next((b for b in self.decode_buckets if high <= b),
                   self.decode_buckets[-1])
-        self.draft_cache, dts = self._draft_propose(
-            self.draft_params, self.draft_cache, jnp.asarray(tok),
-            jnp.asarray(pos), jnp.asarray(active), k=int(k),
-            s_active=int(sa))
+        with _device.annotation("serve.spec_draft"):
+            self.draft_cache, dts = self._draft_propose(
+                self.draft_params, self.draft_cache, jnp.asarray(tok),
+                jnp.asarray(pos), jnp.asarray(active), k=int(k),
+                s_active=int(sa))
         dtoks = np.asarray(dts)  # (k, B): d1..dk per slot
         # Verify inputs: [last accepted, d1..d_{k-1}] — outputs are
         # the target's tokens for positions pos+1..pos+k, lining up
@@ -1470,14 +1475,17 @@ class LLMServer:
         for s, _req, _l in snapshot:
             blocks = self.slot_table[s].blocks[:nb]
             bt[s, :len(blocks)] = blocks
-        self.pool, g_dev = self._spec_verify(
-            self.params, self.pool, jnp.asarray(vtoks),
-            jnp.asarray(vpos), jnp.asarray(active), jnp.asarray(bt))
+        with _device.annotation("serve.spec_verify"):
+            self.pool, g_dev = self._spec_verify(
+                self.params, self.pool, jnp.asarray(vtoks),
+                jnp.asarray(vpos), jnp.asarray(active),
+                jnp.asarray(bt))
         g = np.asarray(g_dev)  # (B, k) target tokens for pos+1..pos+k
         now = time.perf_counter()
         dt = now - t0
         self._chunk_ema = (dt if self._chunk_ema is None
                            else 0.8 * self._chunk_ema + 0.2 * dt)
+        self._emit_ema("spec_round", self._chunk_ema)
         proposed = accepted = emitted_total = 0
         for s, req, _l in snapshot:
             if self.slot_req[s] is not req or req.done:
@@ -1510,6 +1518,15 @@ class LLMServer:
                               + 0.2 * per_slot)
         self._count_spec(proposed, accepted)
         return True
+
+    def _emit_ema(self, program: str, seconds) -> None:
+        """Model-plane gauge: the engine's per-program execution-time
+        EMA (the same numbers the feasibility shed steers by) as
+        ``ray_tpu_serve_program_seconds{deployment,program}`` — ships
+        to the head TSDB so `ray_tpu top` / metrics_query watch the
+        engine's device-time live (observability/device.py)."""
+        _device.record_program_ema(self._deployment or "llm",
+                                   program, seconds)
 
     def _count_spec(self, proposed: int, accepted: int) -> None:
         self._spec_proposed += proposed
@@ -1630,6 +1647,9 @@ class LLMServer:
                    jnp.asarray(self._ov_len.copy()),
                    jnp.asarray(self._ov_mask.copy()),
                    jnp.asarray(active))
+        # TraceAnnotation: a device trace captured during this chunk
+        # shows the launch stamped with the ambient trace id, so
+        # device slices correlate with the cluster timeline.
         if self.paged:
             nb = self._nb_bucket(max(
                 len(self.slot_table[s]) for s, _r, _l in snapshot))
@@ -1638,16 +1658,20 @@ class LLMServer:
             for s, _req, _l in snapshot:
                 blocks = self.slot_table[s].blocks[:nb]
                 bt[s, :len(blocks)] = blocks
-            self.pool, toks, self._tok_dev, self._len_dev = \
-                self._decode_paged(self.params, self.pool,
-                                   self._tok_dev, self._len_dev,
-                                   *ov_args, jnp.asarray(bt), k=int(k))
+            with _device.annotation("serve.decode_chunk"):
+                self.pool, toks, self._tok_dev, self._len_dev = \
+                    self._decode_paged(self.params, self.pool,
+                                       self._tok_dev, self._len_dev,
+                                       *ov_args, jnp.asarray(bt),
+                                       k=int(k))
         else:
             sa = self._decode_bucket()
-            self.cache, toks, self._tok_dev, self._len_dev = \
-                self._decode_k(self.params, self.cache, self._tok_dev,
-                               self._len_dev, *ov_args, k=int(k),
-                               s_active=int(sa))
+            with _device.annotation("serve.decode_chunk"):
+                self.cache, toks, self._tok_dev, self._len_dev = \
+                    self._decode_k(self.params, self.cache,
+                                   self._tok_dev, self._len_dev,
+                                   *ov_args, k=int(k),
+                                   s_active=int(sa))
         self._ov_mask[:] = False
         for s, _req, _len0 in snapshot:
             self.slot_len[s] += k
@@ -1663,6 +1687,7 @@ class LLMServer:
         dt = now - t0
         self._chunk_ema = (dt if self._chunk_ema is None
                            else 0.8 * self._chunk_ema + 0.2 * dt)
+        self._emit_ema("decode_chunk", self._chunk_ema)
         for slot, req, len0 in snapshot:
             if req is None or req.done:
                 continue

@@ -1018,6 +1018,121 @@ def rule_metric_cardinality(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: jit-in-hot-path
+# --------------------------------------------------------------------------
+
+# Method names that run per dispatch / per step / per request: a
+# jax.jit/pjit wrapper built THERE is built per call — each wrapper
+# owns a fresh compile cache, so every invocation re-traces and
+# recompiles (the xla-recompile-storm alert's favorite root cause).
+_JIT_HOT_RE = re.compile(
+    r"(?:^|_)(dispatch|handle|submit|execute|request|recv|decode|"
+    r"generate|sample|collect|predict|forward|backward|step|loop|"
+    r"round|chunk|process|call)(?:_|$)|(?:^|_)on_", re.I)
+# Builder/setup names trump hot tokens: make_train_step and friends
+# exist to build the jitted program once.
+_JIT_BUILDER_RE = re.compile(
+    r"(?:^|_)(make|build|init|create|compile|setup|warmup)(?:_|$)",
+    re.I)
+
+
+def _jit_call_desc(info: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """'jax.jit' / 'pjit' when this call builds a jit wrapper, else
+    None.  Resolution is import-aware but tolerant of function-local
+    ``import jax`` (the name itself then reads as the module)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in ("jit", "pjit"):
+        base = f.value
+        name = (base.id if isinstance(base, ast.Name)
+                else getattr(base, "attr", ""))
+        resolved = info.imports.get(name, name)
+        if resolved == "jax" or resolved.startswith("jax."):
+            return f"{name}.{f.attr}"
+        return None
+    if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
+        resolved = info.imports.get(f.id, "")
+        if resolved.startswith("jax"):
+            return f.id
+    return None
+
+
+def _none_guard_target(test: ast.AST) -> Optional[ast.AST]:
+    """The expression a ``if X is None: `` / ``if not X:`` test
+    guards, or None — the build-once cache idiom's gate."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.ops[0], ast.Is) \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        return test.left
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return test.operand
+    return None
+
+
+def _lvalue_key(expr: ast.AST) -> Optional[str]:
+    """'self._apply' / 'cache' for Name/Attribute chains, ignoring
+    the Load/Store context (a guard test reads what the assignment
+    writes — ast.dump would never match the two)."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+def rule_jit_in_hot_path(model: ProjectModel) -> List[Finding]:
+    """``jax.jit``/``pjit`` invoked inside dispatch/step/per-request
+    methods: the wrapper (and its compile cache) is rebuilt per call,
+    so every invocation pays a retrace + XLA compile — latency spikes
+    and a recompilation storm under load.  The build-once idioms stay
+    clean: builder-named functions, and the ``if self._f is None:
+    self._f = jax.jit(...)`` cached-guard pattern."""
+    out = _Collector(model, "jit-in-hot-path")
+    for fi in model.functions.values():
+        if not _JIT_HOT_RE.search(fi.name) \
+                or _JIT_BUILDER_RE.search(fi.name):
+            continue
+        info = model.modules[fi.module]
+
+        def walk(node, guarded):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # nested defs execute elsewhere
+                g = guarded
+                if isinstance(child, ast.If):
+                    target = _none_guard_target(child.test)
+                    key = (_lvalue_key(target)
+                           if target is not None else None)
+                    if key is not None:
+                        g = guarded | {key}
+                if isinstance(child, ast.Assign) \
+                        and len(child.targets) == 1 \
+                        and _lvalue_key(child.targets[0]) in g:
+                    # Filling the None-guarded cache: build-once.
+                    continue
+                if isinstance(child, ast.Call):
+                    desc = _jit_call_desc(info, child)
+                    if desc is not None:
+                        out.add(
+                            info, child.lineno, fi.qualname,
+                            f"{desc}(...) inside hot-path method "
+                            f"{fi.name!r} builds a fresh jit wrapper "
+                            f"(own compile cache) per call — every "
+                            f"invocation re-traces and recompiles; "
+                            f"build it once at init or cache it "
+                            f"behind a None guard")
+                walk(child, g)
+
+        walk(fi.node, frozenset())
+    return out.findings
+
+
+# --------------------------------------------------------------------------
 # rule: suppression-syntax (meta): disables must carry a reason and
 # name real rules — a typo'd disable that silently fails to suppress
 # (or a reasonless one) is itself a finding
@@ -1521,6 +1636,7 @@ RULES = {
     "unbounded-mailbox": rule_unbounded_mailbox,
     "log-hygiene": rule_log_hygiene,
     "metric-cardinality": rule_metric_cardinality,
+    "jit-in-hot-path": rule_jit_in_hot_path,
     "suppression-syntax": rule_suppression_syntax,
     "journaled-mutation": rule_journaled_mutation,
     "lock-order-inversion": rule_lock_order_inversion,
@@ -1581,6 +1697,14 @@ RULE_DOCS = {
         "rendering) mints one series per operation, growing every "
         "process registry, the /metrics exposition, and the head "
         "TSDB until the cardinality cap drops real series."),
+    "jit-in-hot-path": (
+        "jax.jit/pjit invoked inside dispatch/step/per-request "
+        "methods builds a fresh wrapper (with its own compile cache) "
+        "per call — every invocation re-traces and recompiles, the "
+        "recompilation-storm failure class the device plane's "
+        "xla-recompile-storm alert fires on.  Build the jitted "
+        "program once (builder/init) or cache it behind a None "
+        "guard."),
     "suppression-syntax": (
         "raylint disables must name real rules and carry a "
         "'-- reason'; a reasonless or typo'd disable does not "

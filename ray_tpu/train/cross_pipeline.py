@@ -115,6 +115,17 @@ class _StageWorker:
         self._n_mb = 0
 
     # ------------------------------------------------------------ helpers
+    def device_info(self) -> Dict[str, Any]:
+        """This stage's accelerator identity for the driver's MFU
+        roofline: chip kind + process-qualified device ids (the
+        driver dedups across stages — colocated in-process stages
+        share one device set and must not double-count it)."""
+        import os
+
+        devs = self._jax.local_devices()
+        return {"kind": devs[0].device_kind if devs else "",
+                "devices": [f"{os.getpid()}:{d}" for d in devs]}
+
     def _run(self, fn, *args):
         if self._mesh is not None:
             with self._use_mesh(self._mesh):
@@ -235,6 +246,9 @@ class CrossSlicePipeline:
         check_pipeline_config(config, n_stages)
         self.n_stages = n_stages
         self.num_microbatches = num_microbatches
+        self.config = config
+        self._n_params: Optional[int] = None  # lazy (model-plane MFU)
+        self._gang_devices = None             # lazy (kind, chip count)
         self._pg = None
         opts_per_stage: List[Dict[str, Any]] = [{} for _ in range(n_stages)]
         if resources_per_stage:
@@ -342,32 +356,99 @@ class CrossSlicePipeline:
         typed.  A restarted stage re-runs its constructor (same seed →
         same init); a stage dead for good (no restart budget)
         re-raises the typed error."""
+        import time as _time
+
         from ray_tpu.exceptions import (ActorError, ChannelError,
                                         ObjectLostError, TaskError)
+        from ray_tpu.observability import device as _device_mod
         from ray_tpu.observability import tracing
 
         # One trace per train step: every microbatch task on every
         # stage (and the retried wave, if any) shares the trace id.
+        t0 = _time.perf_counter()
         with tracing.span("train.step",
                           args={"stages": self.n_stages}) as span:
+            # The annotation carries the step's trace id into any
+            # device trace captured while the wave runs.
+            with _device_mod.annotation("train.step"):
+                try:
+                    self._run_wave(tokens)
+                except (ActorError, ChannelError, ObjectLostError,
+                        TaskError) as e:
+                    cause = e.cause if isinstance(e, TaskError) else e
+                    if not isinstance(cause,
+                                      (ActorError, ChannelError,
+                                       ObjectLostError)):
+                        raise
+                    if not self._recover_stages():
+                        raise
+                    # The recovery that used to be only a counter is
+                    # now a correlated log line: `logs --trace <step
+                    # trace>` shows WHY this step was slow next to
+                    # its spans.
+                    _log.warning(
+                        "train.step wave retried after %s trace=%s",
+                        type(cause).__name__, span.trace_id)
+                    self._run_wave(tokens)
+                out = self._apply_updates()
+            # Step time ends HERE — the roofline gather below is a
+            # one-off gang RPC that must not pollute the first step's
+            # tokens/s gauge.
+            step_s = _time.perf_counter() - t0
+            # Model-plane series: per-step tokens/s (+ MFU where the
+            # chip roofline is known) — profile_mfu.py's numbers,
+            # live.  The roofline is the GANG's: kind + distinct chip
+            # count come from the stage workers, not the driver (a
+            # CPU driver orchestrating TPU stages would otherwise
+            # never export MFU, and a multi-stage gang would report
+            # it inflated by the stage count).
+            kind, n_dev = self._gang_roofline()
+            _device_mod.record_train_step(
+                int(tokens.shape[0]) * (int(tokens.shape[1]) - 1),
+                step_s, n_params=self._total_params(),
+                device_kind=kind or None, n_devices=n_dev)
+            return out
+
+    def _total_params(self) -> Optional[int]:
+        """Whole-model parameter count for the MFU gauge, computed
+        once via shape-only eval (no weights materialize on the
+        driver); None when jax is unavailable here."""
+        if self._n_params is None:
             try:
-                self._run_wave(tokens)
-            except (ActorError, ChannelError, ObjectLostError,
-                    TaskError) as e:
-                cause = e.cause if isinstance(e, TaskError) else e
-                if not isinstance(cause, (ActorError, ChannelError,
-                                          ObjectLostError)):
-                    raise
-                if not self._recover_stages():
-                    raise
-                # The recovery that used to be only a counter is now a
-                # correlated log line: `logs --trace <step trace>`
-                # shows WHY this step was slow next to its spans.
-                _log.warning(
-                    "train.step wave retried after %s trace=%s",
-                    type(cause).__name__, span.trace_id)
-                self._run_wave(tokens)
-            return self._apply_updates()
+                import jax
+
+                from ray_tpu.models import llama
+
+                self._n_params = llama.param_count(jax.eval_shape(
+                    lambda: llama.init_params(jax.random.key(0),
+                                              self.config)))
+            except Exception:
+                self._n_params = 0
+        return self._n_params or None
+
+    def _gang_roofline(self):
+        """(device_kind, distinct device count) across the stage
+        gang, gathered once: each stage reports process-qualified
+        device ids, deduped here so colocated in-process stages
+        (which share one device set) don't double-count chips."""
+        if self._gang_devices is None:
+            try:
+                infos = ray_tpu.get(
+                    [s.device_info.remote() for s in self.stages],
+                    timeout=30.0)
+                devs: set = set()
+                kind = ""
+                for info in infos:
+                    devs.update(info["devices"])
+                    kind = kind or info["kind"]
+                self._gang_devices = (kind, max(1, len(devs)))
+            except Exception:
+                # Transient (a stage mid-restart): DON'T cache the
+                # failure — the next step retries, else one bad first
+                # step would disable MFU export for the pipeline's
+                # whole lifetime.
+                return "", 1
+        return self._gang_devices
 
     def _recover_stages(self, timeout_s: float = 60.0) -> bool:
         """Wait for every stage to be ALIVE again (restarts included),

@@ -64,6 +64,7 @@ def _chaos_hang_guard(request):
             request.node.get_closest_marker("overload") is None and \
             request.node.get_closest_marker("net") is None and \
             request.node.get_closest_marker("tsdb") is None and \
+            request.node.get_closest_marker("device") is None and \
             request.node.get_closest_marker("stress") is None:
         yield
         return

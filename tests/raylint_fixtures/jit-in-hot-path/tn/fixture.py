@@ -1,0 +1,34 @@
+"""True negatives: the build-once idioms — jit at init/builder time,
+the None-guarded cache pattern, and hot methods that only CALL an
+already-jitted program."""
+
+import jax
+
+# module-level jit: compiled once at import
+_global_step = jax.jit(lambda p, v: p @ v)
+
+
+def make_train_step(cfg):
+    # builder-named functions exist to build the jitted program
+    return jax.jit(lambda p, v: p @ v + cfg)
+
+
+class Engine:
+    def __init__(self):
+        # init-time build: once per engine
+        self._step = jax.jit(lambda p, v: p + v)
+        self._apply = None
+
+    def handle_request(self, params, x):
+        # cached-guard idiom: built on first use, reused after
+        if self._apply is None:
+            self._apply = jax.jit(lambda p, v: p * v)
+        return self._apply(params, x)
+
+    def decode_step(self, params, x):
+        # hot method merely CALLING jitted programs is the point
+        return self._step(params, x)
+
+    def dispatch(self, params, x):
+        # jit-shaped names on non-jax receivers are not the hazard
+        return self.pool.jit(params, x)

@@ -1,0 +1,28 @@
+"""True positives: jit wrappers built inside hot-path methods — each
+call builds a fresh wrapper with its own compile cache, so every
+invocation re-traces and recompiles."""
+
+import jax
+from jax import jit
+from jax.experimental.pjit import pjit
+
+
+class Engine:
+    def handle_request(self, params, x):
+        # finding: jax.jit built per request
+        f = jax.jit(lambda p, v: p @ v)
+        return f(params, x)
+
+    def decode_step(self, params, x):
+        # finding: from-imported jit, still per call
+        return jit(lambda p, v: p + v)(params, x)
+
+    def dispatch(self, params, x):
+        # finding: pjit is the same hazard
+        return pjit(lambda p, v: p * v)(params, x)
+
+    def on_sample(self, params, x):
+        # finding: an UNguarded cache assignment still rebuilds the
+        # wrapper every call (no `if ... is None` gate)
+        self._f = jax.jit(lambda p, v: p - v)
+        return self._f(params, x)

@@ -71,6 +71,19 @@ def test_train_phase_emits_mfu_field():
         capture_output=True).returncode == 0
 
 
+def test_device_telemetry_overhead_phase_smoke():
+    """The device-plane overhead phase runs the paired-adjacent
+    harness end to end at smoke size and emits its keys (the <5
+    guard is asserted on the full-size BENCH run)."""
+    from bench import _device_telemetry_overhead_bench
+
+    out = _device_telemetry_overhead_bench(n_pairs=6)
+    assert "device_telemetry_overhead_pct" in out
+    assert out["device_on_roundtrip_us"] > 0
+    assert out["device_off_roundtrip_us"] > 0
+    assert -50.0 < out["device_telemetry_overhead_pct"] < 100.0
+
+
 def test_tsdb_bench_phase_smoke():
     """The TSDB phase emits its query latency + ingest-overhead keys
     from a real head RPC round (small sizes — the real numbers come
