@@ -316,6 +316,82 @@ def _object_plane_bench(size_bytes: int) -> dict:
         c.shutdown()
 
 
+def _shuffle_bench(n_blocks: int = 32, rows_per_block: int = 4096,
+                   width: int = 256) -> dict:
+    """Push-based shuffle exchange (data/exchange.py) vs the
+    materialized baseline in the same run: ``random_shuffle`` streams
+    partition fragments map→reduce over the shm rings as they are
+    produced, while the baseline pulls every block to one place,
+    permutes, and re-emits (the pre-push data path).  Local mode =
+    same-host soak: all fragments should ride the shm transport —
+    ``shuffle_shm_bytes`` being nonzero is part of the acceptance
+    gate, not just the throughput ratio."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.data.block import BlockAccessor
+    from ray_tpu.data.executor import AllToAll
+    from ray_tpu.observability.metrics import metrics_summary
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_tpus=0)
+    try:
+        rng = np.random.default_rng(0)
+        blocks = []
+        for i in range(n_blocks):
+            blocks.append({
+                "x": rng.standard_normal(
+                    (rows_per_block, width)).astype(np.float32),
+                "id": np.arange(i * rows_per_block,
+                                (i + 1) * rows_per_block,
+                                dtype=np.int64)})
+        total_bytes = sum(b["x"].nbytes + b["id"].nbytes
+                          for b in blocks)
+        total_rows = n_blocks * rows_per_block
+        ds = rd.from_blocks(blocks)
+
+        def consume(dataset) -> float:
+            t0 = time.perf_counter()
+            rows = sum(b["x"].shape[0] for b in dataset.iter_blocks())
+            dt = time.perf_counter() - t0
+            assert rows == total_rows, (rows, total_rows)
+            return dt
+
+        shm0 = metrics_summary().get(
+            "ray_tpu_shuffle_bytes", {}).get("shm", 0.0)
+        push_dt = consume(ds.random_shuffle(seed=0))
+        shm1 = metrics_summary().get(
+            "ray_tpu_shuffle_bytes", {}).get("shm", 0.0)
+
+        def mat_shuffle(blks, _ctx):
+            # The materialized path: everything in one place first,
+            # one global permutation, re-slice.
+            whole = BlockAccessor.concat(blks)
+            n = BlockAccessor.num_rows(whole)
+            shuffled = BlockAccessor.take(
+                whole, np.random.default_rng(0).permutation(n))
+            bounds = np.linspace(0, n, max(1, len(blks)) + 1
+                                 ).astype(np.int64)
+            return [BlockAccessor.slice(shuffled, int(lo), int(hi))
+                    for lo, hi in zip(bounds[:-1], bounds[1:])]
+
+        mat_dt = consume(ds._with(
+            AllToAll("MaterializedShuffle", mat_shuffle)))
+
+        return {
+            "shuffle_gbytes_per_s": round(
+                total_bytes / push_dt / 1e9, 3),
+            "shuffle_gbytes_per_s_materialized": round(
+                total_bytes / mat_dt / 1e9, 3),
+            "shuffle_push_speedup": round(mat_dt / push_dt, 2),
+            "shuffle_mb": total_bytes // (1024 * 1024),
+            "shuffle_shm_bytes": int(shm1 - shm0),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def _dag_roundtrip_bench(n_iters: int = 150) -> dict:
     """2-actor compiled-DAG ping-pong (64 KiB payload), actors in two
     worker processes on this host: per-pass round-trip latency with the
@@ -1330,6 +1406,13 @@ def main():
             1024 * 1024 * 1024 if on_tpu else 64 * 1024 * 1024))
     except Exception as e:  # noqa: BLE001
         extra["object_pull_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: shuffle phase start", file=sys.stderr, flush=True)
+    try:
+        extra.update(_shuffle_bench(
+            *((64, 16384, 256) if on_tpu else (32, 8192, 128))))
+    except Exception as e:  # noqa: BLE001
+        extra["shuffle_error"] = f"{type(e).__name__}: {e}"
 
     print("bench: broadcast phase start", file=sys.stderr, flush=True)
     try:

@@ -8,23 +8,27 @@ per-worker shards ray_tpu.train consumes (reference:
 train/_internal/data_config.py).
 """
 
+from .aggregate import (AggregateFn, Count, Max, Mean, Min, Std, Sum)
 from .block import Block, BlockAccessor, BlockMetadata
 from .context import DataContext
 from .executor import ActorPoolStrategy
-from .dataset import (DataIterator, Dataset, from_arrow, from_blocks,
-                      from_items, from_numpy, from_pandas, range,
-                      read_csv, read_datasource, read_images, read_json,
-                      read_numpy, read_parquet, read_tfrecords)
+from .dataset import (DataIterator, Dataset, GroupedData, from_arrow,
+                      from_blocks, from_items, from_numpy, from_pandas,
+                      range, read_csv, read_datasource, read_images,
+                      read_json, read_numpy, read_parquet,
+                      read_tfrecords)
 from .datasource import Datasource, FileDatasource, ReadTask
 from .random_access import RandomAccessDataset
 from . import preprocessors
 
 __all__ = [
-    "ActorPoolStrategy",
-    "Block", "BlockAccessor", "BlockMetadata", "DataContext",
+    "ActorPoolStrategy", "AggregateFn",
+    "Block", "BlockAccessor", "BlockMetadata", "Count", "DataContext",
     "DataIterator", "Dataset", "Datasource", "FileDatasource",
-    "RandomAccessDataset", "ReadTask", "from_arrow", "from_blocks",
-    "from_items", "from_numpy", "from_pandas", "preprocessors", "range",
-    "read_csv", "read_datasource", "read_images", "read_json",
-    "read_numpy", "read_parquet", "read_tfrecords",
+    "GroupedData", "Max", "Mean", "Min",
+    "RandomAccessDataset", "ReadTask", "Std", "Sum", "from_arrow",
+    "from_blocks", "from_items", "from_numpy", "from_pandas",
+    "preprocessors", "range", "read_csv", "read_datasource",
+    "read_images", "read_json", "read_numpy", "read_parquet",
+    "read_tfrecords",
 ]

@@ -1,0 +1,291 @@
+"""Combinable aggregate functions for ``groupby``/``aggregate``.
+
+Reference: python/ray/data/aggregate.py (``AggregateFn`` with
+init/accumulate/merge/finalize, applied row-at-a-time).  Redesign for
+the numpy engine: every phase is vectorized over *runs* of equal keys
+in a key-sorted block (``np.ufunc.reduceat`` over run boundaries), and
+the partial states are themselves blocks — so they ride the push
+exchange like any other fragment and reducers can combine them
+incrementally without holding raw rows.
+
+Three phases per aggregate:
+
+- ``partial(block, bounds)``  — map side: per-run state arrays from raw
+  rows (one state row per distinct key in the fragment);
+- ``combine(states, bounds)`` — reduce side: merge state rows after the
+  reducer re-sorts concatenated partials by key (runs again);
+- ``finalize(states)``        — the output column.
+
+NaN semantics follow naive numpy (``sum`` over a group containing NaN
+is NaN); NaN *keys* form a single group (see block.stable_hash_column /
+group_boundaries).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .block import (Block, BlockAccessor, group_boundaries,
+                    hash_partition_indices, sort_by_key)
+
+# Synthetic key column for whole-dataset aggregation (one global
+# group); stripped from the finalized output.
+GLOBAL_KEY = "__global__"
+
+
+def _reduceat(ufunc, col: np.ndarray, bounds: np.ndarray) -> np.ndarray:
+    """Per-run reduction of ``col`` over boundary offsets ``bounds``
+    (``[0, s1, ..., n]``).  Empty input → empty output."""
+    if len(bounds) <= 1:
+        return col[:0]
+    return ufunc.reduceat(col, bounds[:-1])
+
+
+class AggregateFn:
+    """One combinable aggregate.  ``fields`` names the per-group state
+    columns; the exchange prefixes them per slot so several aggregates
+    share one state block."""
+
+    fields = ()
+    kind = "agg"
+
+    def __init__(self, on: Optional[str] = None):
+        self.on = on
+
+    def out_name(self) -> str:
+        return f"{self.kind}({self.on if self.on is not None else ''})"
+
+    def _col(self, block) -> np.ndarray:
+        if self.on is None:
+            raise ValueError(f"{self.kind}() requires on=<column>")
+        if self.on not in block:
+            raise KeyError(
+                f"aggregate column {self.on!r} not in block columns "
+                f"{sorted(block.keys())}")
+        return block[self.on]
+
+    def partial(self, block, bounds) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def combine(self, states: Dict[str, np.ndarray],
+                bounds) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def finalize(self, states: Dict[str, np.ndarray]) -> np.ndarray:
+        raise NotImplementedError
+
+
+class Count(AggregateFn):
+    kind = "count"
+    fields = ("n",)
+
+    def __init__(self):
+        super().__init__(on=None)
+
+    def partial(self, block, bounds):
+        return {"n": np.diff(bounds).astype(np.int64)}
+
+    def combine(self, states, bounds):
+        return {"n": _reduceat(np.add, states["n"], bounds)}
+
+    def finalize(self, states):
+        return states["n"]
+
+
+class Sum(AggregateFn):
+    kind = "sum"
+    fields = ("s",)
+
+    def partial(self, block, bounds):
+        return {"s": _reduceat(np.add, self._col(block), bounds)}
+
+    def combine(self, states, bounds):
+        return {"s": _reduceat(np.add, states["s"], bounds)}
+
+    def finalize(self, states):
+        return states["s"]
+
+
+class Min(AggregateFn):
+    kind = "min"
+    fields = ("m",)
+
+    def partial(self, block, bounds):
+        return {"m": _reduceat(np.minimum, self._col(block), bounds)}
+
+    def combine(self, states, bounds):
+        return {"m": _reduceat(np.minimum, states["m"], bounds)}
+
+    def finalize(self, states):
+        return states["m"]
+
+
+class Max(AggregateFn):
+    kind = "max"
+    fields = ("m",)
+
+    def partial(self, block, bounds):
+        return {"m": _reduceat(np.maximum, self._col(block), bounds)}
+
+    def combine(self, states, bounds):
+        return {"m": _reduceat(np.maximum, states["m"], bounds)}
+
+    def finalize(self, states):
+        return states["m"]
+
+
+class Mean(AggregateFn):
+    kind = "mean"
+    fields = ("s", "n")
+
+    def partial(self, block, bounds):
+        col = self._col(block).astype(np.float64, copy=False)
+        return {"s": _reduceat(np.add, col, bounds),
+                "n": np.diff(bounds).astype(np.int64)}
+
+    def combine(self, states, bounds):
+        return {"s": _reduceat(np.add, states["s"], bounds),
+                "n": _reduceat(np.add, states["n"], bounds)}
+
+    def finalize(self, states):
+        return states["s"] / states["n"]
+
+
+class Std(AggregateFn):
+    """Population / sample std via (sum, sum-of-squares, n) moments in
+    float64 — combinable with plain addition, accurate to well past the
+    parity tests' tolerance for non-pathological data."""
+
+    kind = "std"
+    fields = ("s", "ss", "n")
+
+    def __init__(self, on: Optional[str] = None, ddof: int = 0):
+        super().__init__(on=on)
+        self.ddof = ddof
+
+    def partial(self, block, bounds):
+        col = self._col(block).astype(np.float64, copy=False)
+        return {"s": _reduceat(np.add, col, bounds),
+                "ss": _reduceat(np.add, col * col, bounds),
+                "n": np.diff(bounds).astype(np.int64)}
+
+    def combine(self, states, bounds):
+        return {k: _reduceat(np.add, states[k], bounds)
+                for k in self.fields}
+
+    def finalize(self, states):
+        n = states["n"].astype(np.float64)
+        mean = states["s"] / n
+        var = states["ss"] / n - mean * mean
+        denom = n - self.ddof
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = np.sqrt(np.clip(var, 0.0, None) * (n / denom))
+        out[denom <= 0] = np.nan
+        return out
+
+
+_BY_NAME = {c.kind: c for c in (Count, Sum, Min, Max, Mean, Std)}
+
+
+def resolve_aggregate(spec) -> AggregateFn:
+    """Accept an AggregateFn instance, a ``"count"`` style name, or a
+    ``("sum", "col")`` tuple (the forms ``Dataset.aggregate`` takes)."""
+    if isinstance(spec, AggregateFn):
+        return spec
+    if isinstance(spec, str):
+        if spec not in _BY_NAME:
+            raise ValueError(
+                f"unknown aggregate {spec!r}; one of {sorted(_BY_NAME)}")
+        return _BY_NAME[spec]() if spec == "count" else _BY_NAME[spec](None)
+    if isinstance(spec, (tuple, list)) and len(spec) == 2:
+        name, on = spec
+        if name not in _BY_NAME:
+            raise ValueError(
+                f"unknown aggregate {name!r}; one of {sorted(_BY_NAME)}")
+        return _BY_NAME[name]() if name == "count" else _BY_NAME[name](on)
+    raise TypeError(
+        f"aggregate spec must be AggregateFn | name | (name, col), "
+        f"got {spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# Exchange plumbing: partial-state blocks + the reducers' combine
+# ---------------------------------------------------------------------------
+
+def partial_state_block(block: Block, key: Optional[str],
+                        aggs: List[AggregateFn]) -> Block:
+    """Map-side partial aggregation of one raw block: one state row
+    per distinct key in the block — the only thing that rides the
+    shuffle for an aggregate exchange."""
+    if key is None:
+        n = BlockAccessor.num_rows(block)
+        bounds = np.array([0, n] if n else [0], dtype=np.int64)
+        sb = block
+        state: Block = {GLOBAL_KEY: np.zeros(1 if n else 0, np.int64)}
+    else:
+        sb = sort_by_key(block, key)
+        bounds = group_boundaries(sb[key])
+        state = {key: sb[key][bounds[:-1]]}
+    for i, agg in enumerate(aggs):
+        for f, arr in agg.partial(sb, bounds).items():
+            state[f"__s{i}_{f}"] = np.asarray(arr)
+    return state
+
+
+def make_agg_partition(key: Optional[str], aggs: List[AggregateFn]):
+    """Exchange ``partition_fn``: partial-aggregate the block, then
+    hash-partition the state rows by key so every partial of one key
+    lands on one reducer."""
+    kcol = key if key is not None else GLOBAL_KEY
+
+    def partition(block: Block, n: int, _spec, _offset: int):
+        state = partial_state_block(block, key, aggs)
+        if not BlockAccessor.num_rows(state):
+            return []
+        idx = hash_partition_indices(state, kcol, n)
+        return [(j, BlockAccessor.take(state, np.nonzero(idx == j)[0]))
+                for j in range(n)]
+
+    return partition
+
+
+class AggCombine:
+    """The reducers' incremental-combine mode for aggregate
+    exchanges: ``add`` folds arriving partial-state fragments into the
+    partition's running state (re-sorted by key, runs combined), and
+    ``finalize`` emits the output columns.  Raw rows never reach the
+    reducer."""
+
+    def __init__(self, key: Optional[str], aggs: List[AggregateFn]):
+        self.key = key if key is not None else GLOBAL_KEY
+        self.aggs = list(aggs)
+
+    def add(self, state: Optional[Block],
+            blocks: List[Block]) -> Block:
+        parts = ([state] if state else []) + \
+            [b for b in blocks if BlockAccessor.num_rows(b)]
+        if not parts:
+            return state if state is not None else {}
+        whole = BlockAccessor.concat(parts)
+        sb = sort_by_key(whole, self.key)
+        bounds = group_boundaries(sb[self.key])
+        out: Block = {self.key: sb[self.key][bounds[:-1]]}
+        for i, agg in enumerate(self.aggs):
+            states = {f: sb[f"__s{i}_{f}"] for f in agg.fields}
+            for f, arr in agg.combine(states, bounds).items():
+                out[f"__s{i}_{f}"] = np.asarray(arr)
+        return out
+
+    def finalize(self, state: Optional[Block], _spec,
+                 _part_idx: int) -> List[Block]:
+        if state is None or not BlockAccessor.num_rows(state):
+            return []
+        out: Block = {}
+        if self.key != GLOBAL_KEY:
+            out[self.key] = state[self.key]
+        for i, agg in enumerate(self.aggs):
+            out[agg.out_name()] = np.asarray(agg.finalize(
+                {f: state[f"__s{i}_{f}"] for f in agg.fields}))
+        return [out]

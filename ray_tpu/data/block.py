@@ -138,6 +138,107 @@ class BlockAccessor:
         return out
 
 
+# -- stable hashing for shuffle partitioning ---------------------------
+#
+# Python's builtin ``hash`` is salted per process (PYTHONHASHSEED), so a
+# mapper and a reducer in different workers would disagree on which
+# partition owns a key.  The exchange needs a hash that is stable across
+# processes and hosts: crc32 for strings/objects, a Knuth
+# multiplicative mix for numerics.  NaN keys canonicalize to one bucket
+# (NaN != NaN, but groupby treats all NaNs as one group) and -0.0
+# hashes with +0.0.
+
+_HASH_MIX = np.uint64(0x9E3779B97F4A7C15)
+
+
+def stable_hash_column(col: np.ndarray) -> np.ndarray:
+    """Per-row uint64 hashes of a key column, identical in every
+    process.  Vectorized for numeric dtypes; object/str columns go
+    through crc32 row-wise."""
+    import zlib
+
+    if col.dtype == object or col.dtype.kind in "US":
+        out = np.empty(len(col), dtype=np.uint64)
+        for i, v in enumerate(col):
+            if isinstance(v, float) and v != v:  # NaN object key
+                out[i] = np.uint64(0x7FF8000000000000)
+                continue
+            out[i] = np.uint64(
+                zlib.crc32(str(v).encode("utf-8", "surrogatepass")))
+        bits = out
+    elif col.dtype.kind == "f":
+        f = col.astype(np.float64, copy=True)
+        f[f == 0.0] = 0.0  # -0.0 -> +0.0 so both hash alike
+        bits = f.view(np.uint64).copy()
+        bits[np.isnan(f)] = np.uint64(0x7FF8000000000000)  # one NaN bucket
+    elif col.dtype.kind == "b":
+        bits = col.astype(np.uint64)
+    else:  # signed/unsigned ints
+        bits = col.astype(np.int64, copy=False).view(np.uint64).copy()
+    with np.errstate(over="ignore"):
+        h = bits * _HASH_MIX
+        h ^= h >> np.uint64(29)
+        h *= np.uint64(0xBF58476D1CE4E5B9)
+        h ^= h >> np.uint64(32)
+    return h
+
+
+def hash_partition_indices(block: Block, key: str, n: int) -> np.ndarray:
+    """Row -> partition index in ``[0, n)`` by stable key hash."""
+    if key not in block:
+        raise KeyError(
+            f"groupby/shuffle key {key!r} not in block columns "
+            f"{sorted(block.keys())}")
+    return (stable_hash_column(block[key]) % np.uint64(n)).astype(np.int64)
+
+
+def sort_by_key(block: Block, key: str) -> Block:
+    """Stable-sort a block's rows by key, NaNs last (numpy argsort
+    convention), so equal keys form contiguous runs for segment
+    reduction."""
+    col = block[key]
+    if col.dtype == object:
+        order = np.argsort(
+            np.array([_obj_sort_token(v) for v in col]), kind="stable")
+    else:
+        order = np.argsort(col, kind="stable")
+    return BlockAccessor.take(block, order)
+
+
+def _obj_sort_token(v: Any) -> str:
+    if isinstance(v, float) and v != v:
+        return "￿￿NaN"  # after any realistic string
+    return str(v)
+
+
+def group_boundaries(col: np.ndarray) -> np.ndarray:
+    """Start offsets of equal-key runs in a key-sorted column, plus the
+    terminal length — ``[0, s1, ..., n]`` ready for pairwise slicing or
+    ``np.add.reduceat``.  All NaNs count as one run."""
+    n = len(col)
+    if n == 0:
+        return np.array([0], dtype=np.int64)
+    if col.dtype.kind == "f":
+        nan = np.isnan(col)
+        neq = col[1:] != col[:-1]
+        neq &= ~(nan[1:] & nan[:-1])  # NaN run stays one group
+    elif col.dtype == object:
+        neq = np.array([_obj_key_ne(col[i], col[i + 1])
+                        for i in range(n - 1)], dtype=bool)
+    else:
+        neq = col[1:] != col[:-1]
+    starts = np.flatnonzero(neq) + 1
+    return np.concatenate(([0], starts, [n])).astype(np.int64)
+
+
+def _obj_key_ne(a: Any, b: Any) -> bool:
+    a_nan = isinstance(a, float) and a != a
+    b_nan = isinstance(b, float) and b != b
+    if a_nan or b_nan:
+        return not (a_nan and b_nan)
+    return a != b
+
+
 def _stack(vals: List[Any]) -> np.ndarray:
     first = np.asarray(vals[0])
     if first.dtype != object and first.ndim > 0:

@@ -32,6 +32,24 @@ class DataContext:
     # Seconds between executor wait() polls (also the cadence at which
     # new work is dispatched when nothing completes).
     wait_timeout_s: float = 0.05
+    # -- push exchange (data/exchange.py) -----------------------------
+    # Map-side coalescing: fragments buffered per reducer flush once
+    # they reach this many bytes (one ring frame / one push per flush).
+    shuffle_fragment_bytes: int = 1 << 20
+    # Reducer memory limit per reduce partition: buffered fragments
+    # beyond this spill to plasma (which LRU-spills to disk under its
+    # own pressure), so a reduce partition can outgrow memory.
+    shuffle_spill_limit_bytes: int = 128 << 20
+    # Ring slots per mapper-process -> reducer shm channel.
+    shuffle_ring_slots: int = 16
+    # Deadline for all pushed fragments to land at the reducers after
+    # the map stage completes (a dead transport surfaces typed instead
+    # of hanging the exchange).
+    shuffle_timeout_s: float = 120.0
+    # Cap on reducer actors per exchange (each owns
+    # ceil(n_out / reducers) output partitions).
+    shuffle_reducers: int = field(
+        default_factory=lambda: min(8, os.cpu_count() or 8))
 
     _global: "DataContext" = None  # type: ignore[assignment]
 

@@ -24,14 +24,16 @@ from typing import (Any, Callable, Dict, Iterator, List, Optional,
 
 import numpy as np
 
-from .block import Block, BlockAccessor, BlockMetadata
+from .block import (Block, BlockAccessor, BlockMetadata,
+                    group_boundaries, hash_partition_indices,
+                    sort_by_key)
 from .context import DataContext
 from .datasource import (BlocksDatasource, Datasource, ItemsDatasource,
                          RangeDatasource, csv_datasource, json_datasource,
                          numpy_datasource, parquet_datasource)
 from .executor import (ActorMapBlocks, ActorPoolStrategy, AllToAll,
                        Exchange, Limit, LogicalOp, MapBlocks, PlanStats,
-                       Read, execute_streaming)
+                       Read, UnionOp, ZipOp, execute_streaming)
 
 
 class Dataset:
@@ -210,6 +212,43 @@ class Dataset:
         return self._with(Exchange("Sort", partition, merge,
                                    sample_fn=sample, bounds_fn=bounds))
 
+    # -- relational ops (push exchange) --------------------------------------
+    def groupby(self, key: str) -> "GroupedData":
+        """Hash-partition rows by ``key`` for aggregation (reference:
+        Dataset.groupby → GroupedData).  All NaN keys form one group;
+        output groups are key-sorted within each output partition but
+        partitions are in hash order, not key order."""
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs) -> Optional[Dict[str, Any]]:
+        """Whole-dataset aggregation (reference: Dataset.aggregate):
+        ``ds.aggregate(Sum("x"), ("mean", "y"), "count")`` → one dict
+        of results, or None on an empty dataset."""
+        from .aggregate import resolve_aggregate
+
+        resolved = [resolve_aggregate(a) for a in aggs]
+        if not resolved:
+            raise ValueError("aggregate() needs at least one aggregate")
+        rows = _aggregate_exchange(self, None, resolved).take_all()
+        if not rows:
+            return None
+        return dict(rows[0])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate with ``other``, position-aligned
+        (reference: Dataset.zip).  Row counts must match —
+        :class:`~ray_tpu.exceptions.ZipLengthMismatchError` otherwise;
+        colliding column names from ``other`` get a ``_1`` suffix."""
+        return self._with(ZipOp(list(other._ops)))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Append the other datasets' blocks after this one's
+        (reference: Dataset.union).  Column sets must agree —
+        :class:`~ray_tpu.exceptions.UnionSchemaError` otherwise."""
+        if not others:
+            return self
+        return self._with(UnionOp([list(o._ops) for o in others]))
+
     # -- execution ----------------------------------------------------------
     def iter_blocks(self) -> Iterator[Block]:
         self._last_stats = PlanStats()
@@ -219,17 +258,26 @@ class Dataset:
                      drop_last: bool = False,
                      batch_format: str = "numpy",
                      prefetch_batches: Optional[int] = None,
-                     device_put: bool = False) -> Iterator[Any]:
+                     device_put: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
         """Stream exact-size batches (reference dataset.py:3935 +
         _internal/batcher.py).  ``device_put=True`` moves each batch to
-        the default jax device one batch ahead of the consumer."""
+        the default jax device one batch ahead of the consumer.
+        ``local_shuffle_buffer_size`` permutes rows through a rolling
+        buffer of at least that many rows before batching — the cheap
+        within-shard decorrelation Train ingestion uses between full
+        shuffled epochs (a ``random_shuffle()`` exchange)."""
         ctx = DataContext.get_current()
         depth = (ctx.prefetch_batches if prefetch_batches is None
                  else prefetch_batches)
         return _assemble_batches(
             self.iter_blocks(), batch_size=batch_size,
             drop_last=drop_last, batch_format=batch_format,
-            prefetch=depth, device_put=device_put)
+            prefetch=depth, device_put=device_put,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for block in self.iter_blocks():
@@ -344,6 +392,98 @@ class Dataset:
         return f"Dataset({' -> '.join(names)})"
 
 
+class GroupedData:
+    """Deferred groupby (reference: grouped_data.py GroupedData): the
+    aggregate/map_groups call appends the push-exchange op to the
+    plan.  Aggregations combine INCREMENTALLY on the reducers (partial
+    state per distinct key, never raw rows); ``map_groups`` ships raw
+    rows and applies the fn per key-run after the shuffle."""
+
+    def __init__(self, ds: Dataset, key: str):
+        if not isinstance(key, str):
+            raise TypeError(
+                f"groupby key must be a column name, got {key!r}")
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs) -> Dataset:
+        from .aggregate import resolve_aggregate
+
+        resolved = [resolve_aggregate(a) for a in aggs]
+        if not resolved:
+            raise ValueError("aggregate() needs at least one aggregate")
+        return _aggregate_exchange(self._ds, self._key, resolved)
+
+    def count(self) -> Dataset:
+        from .aggregate import Count
+
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        from .aggregate import Sum
+
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        from .aggregate import Min
+
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        from .aggregate import Max
+
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        from .aggregate import Mean
+
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str, ddof: int = 0) -> Dataset:
+        from .aggregate import Std
+
+        return self.aggregate(Std(on, ddof=ddof))
+
+    def map_groups(self, fn: Callable[[Block], Any]) -> Dataset:
+        """Apply ``fn`` to each whole group (a Block of that key's
+        rows); it returns a Block of any shape (reference:
+        GroupedData.map_groups)."""
+        key = self._key
+
+        def partition(block: Block, n: int, _spec, _offset: int):
+            idx = hash_partition_indices(block, key, n)
+            return [(j, BlockAccessor.take(block,
+                                           np.nonzero(idx == j)[0]))
+                    for j in builtins.range(n)]
+
+        def merge(blocks: List[Block], _spec, _idx) -> List[Block]:
+            if not blocks:
+                return []
+            sb = sort_by_key(BlockAccessor.concat(blocks), key)
+            bounds = group_boundaries(sb[key])
+            outs: List[Block] = []
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                res = BlockAccessor.validate(
+                    fn(BlockAccessor.slice(sb, int(s), int(e))))
+                if BlockAccessor.num_rows(res):
+                    outs.append(res)
+            return outs
+
+        return self._ds._with(
+            Exchange(f"MapGroups({key})", partition, merge))
+
+
+def _aggregate_exchange(ds: Dataset, key: Optional[str],
+                        aggs) -> Dataset:
+    from .aggregate import AggCombine, make_agg_partition
+
+    return ds._with(Exchange(
+        f"GroupBy({key})" if key is not None else "Aggregate",
+        make_agg_partition(key, aggs), None,
+        n_out=1 if key is None else -1,
+        combine=AggCombine(key, aggs)))
+
+
 class _SplitRouter:
     """Routes blocks of one shared streaming execution to n consumers,
     round-robin by block index.  Epoch-aware: a consumer that finishes
@@ -442,11 +582,16 @@ class DataIterator:
                      drop_last: bool = False,
                      batch_format: str = "numpy",
                      prefetch_batches: int = 1,
-                     device_put: bool = False) -> Iterator[Any]:
+                     device_put: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
         return _assemble_batches(
             self.iter_blocks(), batch_size=batch_size,
             drop_last=drop_last, batch_format=batch_format,
-            prefetch=prefetch_batches, device_put=device_put)
+            prefetch=prefetch_batches, device_put=device_put,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for block in self.iter_blocks():
@@ -458,7 +603,10 @@ class DataIterator:
 # --------------------------------------------------------------------------
 def _assemble_batches(blocks: Iterator[Block], *, batch_size: int,
                       drop_last: bool, batch_format: str,
-                      prefetch: int, device_put: bool) -> Iterator[Any]:
+                      prefetch: int, device_put: bool,
+                      local_shuffle_buffer_size: Optional[int] = None,
+                      local_shuffle_seed: Optional[int] = None
+                      ) -> Iterator[Any]:
     """Batcher → optional device_put → optional prefetch thread →
     format-on-consumer.  Formatting (e.g. pandas DataFrame build) runs
     on the caller's thread, never the prefetch daemon: pandas' lazy
@@ -466,6 +614,13 @@ def _assemble_batches(blocks: Iterator[Block], *, batch_size: int,
     on other fresh threads (segfault observed under the test suite)."""
     if device_put and batch_format != "numpy":
         raise ValueError("device_put requires batch_format='numpy'")
+    if local_shuffle_buffer_size is not None:
+        if local_shuffle_buffer_size < 1:
+            raise ValueError(
+                "local_shuffle_buffer_size must be >= 1, got "
+                f"{local_shuffle_buffer_size}")
+        blocks = _local_shuffle_iter(blocks, local_shuffle_buffer_size,
+                                     local_shuffle_seed)
     it = _batch_iterator(blocks, batch_size, drop_last)
     if device_put:
         it = _device_put_iter(it)
@@ -500,6 +655,31 @@ def _batch_iterator(blocks: Iterator[Block], batch_size: int,
                 if merged else 0)
     if leftover > 0 and not drop_last:
         yield BlockAccessor.slice(merged, offset, offset + leftover)
+
+
+def _local_shuffle_iter(blocks: Iterator[Block], buffer_rows: int,
+                        seed: Optional[int]) -> Iterator[Block]:
+    """Rolling within-shard shuffle (reference: iter_batches
+    ``local_shuffle_buffer_size`` → ShufflingBatcher): rows pool into
+    a buffer until it holds at least ``buffer_rows``, then the pooled
+    rows are permuted and the surplus beyond half a buffer is emitted
+    — every emitted row was mixed across a window of at least
+    ``buffer_rows`` rows, at memcpy cost instead of an exchange."""
+    rng = np.random.default_rng(seed)
+    hold: Optional[Block] = None
+    for block in blocks:
+        hold = block if hold is None else \
+            BlockAccessor.concat([hold, block])
+        n = BlockAccessor.num_rows(hold)
+        if n >= buffer_rows:
+            hold = BlockAccessor.take(hold, rng.permutation(n))
+            keep = buffer_rows // 2
+            yield BlockAccessor.slice(hold, 0, n - keep)
+            hold = dict(BlockAccessor.slice(hold, n - keep, n)) \
+                if keep else None
+    if hold is not None and BlockAccessor.num_rows(hold):
+        n = BlockAccessor.num_rows(hold)
+        yield BlockAccessor.take(hold, rng.permutation(n))
 
 
 def _format_batch(batch: Block, batch_format: str) -> Any:

@@ -1,0 +1,604 @@
+"""Push-based hash-shuffle exchange (reference: planner/exchange/ +
+push_based_shuffle_task_scheduler.py:590, following the
+Magnet/Exoshuffle line of work).
+
+The pull-based two-stage exchange this replaces materialized every
+partition fragment through the object plane (partition tasks with
+``num_returns=n_out``, merge tasks pulling the parts afterwards).
+Here map tasks PUSH each fragment to its owning reducer *as it is
+produced*, over the cheapest transport the edge supports:
+
+=============  =====================================================
+transport      edge
+=============  =====================================================
+``shm``        mapper and reducer share a /dev/shm namespace and the
+               native ring builds (experimental/channel.py, PR 1):
+               one SPSC ring per (mapper process, reducer), frames
+               assembled in slot memory — one memcpy end to end.
+``dcn``        cross-host: the fragment rides the striped multi-
+               stream push sockets (cluster/client.py
+               ``broadcast_object``, PR 6) into the reducer node's
+               plasma foreign cache; the accept RPC then resolves it
+               locally.
+``obj``        everything else (no native rings, single-process
+               local mode fallbacks, transport errors): the fragment
+               travels as a plain actor-call argument through the
+               object plane.
+=============  =====================================================
+
+Reducers are streaming and spill-aware: raw-block exchanges buffer
+fragments per output partition and move a partition's buffer to
+plasma when it outgrows ``DataContext.shuffle_spill_limit_bytes``
+(plasma LRU-spills to disk under its own pressure), while combinable
+exchanges (groupby aggregates) fold every arriving fragment into a
+running partial-state block and never hold raw rows at all.
+
+Failure semantics: map tasks run with ``max_retries=0`` (a retried
+map would re-push duplicate fragments); any map failure, reducer
+error, or missed landing deadline tears down the reducers and rings
+first and then raises a typed :class:`ShuffleError` — no hung reader
+threads, no wedged reducers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..exceptions import ChannelError, ShuffleError
+from .block import Block, BlockAccessor
+from .context import DataContext
+from .executor import (OpStats, _meta, _RefGroup, _run_sample_wrapped)
+
+
+def _shuffle_metrics():
+    from ..observability.metrics import shuffle_counters
+
+    return shuffle_counters()
+
+
+def _host_key() -> str:
+    """This process's /dev/shm namespace key — same convention as
+    channel.channel_location: the node IP in cluster mode, "local"
+    otherwise (all local-mode tasks/actors are threads in one
+    process)."""
+    from ..core.runtime import try_get_runtime
+
+    rt = try_get_runtime()
+    if rt is None or rt.cluster is None:
+        return "local"
+    return rt.address.rsplit(":", 1)[0]
+
+
+# ---------------------------------------------------------------------------
+# Reducer actor
+# ---------------------------------------------------------------------------
+
+class _ShuffleReducer:
+    """Owns output partitions ``j`` with ``j % R == r``.  Sync +
+    max_concurrency=1 (the channel-capability contract), so ring
+    frames are drained by per-ring daemon reader threads instead of
+    the actor mailbox — accept RPCs and ring pumps converge on
+    :meth:`_ingest` under one lock."""
+
+    def __init__(self, shuffle_id: str, merge_fn, combine, spec,
+                 spill_limit: int, ring_timeout: float):
+        self._sid = shuffle_id
+        self._merge_fn = merge_fn
+        self._combine = combine
+        self._spec = spec
+        self._spill_limit = int(spill_limit)
+        self._ring_timeout = float(ring_timeout)
+        self._lock = threading.Lock()
+        # part_idx -> [(order_key, [block])]; deterministic replay
+        # order is restored by sorting on order_key at take time.
+        self._frags: Dict[int, List[Tuple[Any, List[Block]]]] = {}
+        self._frag_bytes: Dict[int, int] = {}
+        self._spilled: Dict[int, List[Any]] = {}  # part_idx -> [ref]
+        self._states: Dict[int, Block] = {}       # combine mode
+        self._received = 0
+        self._queue_depth = 0
+        self._error: Optional[str] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._readers: List[Any] = []
+        self._ring_paths: List[str] = []
+
+    def ping(self) -> str:
+        return "ok"
+
+    # -- transports ---------------------------------------------------------
+    def attach_ring(self, path: str) -> None:
+        """Register a mapper-created shm ring and pump it from a
+        daemon thread (one ring per writing mapper process — SPSC)."""
+        from ..experimental.channel import ChannelReader
+
+        with self._lock:
+            if path in self._ring_paths:
+                return
+            self._ring_paths.append(path)
+        reader = ChannelReader(path, timeout=2.0)
+        self._readers.append(reader)
+        t = threading.Thread(target=self._ring_pump, args=(reader,),
+                             daemon=True,
+                             name=f"shfl-pump-{self._sid[:6]}")
+        self._threads.append(t)
+        t.start()
+
+    def _ring_pump(self, reader) -> None:
+        while not self._stop.is_set():
+            try:
+                frame = reader.get_value()
+            except ChannelError as e:
+                if self._stop.is_set():
+                    return
+                msg = str(e)
+                # Short reader deadlines are the poll cadence, not a
+                # failure: a slow mapper just hasn't pushed yet.
+                if "deadline" in msg or "never created" in msg:
+                    continue
+                if ("torn down" in msg or "closed" in msg
+                        or "destroyed" in msg):
+                    return
+                self._record_error(e)
+                return
+            except BaseException as e:  # noqa: BLE001 — reducer-side
+                self._record_error(e)
+                return
+            try:
+                sid, entries = frame
+                if sid != self._sid:
+                    continue
+                for part_idx, order_key, piece in entries:
+                    self._ingest(int(part_idx), order_key, [piece])
+            except BaseException as e:  # noqa: BLE001
+                self._record_error(e)
+                return
+
+    def accept(self, shuffle_id: str, entries) -> None:
+        """Object-plane push: fragments arrive as call arguments."""
+        if shuffle_id != self._sid:
+            return
+        for part_idx, order_key, piece in entries:
+            self._ingest(int(part_idx), order_key, [piece])
+
+    def accept_ref(self, shuffle_id: str, ref) -> None:
+        """DCN push: ``broadcast_object`` landed the payload in this
+        node's plasma foreign cache, so the get() resolves locally.
+        Foreign-cache entries are EVICTABLE views — copy the arrays
+        before buffering."""
+        import ray_tpu
+
+        if shuffle_id != self._sid:
+            return
+        for part_idx, order_key, piece in ray_tpu.get(ref):
+            owned = {k: np.array(v, copy=True) for k, v in piece.items()}
+            self._ingest(int(part_idx), order_key, [owned])
+
+    # -- buffering / combining ----------------------------------------------
+    def _ingest(self, part_idx: int, order_key, blocks: List[Block]
+                ) -> None:
+        if self._combine is not None:
+            # Running partial aggregate: fold the fragment into the
+            # partition's state block — raw rows are never retained.
+            with self._lock:
+                self._states[part_idx] = self._combine.add(
+                    self._states.get(part_idx), blocks)
+                self._received += 1
+            return
+        nbytes = sum(BlockAccessor.size_bytes(b) for b in blocks)
+        spill: Optional[List[Tuple[Any, List[Block]]]] = None
+        spill_bytes = 0
+        with self._lock:
+            self._frags.setdefault(part_idx, []).append(
+                (order_key, blocks))
+            self._queue_depth += 1
+            self._received += 1
+            total = self._frag_bytes.get(part_idx, 0) + nbytes
+            if total >= self._spill_limit:
+                # Partition outgrew its memory budget: hand the
+                # buffered fragments to plasma (put happens OUTSIDE
+                # the lock) and start a fresh buffer.
+                spill = self._frags.pop(part_idx)
+                spill_bytes, total = total, 0
+            self._frag_bytes[part_idx] = total
+            depth = self._queue_depth
+        if spill is not None:
+            import ray_tpu
+
+            ref = ray_tpu.put(spill)
+            with self._lock:
+                self._spilled.setdefault(part_idx, []).append(ref)
+                self._queue_depth -= len(spill)
+                depth = self._queue_depth
+            _shuffle_metrics()["spilled_bytes"].inc(spill_bytes)
+        _shuffle_metrics()["reduce_queue_depth"].set(depth)
+
+    def _record_error(self, err: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = f"{type(err).__name__}: {err}"
+
+    # -- driver protocol ----------------------------------------------------
+    def progress(self, shuffle_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return {"received": self._received, "error": self._error}
+
+    def take_partition(self, shuffle_id: str, part_idx: int):
+        """Finalize one owned output partition: merge (or combine-
+        finalize) everything that landed for it and return the blocks
+        in the executor's ``(group, meta)`` convention."""
+        import ray_tpu
+
+        if self._combine is not None:
+            with self._lock:
+                state = self._states.pop(part_idx, None)
+            blocks = self._combine.finalize(state, self._spec, part_idx)
+        else:
+            with self._lock:
+                frags = self._frags.pop(part_idx, [])
+                self._frag_bytes.pop(part_idx, None)
+                refs = self._spilled.pop(part_idx, [])
+                self._queue_depth -= len(frags)
+                depth = self._queue_depth
+            for ref in refs:
+                frags.extend(ray_tpu.get(ref))
+            # Fragments arrive in whatever order the transports race
+            # them in; (map group, sequence) keys restore the exact
+            # order the deleted pull path saw, keeping seeded
+            # shuffles / stable sorts deterministic.
+            frags.sort(key=lambda t: t[0])
+            blocks = [b for _k, bl in frags for b in bl]
+            blocks = self._merge_fn(blocks, self._spec, part_idx)
+            _shuffle_metrics()["reduce_queue_depth"].set(depth)
+        _shuffle_metrics()["partitions"].inc()
+        return blocks, _meta(blocks)
+
+    def shutdown(self) -> None:
+        """Stop ring pumps, tear rings down, join threads."""
+        from ..experimental.channel import destroy_channel
+
+        self._stop.set()
+        for path in list(self._ring_paths):
+            try:
+                destroy_channel(path)
+            except Exception:
+                pass
+        for reader in self._readers:
+            try:
+                reader.close()
+            except Exception:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# Map side
+# ---------------------------------------------------------------------------
+
+# Per-process ring registry: (shuffle_id, reducer_idx) -> (writer,
+# lock).  Guarantees ONE writer endpoint per ring in this process
+# (several map-task threads share it; ChannelWriter itself is not
+# thread-safe), and bounds stale entries from finished exchanges.
+_ring_registry: Dict[Tuple[str, int], Tuple[Any, threading.Lock]] = {}
+_ring_registry_lock = threading.Lock()
+_RING_REGISTRY_MAX = 64
+
+
+def _evict_stale_rings() -> None:
+    """Caller holds _ring_registry_lock."""
+    while len(_ring_registry) > _RING_REGISTRY_MAX:
+        _key, (writer, _l) = next(iter(_ring_registry.items()))
+        _ring_registry.pop(_key)
+        try:
+            writer.destroy()
+        except Exception:
+            pass
+
+
+class _FragmentSender:
+    """Per-map-task transport mux: picks shm ring / dcn push / object
+    plane per reducer and counts ``ray_tpu_shuffle_bytes`` by
+    transport on the send side."""
+
+    def __init__(self, sid: str, infos, ring_slots: int,
+                 timeout: float):
+        self._sid = sid
+        self._infos = infos  # [(handle, host_key, node_address)]
+        self._ring_slots = ring_slots
+        self._timeout = timeout
+        self._host = _host_key()
+        self._no_ring: set = set()
+        self._pinned_refs: List[Any] = []
+
+    def _ring_for(self, r: int):
+        """The (writer, lock) shm endpoint for reducer ``r``, created
+        (and announced via attach_ring) once per process, or None when
+        the edge can't ride a ring."""
+        from ..experimental.channel import (ChannelWriter, channel_path,
+                                            channels_available)
+
+        if r in self._no_ring:
+            return None
+        handle, host, _addr = self._infos[r]
+        if host is None or host != self._host:
+            return None
+        if not channels_available():
+            return None
+        key = (self._sid, r)
+        with _ring_registry_lock:
+            ent = _ring_registry.get(key)
+            if ent is not None:
+                return ent
+        # Create outside the registry lock (attach is a remote call);
+        # losing a creation race just means one redundant ring.
+        import ray_tpu
+
+        path = channel_path(f"shfl-{self._sid[:6]}-r{r}")
+        writer = ChannelWriter(path, n_slots=self._ring_slots,
+                               timeout=self._timeout)
+        try:
+            ray_tpu.get(handle.attach_ring.remote(path))
+        except Exception:
+            self._no_ring.add(r)
+            return None
+        with _ring_registry_lock:
+            ent = _ring_registry.get(key)
+            if ent is None:
+                ent = _ring_registry[key] = (writer, threading.Lock())
+                _evict_stale_rings()
+        return ent
+
+    def flush(self, r: int, entries, pending: List[Any]) -> int:
+        """Push one coalesced fragment list to reducer ``r``.  Returns
+        the number of fragment entries delivered (the driver's
+        progress accounting unit)."""
+        from ray_tpu.experimental.chaos import ChaosKill
+
+        handle, _host, addr = self._infos[r]
+        nbytes = sum(BlockAccessor.size_bytes(p) for _i, _k, p in entries)
+        ent = self._ring_for(r)
+        if ent is not None:
+            writer, lock = ent
+            try:
+                with lock:
+                    writer.put_value((self._sid, entries))
+                _shuffle_metrics()["bytes"].inc(
+                    nbytes, tags={"transport": "shm"})
+                return len(entries)
+            except ChaosKill:
+                raise
+            except Exception:
+                # Ring failed mid-exchange (torn down, oversized ring
+                # create, native error): degrade this reducer edge to
+                # the object plane for the rest of the task.
+                self._no_ring.add(r)
+        if addr is not None and self._host not in (None, "local") \
+                and addr.rsplit(":", 1)[0] != self._host:
+            # Cross-host: pre-push the payload over the striped DCN
+            # sockets so the reducer's get() resolves from its local
+            # foreign cache instead of pulling back across hosts.
+            from ..core.runtime import try_get_runtime
+
+            rt = try_get_runtime()
+            if rt is not None and rt.cluster is not None:
+                import ray_tpu
+
+                try:
+                    ref = ray_tpu.put(entries)
+                    self._pinned_refs.append(ref)
+                    rt.cluster.broadcast_object(
+                        ref, [addr], timeout=self._timeout)
+                    pending.append(
+                        handle.accept_ref.remote(self._sid, ref))
+                    _shuffle_metrics()["bytes"].inc(
+                        nbytes, tags={"transport": "dcn"})
+                    return len(entries)
+                except Exception:
+                    pass  # fall through to the object plane
+        pending.append(handle.accept.remote(self._sid, entries))
+        _shuffle_metrics()["bytes"].inc(nbytes, tags={"transport": "obj"})
+        return len(entries)
+
+
+def _push_map_task(group, sid: str, partition_fn, n_out: int, spec,
+                   offset: int, group_idx: int, infos,
+                   frag_bytes: int, ring_slots: int,
+                   timeout: float) -> List[int]:
+    """One map task: partition this input group's blocks and push
+    every fragment to its owning reducer as produced, coalescing per
+    reducer up to ``frag_bytes``.  Returns per-reducer entry counts —
+    the driver's expected-landing ledger.  MUST run with
+    max_retries=0: a retry would push duplicates."""
+    import ray_tpu
+
+    blocks = group.resolve() if isinstance(group, _RefGroup) else group
+    R = len(infos)
+    sender = _FragmentSender(sid, infos, ring_slots, timeout)
+    bufs: List[List[Tuple[int, Tuple[int, int], Block]]] = \
+        [[] for _ in range(R)]
+    buf_bytes = [0] * R
+    counts = [0] * R
+    pending: List[Any] = []
+    seq = 0
+    off = int(offset)
+    for block in blocks:
+        for idx, piece in partition_fn(block, n_out, spec, off):
+            if not BlockAccessor.num_rows(piece):
+                continue
+            r = idx % R
+            bufs[r].append((idx, (group_idx, seq), piece))
+            seq += 1
+            buf_bytes[r] += BlockAccessor.size_bytes(piece)
+            if buf_bytes[r] >= frag_bytes:
+                counts[r] += sender.flush(r, bufs[r], pending)
+                bufs[r], buf_bytes[r] = [], 0
+        off += BlockAccessor.num_rows(block)
+    for r in range(R):
+        if bufs[r]:
+            counts[r] += sender.flush(r, bufs[r], pending)
+    # Await the accept RPCs: the task ends only once its object-plane
+    # and DCN fragments are INSIDE the reducers (pins the payload refs
+    # until delivery, and makes the returned counts a lower bound the
+    # driver can trust).
+    if pending:
+        ray_tpu.get(pending)
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# Driver orchestration
+# ---------------------------------------------------------------------------
+
+def exchange_streaming(source, op, ctx: Optional[DataContext], stats):
+    """Run one Exchange op push-based.  Yields one ``(group, meta)``
+    ref per output partition, in partition order — the same contract
+    as every other streaming phase."""
+    import ray_tpu
+
+    ctx = ctx or DataContext.get_current()
+    op_stats = OpStats(op.name)
+    if stats is not None:
+        stats.ops.append(op_stats)
+    t0 = time.perf_counter()
+    input_refs = list(source)
+    if not input_refs:
+        op_stats.wall_s = time.perf_counter() - t0
+        return iter(())
+
+    n_out = op.n_out if op.n_out > 0 else len(input_refs)
+    if op.needs_offsets:
+        # Sample stage: group row counts (exact global offsets) plus
+        # the op's own samples (e.g. sort range bounds).
+        remote_sample = ray_tpu.remote(_run_sample_wrapped)
+        sampled = ray_tpu.get(
+            [remote_sample.remote(_RefGroup(r), op.sample_fn)
+             for r in input_refs])
+        rows_per_group = [s[0] for s in sampled]
+        offsets = list(np.cumsum([0] + rows_per_group[:-1]))
+        spec = None
+        if op.sample_fn is not None:
+            spec = op.bounds_fn([s[1] for s in sampled], n_out)
+        if op.n_out <= 0 and sum(rows_per_group) == 0:
+            op_stats.wall_s = time.perf_counter() - t0
+            return iter(())
+        spec = {"spec": spec, "total": int(sum(rows_per_group))}
+    else:
+        # The "offset" handed to the partition fn is the group INDEX —
+        # enough to decorrelate per-group randomness under a fixed
+        # seed.
+        offsets = list(range(len(input_refs)))
+        spec = {"spec": None, "total": -1}
+
+    sid = uuid.uuid4().hex[:12]
+    R = max(1, min(n_out, ctx.shuffle_reducers))
+    Reducer = ray_tpu.remote(_ShuffleReducer)
+    reducers = [
+        Reducer.remote(sid, op.merge_fn, op.combine, spec,
+                       ctx.shuffle_spill_limit_bytes,
+                       ctx.shuffle_timeout_s)
+        for _ in range(R)]
+
+    def teardown():
+        for h in reducers:
+            try:
+                ray_tpu.wait([h.shutdown.remote()], num_returns=1,
+                             timeout=5.0)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+
+    def abort(reason: str, cause: Optional[BaseException] = None,
+              extra: Optional[dict] = None):
+        # The enclosing except tears the reducers/rings down before
+        # this propagates out of the exchange.
+        err = ShuffleError(reason, context={
+            "exchange": op.name, "shuffle_id": sid, **(extra or {})})
+        if cause is not None:
+            raise err from cause
+        raise err
+
+    try:
+        # Reducers must be ALIVE before the channel-capability probe,
+        # or every same-host edge would silently degrade to obj.
+        ray_tpu.get([h.ping.remote() for h in reducers])
+        from ..experimental.channel import (channel_location,
+                                            channels_available)
+
+        infos = []
+        for h in reducers:
+            loc = channel_location(h) if channels_available() else None
+            infos.append((h, loc[0] if loc else None,
+                          loc[1] if loc else None))
+
+        remote_map = ray_tpu.remote(_push_map_task).options(
+            max_retries=0)
+        map_refs = [
+            remote_map.remote(
+                _RefGroup(ref), sid, op.partition_fn, n_out, spec,
+                int(off), i, infos, ctx.shuffle_fragment_bytes,
+                ctx.shuffle_ring_slots, ctx.shuffle_timeout_s)
+            for i, (ref, off) in enumerate(zip(input_refs, offsets))]
+        op_stats.num_tasks += len(map_refs)
+
+        expected = [0] * R
+        pending_maps = list(map_refs)
+        while pending_maps:
+            ready, pending_maps = ray_tpu.wait(
+                pending_maps, num_returns=1, timeout=None)
+            for ref in ready:
+                try:
+                    counts = ray_tpu.get(ref)
+                except BaseException as e:  # noqa: BLE001
+                    abort("map task failed mid-shuffle", cause=e)
+                for r, c in enumerate(counts):
+                    expected[r] += c
+
+        # All map tasks returned: their obj/dcn fragments are already
+        # inside the reducers; ring frames may still be in flight —
+        # poll the reducers' landing ledgers up to the deadline.
+        deadline = time.monotonic() + ctx.shuffle_timeout_s
+        pause = threading.Event()  # never set: wait() = bounded sleep
+        while True:
+            prog = ray_tpu.get(
+                [h.progress.remote(sid) for h in reducers])
+            errs = [p["error"] for p in prog if p["error"]]
+            if errs:
+                abort("reducer failed mid-shuffle",
+                      extra={"reducer_error": errs[0]})
+            if all(p["received"] >= e
+                   for p, e in zip(prog, expected)):
+                break
+            if time.monotonic() > deadline:
+                abort("pushed fragments never landed within "
+                      f"{ctx.shuffle_timeout_s:g}s",
+                      extra={"expected": expected,
+                             "received": [p["received"] for p in prog]})
+            pause.wait(timeout=0.02)
+
+        out_refs = [reducers[j % R].take_partition.remote(sid, j)
+                    for j in range(n_out)]
+        op_stats.num_tasks += n_out
+    except BaseException:
+        teardown()
+        raise
+
+    def gen():
+        try:
+            for ref in out_refs:
+                ray_tpu.wait([ref], num_returns=1, timeout=None)
+                op_stats.num_blocks += 1
+                yield ref
+        finally:
+            op_stats.wall_s = time.perf_counter() - t0
+            teardown()
+
+    return gen()

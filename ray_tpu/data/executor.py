@@ -19,9 +19,11 @@ TPU-first redesign:
   driver holds location records and hands refs straight to downstream
   tasks, which pull node-to-node over the chunk protocol.  Values only
   materialize at the final consumption point.  Exchanges (shuffle /
-  sort / repartition) are two distributed stages — partition tasks with
-  ``num_returns=n`` and merge tasks taking the parts as ref args — so
-  no intermediate data crosses the driver.
+  sort / repartition / groupby) are PUSH-BASED (data/exchange.py): map
+  tasks hash/range-partition rows and push each fragment to its owning
+  streaming reducer as produced — same-host over shm rings, cross-host
+  over the striped DCN push sockets — so no intermediate data crosses
+  the driver and reducers combine/spill incrementally.
 """
 
 from __future__ import annotations
@@ -100,26 +102,56 @@ class ActorMapBlocks(LogicalOp):
 
 
 class Exchange(LogicalOp):
-    """Distributed all-to-all: a partition stage (one task per input
-    group, ``num_returns=n_out``) followed by a merge stage (one task
-    per output partition) — reference planner/exchange/.  ``sample_fn``
-    (optional) runs per input group first; ``bounds_fn`` reduces the
-    samples driver-side into the small partition spec (e.g. sort range
-    bounds)."""
+    """Distributed all-to-all, executed push-based (data/exchange.py):
+    map tasks run ``partition_fn`` per block and push fragments to
+    streaming reducer actors as produced; each reducer finalizes its
+    owned output partitions with ``merge_fn`` — or, when ``combine``
+    is given, folds every arriving fragment into a running partial
+    state (groupby aggregates) and never buffers raw rows.
+    ``sample_fn`` (optional) runs per input group first; ``bounds_fn``
+    reduces the samples driver-side into the small partition spec
+    (e.g. sort range bounds)."""
 
     def __init__(self, name: str, partition_fn, merge_fn, n_out: int = -1,
                  sample_fn=None, bounds_fn=None,
-                 needs_offsets: bool = False):
+                 needs_offsets: bool = False, combine=None):
         self.name = name
         self.partition_fn = partition_fn
         self.merge_fn = merge_fn
         self.n_out = n_out
         self.sample_fn = sample_fn
         self.bounds_fn = bounds_fn
+        # An object with ``add(state, blocks) -> state`` and
+        # ``finalize(state, spec, part_idx) -> List[Block]``: the
+        # reducers' incremental-combine mode.
+        self.combine = combine
         # True when partition_fn consumes exact global row offsets /
         # totals (repartition); forces the sample round even without a
         # sample_fn.
         self.needs_offsets = needs_offsets or sample_fn is not None
+
+
+class ZipOp(LogicalOp):
+    """Barrier: column-concatenate this plan's rows with another
+    plan's rows, position-aligned (reference: Dataset.zip →
+    ZipOperator).  Row counts must match — checked driver-side from a
+    metadata round before any block moves."""
+
+    name = "Zip"
+
+    def __init__(self, other_ops: List["LogicalOp"]):
+        self.other_ops = other_ops
+
+
+class UnionOp(LogicalOp):
+    """Barrier: append other plans' blocks after this plan's
+    (reference: Dataset.union).  Column sets must agree — checked via
+    a schema probe before the streams interleave."""
+
+    name = "Union"
+
+    def __init__(self, others: List[List["LogicalOp"]]):
+        self.others = others
 
 
 class AllToAll(LogicalOp):
@@ -200,33 +232,6 @@ def _apply(blocks: List[Block], transforms: Sequence[Transform]
     return [b for b in blocks if BlockAccessor.num_rows(b) > 0]
 
 
-def _run_partition(group: List[Block], n_out: int, partition_fn,
-                   spec, offset: int) -> List[List[Block]]:
-    """Split a group's rows into n_out part-lists (one per output
-    partition).  ``offset`` is this group's global starting row (from
-    the sample stage), letting partition functions compute exact
-    global row ranges."""
-    parts: List[List[Block]] = [[] for _ in range(n_out)]
-    for block in group:
-        for idx, piece in partition_fn(block, n_out, spec, offset):
-            if BlockAccessor.num_rows(piece):
-                parts[idx].append(piece)
-        offset += BlockAccessor.num_rows(block)
-    return parts
-
-
-def _run_merge(merge_fn, spec, part_idx, *part_lists):
-    blocks: List[Block] = []
-    for pl in part_lists:
-        blocks.extend(pl)
-    merged = merge_fn(blocks, spec, part_idx)
-    return merged, _meta(merged)
-
-
-def _run_sample(group: List[Block], sample_fn):
-    return sample_fn(group)
-
-
 class _PoolWorker:
     """Actor-pool map worker: holds one instance of the user's class."""
 
@@ -293,7 +298,8 @@ def compile_plan(ops: Sequence[LogicalOp]
                 flush()
                 phases.append(AllToAll(
                     "Limit", lambda blocks, ctx, n=n: _truncate(blocks, n)))
-        elif isinstance(op, (AllToAll, Exchange, ActorMapBlocks)):
+        elif isinstance(op, (AllToAll, Exchange, ActorMapBlocks,
+                             ZipOp, UnionOp)):
             flush()
             phases.append(op)
         else:
@@ -377,7 +383,13 @@ def _execute_refs(ops, ctx, stats):
         if isinstance(barrier, ActorMapBlocks):
             source = _stream_actor_pool(source, barrier, ctx, stats)
         elif isinstance(barrier, Exchange):
-            source = _stream_exchange(source, barrier, ctx, stats)
+            from .exchange import exchange_streaming
+
+            source = exchange_streaming(source, barrier, ctx, stats)
+        elif isinstance(barrier, ZipOp):
+            source = _stream_zip(source, barrier, ctx, stats)
+        elif isinstance(barrier, UnionOp):
+            source = _stream_union(source, barrier, ctx, stats)
         else:
             source = _run_driver_barrier(source, barrier, ctx, stats)
         if map_phase.transforms:
@@ -547,64 +559,95 @@ def _resolve_groups(args):
     return [a.resolve() if isinstance(a, _RefGroup) else a for a in args]
 
 
-def _stream_exchange(source, op: Exchange, ctx, stats):
-    """Two-stage distributed exchange: partition tasks (num_returns =
-    n_out) then merge tasks taking the parts as ref args.  Part values
-    move node-to-node (object-plane primaries); the driver only routes
-    refs (reference: planner/exchange/ push-based shuffle)."""
+def _run_sample_wrapped(group, sample_fn):
+    blocks = _resolve_groups([group])[0]
+    rows = sum(BlockAccessor.num_rows(b) for b in blocks)
+    return rows, (sample_fn(blocks) if sample_fn is not None else None)
+
+
+def _rows_of(group) -> int:
+    blocks = _resolve_groups([group])[0]
+    return sum(BlockAccessor.num_rows(b) for b in blocks)
+
+
+def _schema_of(group):
+    """Column names + dtype strings of the group's first non-empty
+    block, or None (the union schema probe's unit)."""
+    blocks = _resolve_groups([group])[0]
+    for b in blocks:
+        if BlockAccessor.num_rows(b):
+            return {k: str(v) for k, v in
+                    BlockAccessor.schema(b).items()}
+    return None
+
+
+def _zip_slice(left_group, lo, hi, right_groups, right_starts):
+    """Zip one left group (global rows [lo, hi)) with the matching
+    row range gathered from the overlapping right groups.  Colliding
+    right column names get a ``_1`` suffix (reference zip
+    convention)."""
+    lblocks = _resolve_groups([left_group])[0]
+    lb = BlockAccessor.concat(lblocks)
+    pieces: List[Block] = []
+    for g, start in zip(right_groups, right_starts):
+        rb = BlockAccessor.concat(_resolve_groups([g])[0])
+        n = BlockAccessor.num_rows(rb)
+        s, e = max(lo - start, 0), min(hi - start, n)
+        if e > s:
+            pieces.append(BlockAccessor.slice(rb, s, e))
+    rb = BlockAccessor.concat(pieces)
+    out: Block = dict(lb)
+    for k, v in rb.items():
+        out[k if k not in lb else f"{k}_1"] = v
+    blocks = [out]
+    return blocks, _meta(blocks)
+
+
+def _stream_zip(source, op: ZipOp, ctx, stats):
+    """Driver-coordinated barrier: one metadata round (row counts per
+    group, both sides), then one zip-slice task per LEFT group that
+    gathers its row range from the overlapping right groups — block
+    values still move node-to-node."""
     import ray_tpu
 
-    op_stats = OpStats(op.name)
+    from ..exceptions import ZipLengthMismatchError
+
+    op_stats = OpStats("Zip")
     if stats is not None:
         stats.ops.append(op_stats)
     t0 = time.perf_counter()
-    input_refs = list(source)
-    if not input_refs:
+    left = list(source)
+    rgen = _execute_refs(op.other_ops, ctx, stats)
+    rgen.send(None)  # prime; a nested plan's limit cannot stream
+    right = list(rgen)
+    remote_rows = ray_tpu.remote(_rows_of)
+    lrows = ray_tpu.get([remote_rows.remote(_RefGroup(r))
+                         for r in left])
+    rrows = ray_tpu.get([remote_rows.remote(_RefGroup(r))
+                         for r in right])
+    if sum(lrows) != sum(rrows):
         op_stats.wall_s = time.perf_counter() - t0
-        return iter(())
-    n_out = op.n_out if op.n_out > 0 else len(input_refs)
-
-    if op.needs_offsets:
-        # Sample stage: group row counts (for exact global offsets)
-        # plus the op's own samples (e.g. sort range bounds).
-        remote_sample = ray_tpu.remote(_run_sample_wrapped)
-        sampled = ray_tpu.get(
-            [remote_sample.remote(_RefGroup(r), op.sample_fn)
-             for r in input_refs])
-        rows_per_group = [s[0] for s in sampled]
-        offsets = list(np.cumsum([0] + rows_per_group[:-1]))
-        spec = None
-        if op.sample_fn is not None:
-            spec = op.bounds_fn([s[1] for s in sampled], n_out)
-        if op.n_out <= 0 and sum(rows_per_group) == 0:
-            op_stats.wall_s = time.perf_counter() - t0
-            return iter(())
-        spec = {"spec": spec, "total": int(sum(rows_per_group))}
-    else:
-        # No sampling needed (shuffle): the "offset" handed to the
-        # partition fn is the group INDEX — enough to decorrelate
-        # per-group randomness under a fixed seed.
-        offsets = list(range(len(input_refs)))
-        spec = {"spec": None, "total": -1}
-
-    remote_part = ray_tpu.remote(_run_partition_wrapped)
-    remote_merge = ray_tpu.remote(_run_merge_wrapped)
-    part_refs = [
-        remote_part.options(num_returns=n_out).remote(
-            _RefGroup(r), n_out, op.partition_fn, spec, int(off))
-        for r, off in zip(input_refs, offsets)]
-    op_stats.num_tasks += len(input_refs)
-    if n_out == 1:
-        part_refs = [[r] for r in part_refs]
-    merge_refs = []
-    for j in range(n_out):
-        merge_refs.append(remote_merge.remote(
-            op.merge_fn, spec, j, *[parts[j] for parts in part_refs]))
+        raise ZipLengthMismatchError(sum(lrows), sum(rrows))
+    loffs = np.cumsum([0] + lrows)
+    roffs = list(np.cumsum([0] + rrows))
+    remote_zip = ray_tpu.remote(_zip_slice)
+    out_refs = []
+    for i, ref in enumerate(left):
+        lo, hi = int(loffs[i]), int(loffs[i + 1])
+        if hi == lo:
+            continue
+        overlap = [(right[j], int(roffs[j]))
+                   for j in range(len(right))
+                   if roffs[j] < hi and roffs[j + 1] > lo]
+        out_refs.append(remote_zip.remote(
+            _RefGroup(ref), lo, hi,
+            [_RefGroup(r) for r, _s in overlap],
+            [s for _r, s in overlap]))
         op_stats.num_tasks += 1
 
     def gen():
         try:
-            for ref in merge_refs:
+            for ref in out_refs:
                 ray_tpu.wait([ref], num_returns=1, timeout=None)
                 op_stats.num_blocks += 1
                 yield ref
@@ -614,22 +657,49 @@ def _stream_exchange(source, op: Exchange, ctx, stats):
     return gen()
 
 
-def _run_sample_wrapped(group, sample_fn):
-    blocks = _resolve_groups([group])[0]
-    rows = sum(BlockAccessor.num_rows(b) for b in blocks)
-    return rows, (sample_fn(blocks) if sample_fn is not None else None)
+def _stream_union(source, op: UnionOp, ctx, stats):
+    """Append the other plans' ref streams after this one, after a
+    schema probe confirms every source shares one column set."""
+    import ray_tpu
 
+    from ..exceptions import UnionSchemaError
 
-def _run_partition_wrapped(group, n_out, partition_fn, spec, offset):
-    blocks = _resolve_groups([group])[0]
-    parts = _run_partition(blocks, n_out, partition_fn, spec, offset)
-    if n_out == 1:
-        return parts[0]
-    return parts
+    op_stats = OpStats("Union")
+    if stats is not None:
+        stats.ops.append(op_stats)
+    t0 = time.perf_counter()
+    streams = [list(source)]
+    for other_ops in op.others:
+        g = _execute_refs(other_ops, ctx, stats)
+        g.send(None)
+        streams.append(list(g))
+    remote_schema = ray_tpu.remote(_schema_of)
+    schemas = []
+    for refs in streams:
+        found = None
+        for s in ray_tpu.get([remote_schema.remote(_RefGroup(r))
+                              for r in refs]):
+            if s is not None:
+                found = s
+                break
+        schemas.append(found)
+    base = next((s for s in schemas if s is not None), None)
+    if base is not None:
+        for s in schemas[1:]:
+            if s is not None and set(s) != set(base):
+                op_stats.wall_s = time.perf_counter() - t0
+                raise UnionSchemaError(base, s)
 
+    def gen():
+        try:
+            for refs in streams:
+                for ref in refs:
+                    op_stats.num_blocks += 1
+                    yield ref
+        finally:
+            op_stats.wall_s = time.perf_counter() - t0
 
-def _run_merge_wrapped(merge_fn, spec, part_idx, *part_lists):
-    return _run_merge(merge_fn, spec, part_idx, *part_lists)
+    return gen()
 
 
 def _run_driver_barrier(source, barrier: AllToAll, ctx, stats):

@@ -144,6 +144,53 @@ class ChannelError(RayTpuError):
         return (type(self), (self.reason, self.context))
 
 
+class ShuffleError(RayTpuError):
+    """A push-based exchange (data/exchange.py) failed as a whole: a
+    map task died mid-shuffle, pushed fragments never landed at their
+    reducers within the deadline, or a reducer actor was lost.  The
+    exchange tears its reducers/rings down BEFORE raising, so a failed
+    shuffle never leaves hung reader threads behind.  ``context`` names
+    the exchange (op, shuffle id, expected/received fragment counts)."""
+
+    def __init__(self, reason: str = "shuffle failed", context=None):
+        self.reason = reason
+        self.context = dict(context or {})
+        super().__init__(reason + _format_context(self.context))
+
+    def __reduce__(self):
+        return (type(self), (self.reason, self.context))
+
+
+class ZipLengthMismatchError(RayTpuError, ValueError):
+    """``Dataset.zip`` requires equal row counts; raised driver-side
+    from the metadata round, before any block moves."""
+
+    def __init__(self, left_rows: int, right_rows: int):
+        self.left_rows = int(left_rows)
+        self.right_rows = int(right_rows)
+        super().__init__(
+            f"Dataset.zip requires equal row counts: left has "
+            f"{self.left_rows} rows, right has {self.right_rows}")
+
+    def __reduce__(self):
+        return (type(self), (self.left_rows, self.right_rows))
+
+
+class UnionSchemaError(RayTpuError, TypeError):
+    """``Dataset.union`` requires every source to share one column
+    set; raised from the schema probe before blocks interleave."""
+
+    def __init__(self, left_schema, right_schema):
+        self.left_schema = sorted(left_schema)
+        self.right_schema = sorted(right_schema)
+        super().__init__(
+            f"Dataset.union sources disagree on columns: "
+            f"{self.left_schema} vs {self.right_schema}")
+
+    def __reduce__(self):
+        return (type(self), (self.left_schema, self.right_schema))
+
+
 class ObjectFreedError(ObjectLostError):
     """Object was explicitly freed by the application."""
 

@@ -327,6 +327,16 @@ def default_rules() -> List[AlertRule]:
                         "— allocations are about to OOM (or the paged "
                         "KV pool is about to preempt)"),
         AlertRule(
+            "shuffle-spilling",
+            "increase(ray_tpu_shuffle_spilled_bytes)[60s]",
+            ">", _env_f("RAY_TPU_ALERT_SHUFFLE_SPILL_BYTES", 1 << 30),
+            for_s=5.0, severity="warning",
+            description="shuffle reducers are spilling buffered "
+                        "fragments to plasma faster than the "
+                        "threshold — reduce partitions are "
+                        "outgrowing shuffle_spill_limit_bytes "
+                        "(skewed keys or undersized reducer count)"),
+        AlertRule(
             "head-repl-lag",
             "max_over_time(ray_tpu_head_repl_lag_entries)[30s]",
             ">", _env_f("RAY_TPU_ALERT_REPL_LAG_ENTRIES", 1000.0),

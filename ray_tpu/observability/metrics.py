@@ -401,6 +401,33 @@ def kv_cache_counters():
     })
 
 
+def shuffle_counters():
+    """The push-exchange data plane's series (data/exchange.py): bytes
+    moved per transport, reduce-partition completions, spill volume,
+    and the reducers' buffered-fragment depth — the signals that tell a
+    skewed or memory-bound shuffle apart from a healthy one."""
+    return metric_group("shuffle", lambda: {
+        "bytes": Counter(
+            "ray_tpu_shuffle_bytes",
+            "fragment payload bytes pushed map->reduce, by transport "
+            "(shm = same-host channel ring, dcn = striped push "
+            "sockets, obj = object-plane fallback)",
+            tag_keys=("transport",)),
+        "partitions": Counter(
+            "ray_tpu_shuffle_partitions_total",
+            "reduce partitions finalized (merged and handed "
+            "downstream)"),
+        "spilled_bytes": Counter(
+            "ray_tpu_shuffle_spilled_bytes",
+            "buffered fragment bytes a reducer moved to plasma when a "
+            "reduce partition outgrew shuffle_spill_limit_bytes"),
+        "reduce_queue_depth": Gauge(
+            "ray_tpu_shuffle_reduce_queue_depth",
+            "fragments buffered in this process's reducers, received "
+            "but not yet merged into an output partition"),
+    })
+
+
 def dropped_events_counter() -> Counter:
     """Timeline ring-buffer evictions (observability/timeline.py
     increments this so drops show up in metrics_summary())."""

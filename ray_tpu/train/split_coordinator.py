@@ -86,13 +86,17 @@ class RemoteSplitShard:
                      drop_last: bool = False,
                      batch_format: str = "numpy",
                      prefetch_batches: int = 1,
-                     device_put: bool = False):
+                     device_put: bool = False,
+                     local_shuffle_buffer_size=None,
+                     local_shuffle_seed=None):
         from ray_tpu.data.dataset import _assemble_batches
 
         return _assemble_batches(
             self.iter_blocks(), batch_size=batch_size,
             drop_last=drop_last, batch_format=batch_format,
-            prefetch=prefetch_batches, device_put=device_put)
+            prefetch=prefetch_batches, device_put=device_put,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
 
     def iter_rows(self):
         from ray_tpu.data.block import BlockAccessor

@@ -98,3 +98,20 @@ def test_tsdb_bench_phase_smoke():
     # The overhead key exists and is a sane percentage; the <5 guard
     # is asserted on the full-size BENCH run, not a 10-pair smoke.
     assert -50.0 < out["tsdb_ingest_overhead_pct"] < 100.0
+
+
+def test_shuffle_bench_phase_smoke():
+    """The shuffle phase runs both paths (push + materialized) end to
+    end at smoke size and emits its keys.  The >=1.5x push speedup is
+    asserted on the full-size BENCH run — at smoke size the fixed
+    actor/ring setup cost dominates and the ratio is meaningless."""
+    from bench import _shuffle_bench
+
+    out = _shuffle_bench(n_blocks=8, rows_per_block=512, width=32)
+    assert out["shuffle_gbytes_per_s"] > 0
+    assert out["shuffle_gbytes_per_s_materialized"] > 0
+    assert out["shuffle_push_speedup"] > 0
+    from ray_tpu.experimental.channel import channels_available
+    if channels_available():
+        # Same-host soak: fragments must ride the shm rings.
+        assert out["shuffle_shm_bytes"] > 0

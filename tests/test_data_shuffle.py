@@ -1,0 +1,316 @@
+"""Push-based shuffle exchange (data/exchange.py): groupby/aggregate
+numpy parity, zip/union typed errors, spill, transport counters, and
+map-death chaos (reference test strategy:
+python/ray/data/tests/test_all_to_all.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.context import DataContext
+from ray_tpu.exceptions import (ShuffleError, UnionSchemaError,
+                                ZipLengthMismatchError)
+
+
+def _groupby_rows(ds_rows, key_name, out_name):
+    """{key: out_value} from take_all() rows for parity asserts."""
+    return {r[key_name]: r[out_name] for r in ds_rows}
+
+
+# ---------------------------------------------------------------------------
+# groupby / aggregate parity vs numpy
+# ---------------------------------------------------------------------------
+
+def test_groupby_count_parity_multiblock(ray_start_regular):
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 7, size=1000)
+    ds = rd.from_blocks([{"k": keys[i:i + 100]}
+                         for i in range(0, 1000, 100)])
+    got = _groupby_rows(ds.groupby("k").count().take_all(),
+                        "k", "count()")
+    uniq, counts = np.unique(keys, return_counts=True)
+    assert got == {int(k): int(c) for k, c in zip(uniq, counts)}
+
+
+def test_groupby_sum_min_max_mean_std_parity(ray_start_regular):
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, 11, size=2000)
+    vals = rng.standard_normal(2000) * 100.0
+    ds = rd.from_blocks([{"k": keys[i:i + 250], "v": vals[i:i + 250]}
+                         for i in range(0, 2000, 250)])
+    gb = ds.groupby("k")
+    for op, out_name, ref_fn in [
+            ("sum", "sum(v)", np.sum),
+            ("min", "min(v)", np.min),
+            ("max", "max(v)", np.max),
+            ("mean", "mean(v)", np.mean),
+            ("std", "std(v)", np.std)]:
+        got = _groupby_rows(getattr(gb, op)("v").take_all(),
+                            "k", out_name)
+        assert sorted(got) == sorted(set(keys.tolist()))
+        for k in got:
+            ref = ref_fn(vals[keys == k])
+            np.testing.assert_allclose(got[k], ref, rtol=1e-9,
+                                       err_msg=f"{op} key={k}")
+
+
+def test_groupby_multiple_aggregates_one_pass(ray_start_regular):
+    from ray_tpu.data import Count, Mean, Sum
+
+    keys = np.repeat(np.arange(4), 25)
+    vals = np.arange(100, dtype=np.float64)
+    ds = rd.from_blocks([{"k": keys[i:i + 10], "v": vals[i:i + 10]}
+                         for i in range(0, 100, 10)])
+    rows = ds.groupby("k").aggregate(
+        Count(), Sum("v"), Mean("v")).take_all()
+    assert len(rows) == 4
+    for r in rows:
+        mask = keys == r["k"]
+        assert r["count()"] == mask.sum()
+        np.testing.assert_allclose(r["sum(v)"], vals[mask].sum())
+        np.testing.assert_allclose(r["mean(v)"], vals[mask].mean())
+
+
+def test_groupby_empty_partitions_and_empty_blocks(ray_start_regular):
+    # 2 distinct keys across 8 input blocks (2 fully empty): most
+    # reduce partitions own zero groups and must stay silent.
+    blocks = []
+    for i in range(8):
+        n = 0 if i in (3, 6) else 50
+        blocks.append({"k": np.full(n, i % 2, dtype=np.int64),
+                       "v": np.ones(n)})
+    ds = rd.from_blocks(blocks)
+    got = _groupby_rows(ds.groupby("k").sum("v").take_all(),
+                        "k", "sum(v)")
+    assert got == {0: 150.0, 1: 150.0}
+
+
+def test_groupby_hot_key_skew(ray_start_regular):
+    # 90% of rows share one key spanning every block: the hot group
+    # lands whole on one reducer and still aggregates exactly.
+    rng = np.random.default_rng(2)
+    keys = np.where(rng.random(3000) < 0.9, 7,
+                    rng.integers(0, 5, size=3000)).astype(np.int64)
+    ds = rd.from_blocks([{"k": keys[i:i + 300]}
+                         for i in range(0, 3000, 300)])
+    got = _groupby_rows(ds.groupby("k").count().take_all(),
+                        "k", "count()")
+    uniq, counts = np.unique(keys, return_counts=True)
+    assert got == {int(k): int(c) for k, c in zip(uniq, counts)}
+    assert got[7] > 2500
+
+
+def test_groupby_nan_keys_form_one_group(ray_start_regular):
+    keys = np.array([1.0, np.nan, 2.0, np.nan, 1.0, np.nan])
+    ds = rd.from_blocks([{"k": keys[:3], "v": np.arange(3.0)},
+                         {"k": keys[3:], "v": np.arange(3.0, 6.0)}])
+    rows = ds.groupby("k").count().take_all()
+    got = {("nan" if np.isnan(r["k"]) else r["k"]): r["count()"]
+           for r in rows}
+    assert got == {1.0: 2, 2.0: 1, "nan": 3}
+
+
+def test_groupby_string_keys(ray_start_regular):
+    keys = np.array(["b", "a", "b", "c", "a", "b"] * 20)
+    ds = rd.from_blocks([{"k": keys[i:i + 30],
+                          "v": np.ones(30)}
+                         for i in range(0, 120, 30)])
+    got = _groupby_rows(ds.groupby("k").sum("v").take_all(),
+                        "k", "sum(v)")
+    assert got == {"a": 40.0, "b": 60.0, "c": 20.0}
+
+
+def test_groupby_key_errors(ray_start_regular):
+    ds = rd.range(10)
+    with pytest.raises(TypeError):
+        ds.groupby(0)
+    # Missing column fails the map side; the exchange surfaces it as
+    # a typed ShuffleError naming the operator.
+    with pytest.raises(ShuffleError, match="nope"):
+        ds.groupby("nope").count().take_all()
+
+
+def test_map_groups(ray_start_regular):
+    keys = np.repeat(np.arange(5), 20)
+    vals = np.arange(100, dtype=np.float64)
+    ds = rd.from_blocks([{"k": keys[i:i + 10], "v": vals[i:i + 10]}
+                         for i in range(0, 100, 10)])
+
+    def summarize(group):
+        return {"k": group["k"][:1],
+                "spread": np.array([group["v"].max()
+                                    - group["v"].min()])}
+
+    rows = ds.groupby("k").map_groups(summarize).take_all()
+    assert len(rows) == 5
+    assert all(r["spread"] == 19.0 for r in rows)
+
+
+def test_dataset_aggregate_global(ray_start_regular):
+    from ray_tpu.data import Mean
+
+    ds = rd.from_blocks([{"v": np.arange(i, i + 100, dtype=np.float64)}
+                         for i in range(0, 1000, 100)])
+    out = ds.aggregate("count", ("sum", "v"), Mean("v"))
+    assert out["count()"] == 1000
+    np.testing.assert_allclose(out["sum(v)"],
+                               sum(range(0, 1000, 100)) * 100
+                               + sum(range(100)) * 10)
+    np.testing.assert_allclose(out["mean(v)"], out["sum(v)"] / 1000)
+    assert rd.from_blocks([{"v": np.array([], np.float64)}]
+                          ).aggregate(("sum", "v")) is None
+
+
+# ---------------------------------------------------------------------------
+# zip / union
+# ---------------------------------------------------------------------------
+
+def test_zip_aligns_rows_and_suffixes_collisions(ray_start_regular):
+    left = rd.range(100, parallelism=4)
+    right = rd.range(100, parallelism=7).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    rows = left.zip(right).take_all()
+    assert len(rows) == 100
+    for r in rows:
+        assert r["id_1"] == r["id"]  # colliding right col suffixed
+        assert r["sq"] == r["id"] ** 2
+
+
+def test_zip_length_mismatch_typed_error(ray_start_regular):
+    with pytest.raises(ZipLengthMismatchError) as ei:
+        rd.range(100).zip(rd.range(90)).take_all()
+    assert ei.value.left_rows == 100
+    assert ei.value.right_rows == 90
+
+
+def test_union_concatenates_in_order(ray_start_regular):
+    a = rd.range(30, parallelism=3)
+    b = rd.range(20).map_batches(lambda blk: {"id": blk["id"] + 100})
+    c = rd.range(10).map_batches(lambda blk: {"id": blk["id"] + 200})
+    out = [r["id"] for r in a.union(b, c).take_all()]
+    assert out == (list(range(30)) + list(range(100, 120))
+                   + list(range(200, 210)))
+    assert a.union() is a
+
+
+def test_union_schema_mismatch_typed_error(ray_start_regular):
+    b = rd.range(10).map_batches(
+        lambda blk: {"other": blk["id"]})
+    with pytest.raises(UnionSchemaError) as ei:
+        rd.range(10).union(b).take_all()
+    assert "id" in ei.value.left_schema
+    assert "other" in ei.value.right_schema
+
+
+# ---------------------------------------------------------------------------
+# spill + transport counters
+# ---------------------------------------------------------------------------
+
+def _metric_total(name):
+    from ray_tpu.observability.metrics import metrics_summary
+
+    return sum(metrics_summary().get(name, {}).values())
+
+
+def test_shuffle_spills_beyond_limit_and_stays_exact(
+        ray_start_regular):
+    ctx = DataContext.get_current()
+    old = ctx.shuffle_spill_limit_bytes
+    ctx.shuffle_spill_limit_bytes = 1 << 10  # force spill per partition
+    try:
+        before = _metric_total("ray_tpu_shuffle_spilled_bytes")
+        ds = rd.range(2000, parallelism=8).random_shuffle(seed=3)
+        out = sorted(r["id"] for r in ds.take_all())
+        assert out == list(range(2000))
+        assert _metric_total("ray_tpu_shuffle_spilled_bytes") > before
+    finally:
+        ctx.shuffle_spill_limit_bytes = old
+
+
+def test_shuffle_rides_shm_rings_same_host(ray_start_regular):
+    from ray_tpu.experimental.channel import channels_available
+    from ray_tpu.observability.metrics import metrics_summary
+
+    if not channels_available():
+        pytest.skip("/dev/shm rings unavailable in this environment")
+    before = metrics_summary().get(
+        "ray_tpu_shuffle_bytes", {}).get("shm", 0.0)
+    parts = _metric_total("ray_tpu_shuffle_partitions_total")
+    out = sorted(r["id"] for r in
+                 rd.range(1000, parallelism=4)
+                 .random_shuffle(seed=0).take_all())
+    assert out == list(range(1000))
+    after = metrics_summary().get(
+        "ray_tpu_shuffle_bytes", {}).get("shm", 0.0)
+    assert after > before, "same-host shuffle must use the shm rings"
+    assert _metric_total("ray_tpu_shuffle_partitions_total") > parts
+    # Reducer queues fully drained after the exchange completes.
+    assert _metric_total("ray_tpu_shuffle_reduce_queue_depth") == 0
+
+
+def test_sort_and_repartition_on_push_path(ray_start_regular):
+    # The migrated exchanges keep their semantics on the push path.
+    ds = rd.range(500, parallelism=5).random_shuffle(seed=1)
+    assert [r["id"] for r in ds.sort("id").take_all()] == \
+        list(range(500))
+    ds2 = rd.range(300, parallelism=3).repartition(7)
+    blocks = list(ds2.iter_blocks())
+    assert len(blocks) == 7
+    assert [int(x) for b in blocks for x in b["id"]] == \
+        list(range(300))
+
+
+# ---------------------------------------------------------------------------
+# local shuffle buffer (iter_batches)
+# ---------------------------------------------------------------------------
+
+def test_iter_batches_local_shuffle_buffer(ray_start_regular):
+    ds = rd.range(512, parallelism=4)
+    plain = [int(x) for b in ds.iter_batches(batch_size=64)
+             for x in b["id"]]
+    shuffled = [int(x) for b in ds.iter_batches(
+        batch_size=64, local_shuffle_buffer_size=128,
+        local_shuffle_seed=0) for x in b["id"]]
+    assert sorted(shuffled) == plain == list(range(512))
+    assert shuffled != plain
+    again = [int(x) for b in ds.iter_batches(
+        batch_size=64, local_shuffle_buffer_size=128,
+        local_shuffle_seed=0) for x in b["id"]]
+    assert again == shuffled  # seeded → reproducible
+    with pytest.raises(ValueError):
+        next(iter(ds.iter_batches(local_shuffle_buffer_size=0)))
+
+
+# ---------------------------------------------------------------------------
+# chaos: map worker dies mid-push
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_map_killed_mid_shuffle_raises_typed_no_hang(
+        ray_start_regular):
+    """Acceptance: a map task hard-killed mid-ring-write (fragments
+    already pushed, no error frame) surfaces a typed ShuffleError at
+    the driver promptly — reducers and rings torn down, nothing
+    wedged."""
+    from ray_tpu.experimental import chaos
+    from ray_tpu.experimental.channel import channels_available
+
+    if not channels_available():
+        pytest.skip("/dev/shm rings unavailable in this environment")
+    sched = chaos.schedule(seed=5).kill_at_ring_write("shfl", nth=2)
+    with sched:
+        t0 = time.monotonic()
+        with pytest.raises(ShuffleError) as ei:
+            rd.range(4000, parallelism=8).random_shuffle(
+                seed=0).take_all()
+        elapsed = time.monotonic() - t0
+    assert sched.fired("ring_kill") == 1
+    assert "map task failed" in str(ei.value)
+    assert elapsed < 30.0, f"typed error took {elapsed:.1f}s"
+    # The runtime is still healthy for the next exchange.
+    out = sorted(r["id"] for r in
+                 rd.range(200).random_shuffle(seed=0).take_all())
+    assert out == list(range(200))
