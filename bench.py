@@ -650,6 +650,30 @@ def _log_plane_overhead_bench(n_pairs: int = 220) -> dict:
         "log_on_roundtrip_us", "log_off_roundtrip_us", n_pairs)
 
 
+def _flightrec_overhead_bench(n_pairs: int = 220) -> dict:
+    """Flight-recorder overhead on ``dag_roundtrip_us``: with the
+    plane on, every process's snapshot thread drains new timeline
+    events + log records to its on-disk ring at the flush cadence
+    (forced to 50 ms cluster-wide so the paired passes actually
+    overlap snapshot ticks; production default is 500 ms).  Guard:
+    flightrec_overhead_pct < 5."""
+    import os as _os
+
+    prev = _os.environ.get("RAY_TPU_FLIGHTREC_FLUSH_S")
+    _os.environ["RAY_TPU_FLIGHTREC_FLUSH_S"] = "0.05"
+    try:
+        return _paired_overhead_bench(
+            "ray_tpu.observability.flightrec",
+            "flightrec_overhead_pct",
+            "flightrec_on_roundtrip_us", "flightrec_off_roundtrip_us",
+            n_pairs)
+    finally:
+        if prev is None:
+            _os.environ.pop("RAY_TPU_FLIGHTREC_FLUSH_S", None)
+        else:
+            _os.environ["RAY_TPU_FLIGHTREC_FLUSH_S"] = prev
+
+
 def _tsdb_bench(n_nodes: int = 3, n_flushes: int = 120,
                 n_queries: int = 50, n_pairs: int = 120) -> dict:
     """Metrics TSDB phases: ``metrics_query_us`` (end-to-end RPC
@@ -1469,6 +1493,13 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["device_telemetry_overhead_error"] = \
             f"{type(e).__name__}: {e}"
+
+    print("bench: flightrec overhead phase start", file=sys.stderr,
+          flush=True)
+    try:
+        extra.update(_flightrec_overhead_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["flightrec_overhead_error"] = f"{type(e).__name__}: {e}"
 
     print("bench: tsdb phase start", file=sys.stderr, flush=True)
     try:

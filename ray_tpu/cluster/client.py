@@ -506,6 +506,16 @@ class ClusterClient:
         # (pubsub/README.md) replaces per-event point-to-point fanout —
         # node deaths propagate to every node within one poll cycle.
         self.observed_dead_nodes: set = set()
+        # Postmortem death reports (observability/postmortem.py):
+        # node_id -> newest typed report, fed by the "death_report"
+        # pubsub channel; ActorDiedError contexts read it so the error
+        # a caller catches names the signal/OOM verdict + bundle id.
+        self._death_reports: Dict[str, Dict[str, Any]] = {}
+        self._last_death_report: Optional[Dict[str, Any]] = None
+        # One bounded head-side lookup + wait per node: a node that
+        # died with no supervisor (simulated death) must not re-stall
+        # every subsequent error construction.
+        self._death_ctx_probed: set = set()
         self._sub_thread = threading.Thread(
             target=self._pubsub_loop, daemon=True,
             name=f"cluster-sub-{self.node_id[:8]}")
@@ -745,7 +755,8 @@ class ClusterClient:
                 pass
 
     def _pubsub_loop(self):
-        cursors = {"node_death": 0, "actor_state": 0}
+        cursors = {"node_death": 0, "actor_state": 0,
+                   "death_report": 0}
         while not self._stopped.is_set():
             try:
                 out = self.head.call(
@@ -772,6 +783,78 @@ class ClusterClient:
                 cursors["actor_state"] = ch["seq"]
                 for event in ch["events"]:
                     self._on_actor_state_event(event)
+            ch = (out or {}).get("death_report")
+            if ch:
+                cursors["death_report"] = ch["seq"]
+                for event in ch["events"]:
+                    self._on_death_report_event(event)
+
+    def _on_death_report_event(self, event):
+        """Cache the newest postmortem report per node (bounded: one
+        per node, nodes are bounded)."""
+        report = dict(event or {})
+        if not report.get("incident"):
+            return
+        with self._loc_lock:
+            nid = report.get("node_id") or ""
+            if nid:
+                self._death_reports[nid] = report
+            self._last_death_report = report
+
+    def death_context(self, node_id: Optional[str] = None,
+                      wait_s: Optional[float] = None
+                      ) -> Dict[str, Any]:
+        """Error-context fields from the newest death report for
+        ``node_id`` (or the newest overall): ``signal=``, ``oom=``,
+        ``postmortem=`` bundle id, and the last log lines.  Returns {}
+        when no report exists.
+
+        ``wait_s`` bounds ONE wait per node for a report still in
+        flight (the supervisor classifies + ships within ~a poll
+        tick); pass 0 for cache-only on latency-sensitive paths."""
+        if wait_s is None:
+            wait_s = float(os.environ.get(
+                "RAY_TPU_DEATH_CTX_WAIT_S", "2.0"))
+        deadline = time.monotonic() + max(0.0, wait_s)
+        probed = False
+        while True:
+            with self._loc_lock:
+                report = (self._death_reports.get(node_id)
+                          if node_id else self._last_death_report)
+            if report is not None:
+                return self._report_to_context(report)
+            key = node_id or "__any__"
+            if key in self._death_ctx_probed:
+                return {}
+            if not probed:
+                probed = True
+                try:
+                    resp = self.head.call(
+                        "get_death_report",
+                        {"node_id": node_id} if node_id else {},
+                        timeout=2.0)
+                    if resp.get("found"):
+                        self._on_death_report_event(resp["report"])
+                        continue
+                except Exception:  # raylint: disable=ft-exception-swallow -- enrichment probe on an error path: a head hiccup must degrade to a context-less error, not mask the death being reported
+                    pass
+            if time.monotonic() >= deadline:
+                self._death_ctx_probed.add(key)
+                return {}
+            time.sleep(0.1)
+
+    @staticmethod
+    def _report_to_context(report: Dict[str, Any]) -> Dict[str, Any]:
+        ctx: Dict[str, Any] = {}
+        if report.get("signal_name"):
+            ctx["signal"] = report["signal_name"]
+        elif report.get("exit_code") is not None:
+            ctx["exit_code"] = report["exit_code"]
+        ctx["oom"] = "yes" if report.get("oom") else "no"
+        ctx["postmortem"] = report.get("incident", "")
+        if report.get("last_logs"):
+            ctx["last_logs"] = list(report["last_logs"])[-5:]
+        return ctx
 
     def _on_node_death_event(self, event):
         nid = event.get("node_id", "")
@@ -1851,7 +1934,8 @@ class ClusterClient:
             if not resp.get("found"):
                 error = ActorDiedError(
                     actor_id, "actor did not come back after its node "
-                    "died (no restart budget or restart failed)")
+                    "died (no restart budget or restart failed)",
+                    context=self.death_context())
                 break
             if resp.get("state") == "RESTARTING":
                 time.sleep(0.25)
@@ -1860,7 +1944,8 @@ class ClusterClient:
             break
         if loc is None and error is None:
             error = ActorDiedError(
-                actor_id, "timed out waiting for the actor to restart")
+                actor_id, "timed out waiting for the actor to restart",
+                context=self.death_context(wait_s=0))
         # Drain the FIFO BEFORE publishing the new location: were the
         # location visible first, a concurrent caller could locate the
         # actor ALIVE and push a new call ahead of the queued ones
@@ -1955,7 +2040,10 @@ class ClusterClient:
                 self.runtime.task_manager.complete_error(
                     spec, ActorDiedError(
                         spec.actor_id,
-                        f"actor's node {node_id[:8]} died: {result}"),
+                        f"actor's node {node_id[:8]} died: {result}",
+                        node_id=node_id,
+                        context=self.death_context(node_id,
+                                                   wait_s=0)),
                     allow_retry=allow_retry)
                 return
             status, payload = result
@@ -1978,8 +2066,10 @@ class ClusterClient:
         except ConnectionError as e:
             self._report_node_failure(node_id, address)
             self.runtime.task_manager.complete_error(
-                spec, ActorDiedError(spec.actor_id,
-                                     f"actor node unreachable: {e}"))
+                spec, ActorDiedError(
+                    spec.actor_id, f"actor node unreachable: {e}",
+                    node_id=node_id,
+                    context=self.death_context(node_id, wait_s=0)))
 
     def kill_remote_actor(self, actor_id, no_restart: bool = True):
         loc = self.locate_actor(actor_id)

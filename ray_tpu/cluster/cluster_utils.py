@@ -11,7 +11,9 @@ boundaries.
 from __future__ import annotations
 
 import atexit
+import os
 import subprocess
+import tempfile
 import time
 from typing import Dict, List, Optional
 
@@ -20,10 +22,28 @@ class Cluster:
     def __init__(self, initialize_head: bool = True):
         from ..core.node import start_head
 
+        # One shared flight-recorder dir for the driver and every
+        # worker child (start_worker_process copies os.environ): the
+        # supervisor resolves a dead pid's record as
+        # <dir>/flight-<pid> even when the child never got far enough
+        # to register in the head KV.
+        if not os.environ.get("RAY_TPU_FLIGHTREC_DIR"):
+            os.environ["RAY_TPU_FLIGHTREC_DIR"] = tempfile.mkdtemp(
+                prefix="ray_tpu_flightrec_")
         self.head_address = start_head() if initialize_head else ""
         self._procs: List[subprocess.Popen] = []
         self._connected = False
+        self._supervisor = None
         atexit.register(self.shutdown)
+
+    def _ensure_supervisor(self):
+        if self._supervisor is None and self.head_address:
+            from ..observability.postmortem import ProcessSupervisor
+
+            self._supervisor = ProcessSupervisor(
+                self.head_address,
+                os.environ["RAY_TPU_FLIGHTREC_DIR"])
+        return self._supervisor
 
     def add_node(self, *, num_cpus: float = 1.0,
                  resources: Optional[Dict[str, float]] = None,
@@ -36,6 +56,9 @@ class Cluster:
             self.head_address, num_cpus=num_cpus, resources=resources,
             node_name=name, labels=labels, env=env)
         self._procs.append(proc)
+        sup = self._ensure_supervisor()
+        if sup is not None:
+            sup.watch(proc)
         if wait:
             # Target = worker processes still running (killed nodes in
             # self._procs must not count) + the driver node if connected.
@@ -72,10 +95,22 @@ class Cluster:
         _private/test_utils.py:1563)."""
         proc.kill()
         proc.wait(timeout=timeout)
+        # Ship the death report synchronously so it is queryable
+        # before the caller catches the ActorDiedError this kill is
+        # about to cause (the supervisor loop would land it anyway,
+        # one poll tick later).
+        if self._supervisor is not None:
+            try:
+                self._supervisor.report(proc)
+            except Exception:
+                pass
 
     def shutdown(self):
         from ..core.node import stop_head
 
+        if self._supervisor is not None:
+            self._supervisor.stop()
+            self._supervisor = None
         for p in self._procs:
             if p.poll() is None:
                 p.terminate()

@@ -252,6 +252,16 @@ class HeadServer:
         self._artifacts: "_collections.OrderedDict[str, Dict]" = \
             _collections.OrderedDict()
         self._artifacts_lock = threading.Lock()
+        # Postmortem plane: typed death reports from process
+        # supervisors (observability/postmortem.py), keyed by incident
+        # id in a bounded drop-oldest window — the "why did it die"
+        # record ActorDiedError contexts, `ray_tpu top`'s incidents
+        # lane and the /api/postmortem route read back.
+        self._death_reports_max = int(_os.environ.get(
+            "RAY_TPU_HEAD_DEATH_REPORTS_MAX", "256"))
+        self._death_reports: "_collections.OrderedDict[str, Dict]" = \
+            _collections.OrderedDict()
+        self._death_lock = threading.Lock()
         self._deque = _collections.deque
         # After a restart, actors replay before their nodes reattach:
         # give nodes one lease of grace before declaring them dead.
@@ -456,6 +466,13 @@ class HeadServer:
             "put_artifact": self._put_artifact,
             "get_artifact": self._get_artifact,
             "list_artifacts": self._list_artifacts,
+            # Postmortem plane (put: the process supervisor after a
+            # child death / `ray_tpu postmortem --capture`; get/list:
+            # ActorDiedError enrichment, the postmortem CLI, `ray_tpu
+            # top`'s incidents lane, dashboard /api/postmortem).
+            "report_death": self._report_death,
+            "get_death_report": self._get_death_report,
+            "list_death_reports": self._list_death_reports,
             "alerts_status": self._alerts_status,
             "alert_rules": self._alert_rules,  # raylint: disable=rpc-protocol -- rule add/remove is driven by tests and ops tooling (out of package); the read surfaces ride metrics_query/alerts_status
             # Replicated-head protocol (replication.py is the caller
@@ -1674,6 +1691,60 @@ class HeadServer:
             return [{"name": name, **a["meta"]}
                     for name, a in self._artifacts.items()]
 
+    # ------------------------------------------------ postmortem plane
+    def _report_death(self, p):
+        """Ingest one typed death report (the supervisor's verdict:
+        signal, exit code, OOM evidence, bundle name, last logs) and
+        fan it out on the ``death_report`` pubsub channel so every
+        node's error contexts can name the cause.  Ephemeral
+        observability state like the artifact store: bounded, not
+        journaled."""
+        report = dict(p.get("report") or {})
+        incident = str(report.get("incident") or "")
+        if not incident:
+            return {"ok": False}
+        report.setdefault("ts", time.time())
+        with self._death_lock:
+            self._death_reports.pop(incident, None)
+            self._death_reports[incident] = report
+            while len(self._death_reports) > self._death_reports_max:
+                self._death_reports.popitem(last=False)
+        self._publisher.publish("death_report", dict(report),
+                                retain=64)
+        return {"ok": True, "incident": incident}
+
+    def _get_death_report(self, p):
+        """Lookup by incident id, by node id (newest first), or — with
+        neither — the most recent report of all."""
+        p = p or {}
+        incident = p.get("incident")
+        node_id = p.get("node_id")
+        with self._death_lock:
+            if incident:
+                report = self._death_reports.get(str(incident))
+                return ({"found": True, "report": dict(report)}
+                        if report else {"found": False})
+            for report in reversed(self._death_reports.values()):
+                if not node_id or report.get("node_id") == node_id:
+                    return {"found": True, "report": dict(report)}
+        return {"found": False}
+
+    def _list_death_reports(self, p):
+        limit = int((p or {}).get("limit", 64))
+        with self._death_lock:
+            reports = [dict(r) for r in
+                       reversed(self._death_reports.values())]
+        return {"reports": reports[:limit]}
+
+    def _crash_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        with self._death_lock:
+            for r in self._death_reports.values():
+                nid = r.get("node_id") or ""
+                if nid and r.get("cause") not in ("manual-capture",):
+                    counts[nid] = counts.get(nid, 0) + 1
+        return counts
+
     def _alerts_status(self, _p):
         """Declared rules + currently pending/firing instances."""
         return self._alerts.status()
@@ -1856,12 +1927,14 @@ class HeadServer:
                 self._stop.wait(self._restart_retry)
 
     def _list_nodes(self, _p):
+        crashes = self._crash_counts()
         with self._lock:
             return [{
                 "node_id": e.node_id, "address": e.address,
                 "total": dict(e.total), "available": dict(e.available),
                 "alive": e.alive, "labels": dict(e.labels),
                 "name": e.name,
+                "crashes": crashes.get(e.node_id, 0),
             } for e in self._nodes.values()]
 
     def _reap_loop(self):

@@ -87,6 +87,26 @@ def main(argv=None) -> int:
         logs_mod.configure_ring_file(os.path.join(
             args.log_dir, f"node-{rt.node_id.hex()[:12]}.jsonl"))
 
+    # Flight recorder: rebase this node's record into the log dir when
+    # no shared dir was pinned via env (keeps all of a node's forensics
+    # together), then register base+pid in the head KV so the driver's
+    # ProcessSupervisor can resolve a dead pid back to a node id and
+    # ship the record into the incident bundle.
+    from ray_tpu.observability import flightrec as flightrec_mod
+
+    rec = flightrec_mod.current()
+    if (rec is None or (args.log_dir
+                        and not os.environ.get("RAY_TPU_FLIGHTREC_DIR"))):
+        rec = flightrec_mod.install(args.log_dir or None)
+    if rec is not None and rt.cluster is not None:
+        try:
+            rt.cluster.kv_put(
+                rt.node_id.hex(),
+                json.dumps({"base": rec.base, "pid": os.getpid()}),
+                ns="flightrec")
+        except Exception:
+            pass
+
     try:
         head_gone_since = None
         while True:

@@ -28,6 +28,21 @@ from ..experimental import chaos as _chaos
 from ..observability.profiling import stuck_guard as _stuck_guard
 
 
+def _flightrec_context() -> Dict[str, Any]:
+    """Context fragment pointing at this process's flight record, so a
+    dead-actor error names where the local forensics live even before
+    any supervisor-built postmortem bundle exists."""
+    try:
+        from ..observability import flightrec as _flightrec
+
+        rec = _flightrec.current()
+        if rec is not None:
+            return {"flightrec": rec.base}
+    except Exception:
+        pass
+    return {}
+
+
 class ActorState(Enum):
     PENDING_CREATION = "PENDING_CREATION"
     ALIVE = "ALIVE"
@@ -264,7 +279,8 @@ class _ActorCore:
                 spec, ActorDiedError(
                     self.info.actor_id,
                     "chaos: actor killed before dispatch",
-                    context={"method": method}),
+                    context={"method": method,
+                             **_flightrec_context()}),
                 allow_retry=False)
             self._runtime.kill_actor(self.info.actor_id,
                                      no_restart=action[1])
@@ -318,7 +334,8 @@ class _ActorCore:
             self.info.actor_id,
             f"actor {self.info.display_name()} is dead{suffix}",
             node_id=self._runtime.node_id.hex(),
-            context={"restarts_used": self.info.num_restarts})
+            context={"restarts_used": self.info.num_restarts,
+                     **_flightrec_context()})
 
     # -- teardown ------------------------------------------------------------
     def stop(self):

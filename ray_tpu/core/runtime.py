@@ -117,6 +117,13 @@ class Runtime:
         from ..observability import device as _device_mod
 
         _device_mod.install()
+        # Flight recorder (observability/flightrec.py): crash-safe
+        # on-disk ring of recent spans/logs/gauges plus faulthandler
+        # stacks, so a kill -9'd process still leaves forensics its
+        # supervisor can ship into a postmortem bundle.
+        from ..observability import flightrec as _flightrec_mod
+
+        _flightrec_mod.install()
 
         self._driver_task_id = TaskID.for_driver(self.job_id)
         self._put_counters: Dict[TaskID, int] = {}
@@ -1130,6 +1137,15 @@ class Runtime:
         location, actor_state = \
             self.cluster.locate_actor_with_state(actor_id)
         if location is None and actor_state != "RESTARTING":
+            if actor_state == "DEAD":
+                # Reaped by the head: submission on the stale handle
+                # gets the same typed, postmortem-enriched error as a
+                # call caught mid-death, not a bare lookup failure.
+                from ..exceptions import ActorDiedError
+
+                raise ActorDiedError(
+                    actor_id, "actor is dead (already reaped)",
+                    context=self.cluster.death_context())
             raise ValueError(f"no such actor {actor_id!r}")
         n = options.num_returns
         if n == STREAMING:

@@ -387,6 +387,24 @@ class CompiledDAG:
         return any(rt.actor_manager.num_restarts(aid) != n
                    for aid, n in self._chan_restarts.items())
 
+    @staticmethod
+    def _record_pass_failure(err) -> None:
+        """Drop a timeline instant for a pass that died to an FT error
+        so a postmortem merge shows WHERE in the DAG the pass failed,
+        not just that an actor exited.  last_logs stays out: the
+        timeline plane ships to the head and the log excerpt already
+        rides the death report itself."""
+        try:
+            from ..observability import timeline as _timeline
+
+            ctx = dict(getattr(err, "context", None) or {})
+            ctx.pop("last_logs", None)
+            _timeline.record_event(
+                "dag:pass-failure", "i", pid=_timeline.process_pid(),
+                args={"error": type(err).__name__, **ctx})
+        except Exception:
+            pass
+
     def _maybe_replan(self):
         """Called under _submit_order_lock at the top of execute: when
         a channel actor restarted (or a pass died to a ring fault),
@@ -539,6 +557,7 @@ class CompiledDAG:
                 if isinstance(err, (ActorError, ChannelError,
                                     ObjectLostError)):
                     _dag_metrics()["pass_failures"].inc()
+                    self._record_pass_failure(err)
                     if self._chan_recovery:
                         self._rings_dirty = True
                 with rel_lock:

@@ -195,6 +195,30 @@ def _logs_api(params: Dict[str, str]):
     return {"records": logs_mod.query(limit=limit, **filters)}
 
 
+def _postmortem_api(params: Dict[str, str]):
+    """Incident forensics: no params → recent death reports;
+    ``?incident=<id>`` → that incident's merged report (add
+    ``&trace=1`` for the full Chrome trace too)."""
+    from ..core.runtime import get_runtime
+    from ..observability import postmortem as pm
+
+    rt = get_runtime()
+    if rt.cluster is None:
+        return {"error": "postmortem needs cluster mode"}
+    head_call = rt.cluster.head.call
+    incident = params.get("incident", "")
+    if not incident:
+        limit = int(params.get("limit", 20))
+        return head_call("list_death_reports", {"limit": limit},
+                         timeout=15.0)
+    merged = pm.merge_incident(
+        head_call, incident,
+        window_s=float(params.get("window", 60.0)))
+    if params.get("trace") not in (None, "", "0"):
+        return merged
+    return {"report": merged["report"]}
+
+
 def _profile_api(params: Dict[str, str]):
     """On-demand sampling profile: the named node's process (node RPC)
     or, with no/own node, this process."""
@@ -337,6 +361,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if params.get("format") == "chrome":
                     return self._send_json(prof["chrome"])
                 return self._send_json(prof)
+            if self.path == "/api/postmortem":
+                return self._send_json(_postmortem_api(params))
             if self.path.startswith("/api/jobs/"):
                 return self._job_get(self.path[len("/api/jobs/"):])
             if self.path.startswith("/api/"):

@@ -17,15 +17,27 @@ class RayTpuError(Exception):
 
 
 def _format_context(context) -> str:
-    """``" [k=v k2=v2]"`` suffix for FT error messages, or ""."""
+    """``" [k=v k2=v2]"`` suffix for FT error messages, or "".
+
+    A ``last_logs`` key (the death report's final log excerpt) renders
+    as an indented block after the suffix instead of inline — five log
+    lines crammed into the bracket would bury the cause fields
+    (``signal=``, ``oom=``, ``postmortem=``) they accompany."""
     if not context:
         return ""
+    ctx = dict(context)
+    last_logs = ctx.pop("last_logs", None)
     parts = []
-    for k, v in context.items():
+    for k, v in ctx.items():
         if isinstance(v, bytes):
             v = v.hex()[:16]
         parts.append(f"{k}={v}")
-    return " [" + " ".join(parts) + "]"
+    out = " [" + " ".join(parts) + "]" if parts else ""
+    if last_logs:
+        out += "\n  last logs from the dead process:"
+        for line in list(last_logs)[-5:]:
+            out += f"\n    {str(line)[:300]}"
+    return out
 
 
 def _picklable_cause(cause: BaseException) -> BaseException:

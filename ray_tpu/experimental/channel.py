@@ -184,13 +184,30 @@ def _producer_state(producer) -> Optional[str]:
     return state
 
 
+def _death_report_context() -> dict:
+    """Postmortem context for FT errors raised at channel edges: the
+    newest death report this process's cluster client has seen (at
+    most one bounded head probe per node, then cache-only — an error
+    path, never the frame hot path)."""
+    try:
+        from ..core.runtime import try_get_runtime
+
+        rt = try_get_runtime()
+        if rt is not None and rt.cluster is not None:
+            return rt.cluster.death_context(wait_s=0)
+    except Exception:
+        pass
+    return {}
+
+
 def _raise_if_producer_gone(producer, path: str) -> None:
     state = _producer_state(producer)
     if state in ("DEAD", "RESTARTING"):
         raise ActorDiedError(
             producer,
             f"producer of channel ring died mid-pass (state={state})",
-            context={"ring": os.path.basename(path)})
+            context={"ring": os.path.basename(path),
+                     **_death_report_context()})
 
 
 def _round_up_pow2(n: int) -> int:
@@ -518,7 +535,8 @@ class ChannelReader:
                 raise ActorDiedError(
                     producer,
                     "producer process of channel ring died mid-pass",
-                    context={"ring": os.path.basename(self.path)}) from e
+                    context={"ring": os.path.basename(self.path),
+                             **_death_report_context()}) from e
             except ChannelClosed as e:
                 # Severed / torn down under us: typed, not a raw
                 # ConnectionError, so one close fails the pass fast.

@@ -1,0 +1,391 @@
+"""Postmortem plane: exit-cause classification, incident bundles, and
+cross-node reconstruction.
+
+Three moving parts on top of the flight recorder
+(:mod:`~ray_tpu.observability.flightrec`):
+
+- :class:`ProcessSupervisor` — the parent that holds worker ``Popen``
+  handles (``cluster_utils.Cluster`` in tests; a node agent in a real
+  deployment) watches its children.  A child dying with a non-zero
+  status gets classified (signal / exit code / cgroup + dmesg OOM
+  evidence), its on-disk flight record is zipped into the head
+  artifact store, and a TYPED death report is published to the head —
+  which fans it out on the ``death_report`` pubsub channel so every
+  node (and ``ActorDiedError`` construction) can name the cause and
+  the bundle.  Reference analogue: the death-cause propagation the
+  GCS/raylet do for worker exits (SURVEY §gcs).
+- :func:`capture_incident` — the explicit ``ray_tpu postmortem
+  --capture`` path: snapshot + bundle every KV-registered record that
+  is readable from this machine, without a death.
+- :func:`merge_incident` — pulls a bundle back out of the artifact
+  store and merges the crashed process's spans/logs/thread stacks with
+  the surviving cluster timeline + logs + a TSDB window into ONE
+  trace-id-correlated Chrome trace and a report naming which processes
+  each trace id touched.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+import uuid
+import zipfile
+from typing import Any, Callable, Dict, List, Optional
+
+from . import flightrec
+from . import logs as _logs
+
+ARTIFACT_PREFIX = "postmortem/"
+
+
+def _new_incident_id(tag: str = "") -> str:
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    return f"inc-{stamp}-{tag or uuid.uuid4().hex[:6]}"
+
+
+def last_log_lines(record: Dict[str, Any], n: int = 5) -> List[str]:
+    """The crashed process's last ``n`` structured log messages."""
+    msgs: List[str] = []
+    for rec in record.get("records", ()):
+        if rec.get("kind") == "logs":
+            for r in rec.get("records") or ():
+                msgs.append(str(r.get("msg", ""))[:300])
+    return msgs[-n:]
+
+
+def build_bundle(records: List[Dict[str, Any]],
+                 report: Dict[str, Any]) -> bytes:
+    """Zip one or more loaded flight records + the death report into
+    an artifact-store payload."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("report.json", json.dumps(report, default=str))
+        for rec in records:
+            name = os.path.basename(rec.get("base", "record"))
+            zf.writestr(f"{name}/record.json",
+                        json.dumps(rec.get("records", []),
+                                   default=str))
+            zf.writestr(f"{name}/final.json",
+                        json.dumps(rec.get("final", []), default=str))
+            zf.writestr(f"{name}/stacks.txt", rec.get("stacks", ""))
+    return buf.getvalue()
+
+
+def load_bundle(data: bytes) -> Dict[str, Any]:
+    """Inverse of :func:`build_bundle`: ``{"report": ...,
+    "records": [...]}``."""
+    records: Dict[str, Dict[str, Any]] = {}
+    report: Dict[str, Any] = {}
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        for entry in zf.namelist():
+            try:
+                if entry == "report.json":
+                    report = json.loads(zf.read(entry))
+                    continue
+                base, _, leaf = entry.partition("/")
+                rec = records.setdefault(
+                    base, {"base": base, "records": [], "final": [],
+                           "stacks": ""})
+                if leaf == "record.json":
+                    rec["records"] = json.loads(zf.read(entry))
+                elif leaf == "final.json":
+                    rec["final"] = json.loads(zf.read(entry))
+                elif leaf == "stacks.txt":
+                    rec["stacks"] = zf.read(entry).decode(
+                        "utf-8", errors="replace")
+            except (ValueError, KeyError):
+                continue
+    return {"report": report, "records": list(records.values())}
+
+
+# ------------------------------------------------------------ supervisor
+class ProcessSupervisor:
+    """Watches worker ``Popen`` children; a non-clean death yields an
+    incident bundle in the head artifact store + a typed death report.
+    Runs in the PARENT process (the flight record is already on disk —
+    a kill -9'd child cannot ship its own), so this path may freely
+    lock and RPC: it is not crash-hook code."""
+
+    def __init__(self, head_address: str, flightrec_dir: str,
+                 poll_s: float = 0.25):
+        self._head_address = head_address
+        self._dir = flightrec_dir
+        self._poll_s = poll_s
+        self._client = None
+        self._watched: List[Any] = []
+        self._reported: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # OOM counters are cumulative: only movement past this baseline
+        # convicts a later SIGKILL.
+        self._oom_baseline = flightrec.read_cgroup_oom_count()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="proc-supervisor")
+        self._thread.start()
+
+    def watch(self, proc) -> None:
+        with self._lock:
+            self._watched.append(proc)
+
+    def _head(self):
+        if self._client is None:
+            from ..cluster.rpc import ReconnectingClient
+
+            self._client = ReconnectingClient(self._head_address)
+        return self._client
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                procs = list(self._watched)
+            for proc in procs:
+                rc = proc.poll()
+                if rc is None or rc == 0:
+                    continue  # running, or chose to exit
+                try:
+                    self.report(proc)
+                except Exception:
+                    pass  # head briefly unreachable: next tick retries
+
+    def report(self, proc) -> Optional[Dict[str, Any]]:
+        """Classify one dead child and ship its incident.  Idempotent
+        per pid; safe to call directly (``Cluster.kill_node`` does, so
+        the report beats the error the caller is about to catch)."""
+        rc = proc.poll()
+        if rc is None:
+            return None
+        with self._lock:
+            if proc.pid in self._reported:
+                return None
+            self._reported.add(proc.pid)
+        evidence = flightrec.gather_oom_evidence(
+            proc.pid, baseline_oom_count=self._oom_baseline)
+        verdict = flightrec.classify_exit(rc, oom_evidence=evidence)
+        node_id, kv_base = self._node_for_pid(proc.pid)
+        base = kv_base or flightrec.base_for_pid(self._dir, proc.pid)
+        record = flightrec.read_record(base)
+        incident = _new_incident_id(node_id[:8] if node_id
+                                    else str(proc.pid))
+        report = {
+            "incident": incident,
+            "node_id": node_id,
+            "pid": proc.pid,
+            "ts": time.time(),
+            "oom_evidence": evidence,
+            "flightrec": base,
+            "artifact": ARTIFACT_PREFIX + incident,
+            "last_logs": last_log_lines(record),
+            **verdict,
+        }
+        head = self._head()
+        data = build_bundle([record], report)
+        # Bundle first, then the report that names it, then the
+        # liveness declaration: by the time actors on the dead node
+        # are declared dead (and ActorDiedErrors start constructing),
+        # the report is already queryable.
+        head.call("put_artifact", {
+            "name": report["artifact"], "data": data,
+            "meta": {"kind": "postmortem", "incident": incident,
+                     "node_id": node_id, "cause": verdict["cause"]}},
+            timeout=15.0)
+        head.call("report_death", {"report": report}, timeout=15.0)
+        if node_id:
+            try:
+                head.call_idempotent("report_node_failure",
+                                     {"node_id": node_id},
+                                     timeout=15.0)
+            except Exception:  # raylint: disable=ft-exception-swallow -- best-effort early declaration; lease expiry declares the node dead shortly anyway
+                pass
+        return report
+
+    def _node_for_pid(self, pid: int):
+        """pid → (node id, record base) via the flightrec KV
+        registrations the worker entry point writes at boot
+        (``("", "")`` when it died before registering)."""
+        try:
+            head = self._head()
+            for key in head.call("kv_keys", {"ns": "flightrec"},
+                                 timeout=10.0):
+                got = head.call("kv_get",
+                                {"ns": "flightrec", "key": key},
+                                timeout=10.0)
+                if not got.get("found"):
+                    continue
+                try:
+                    meta = json.loads(got["value"])
+                except (TypeError, ValueError):
+                    continue
+                if meta.get("pid") == pid:
+                    return key, str(meta.get("base", ""))
+        except Exception:  # raylint: disable=ft-exception-swallow -- a dead-before-registering child has no KV entry; the report ships with node_id="" rather than not at all
+            pass
+        return "", ""
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
+
+
+# -------------------------------------------------------------- capture
+def capture_incident(head_call: Callable[..., Any],
+                     reason: str = "manual-capture") -> Dict[str, Any]:
+    """Explicit (death-less) capture: snapshot this process's recorder,
+    then bundle every KV-registered flight record readable from this
+    machine into one artifact.  Returns the stored report."""
+    flightrec.snapshot_now()
+    records: List[Dict[str, Any]] = []
+    rec = flightrec.current()
+    if rec is not None:
+        records.append(flightrec.read_record(rec.base))
+    seen = {r["base"] for r in records}
+    try:
+        for key in head_call("kv_keys", {"ns": "flightrec"}):
+            got = head_call("kv_get", {"ns": "flightrec", "key": key})
+            if not got.get("found"):
+                continue
+            try:
+                base = json.loads(got["value"]).get("base", "")
+            except (TypeError, ValueError):
+                continue
+            if base and base not in seen \
+                    and os.path.exists(base + ".jsonl"):
+                seen.add(base)
+                records.append(flightrec.read_record(base))
+    except Exception:
+        pass
+    incident = _new_incident_id("cap")
+    report = {
+        "incident": incident, "node_id": "", "pid": os.getpid(),
+        "ts": time.time(), "cause": reason, "signal": None,
+        "signal_name": None, "oom": False, "exit_code": None,
+        "artifact": ARTIFACT_PREFIX + incident,
+        "processes": len(records),
+    }
+    head_call("put_artifact", {
+        "name": report["artifact"],
+        "data": build_bundle(records, report),
+        "meta": {"kind": "postmortem", "incident": incident,
+                 "node_id": "", "cause": reason}})
+    head_call("report_death", {"report": report})
+    return report
+
+
+# ---------------------------------------------------------------- merge
+def merge_incident(head_call: Callable[..., Any], incident: str,
+                   window_s: float = 60.0) -> Dict[str, Any]:
+    """Reconstruct one incident: ``{"report": ..., "trace": [...]}``
+    where ``trace`` is ONE Chrome trace holding the crashed process's
+    final spans/logs/thread stacks next to every surviving process's
+    shipped events inside the window, all correlated by trace id."""
+    name = incident if incident.startswith(ARTIFACT_PREFIX) \
+        else ARTIFACT_PREFIX + incident
+    art = head_call("get_artifact", {"name": name})
+    if not art.get("found"):
+        raise KeyError(f"no postmortem bundle {incident!r} "
+                       f"in the artifact store")
+    bundle = load_bundle(art["data"])
+    death = bundle.get("report") or {}
+    crash_ts = float(death.get("ts") or time.time())
+
+    events: List[Dict] = []
+    crashed_lanes: set = set()
+    for rec in bundle["records"]:
+        evs = flightrec.record_events(rec)
+        events.extend(evs)
+        for e in evs:
+            if e.get("ph") != "i":
+                crashed_lanes.add(e.get("pid"))
+
+    # Surviving cluster view, restricted to the incident window.
+    lo_us = (crash_ts - window_s) * 1e6
+    hi_us = (crash_ts + min(window_s, 10.0)) * 1e6
+    try:
+        resp = head_call("cluster_timeline", {})
+        for e in resp.get("events", ()):
+            if lo_us <= float(e.get("ts", 0)) <= hi_us:
+                events.append(e)
+    except Exception:
+        pass
+    try:
+        resp = head_call("cluster_logs",
+                         {"since": crash_ts - window_s,
+                          "until": crash_ts + window_s})
+        events.extend(_logs.to_timeline_events(
+            resp.get("records", ())))
+    except Exception:
+        pass
+
+    # Trace-id correlation: which processes did each trace id touch?
+    trace_lanes: Dict[str, set] = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            trace_lanes.setdefault(tid, set()).add(e.get("pid"))
+    ranked = sorted(trace_lanes.items(),
+                    key=lambda kv: len(kv[1]), reverse=True)
+
+    tsdb: Dict[str, Any] = {}
+    try:
+        names = head_call("metrics_query", {"names": True})
+        tsdb = {"series": len(names.get("names", ())),
+                "stats": names.get("stats", {})}
+    except Exception:
+        pass
+
+    report = {
+        "incident": incident,
+        "death": death,
+        "window_s": window_s,
+        "crashed_lanes": sorted(x for x in crashed_lanes if x),
+        "processes": sorted({e.get("pid") for e in events
+                             if e.get("pid")}),
+        "events": len(events),
+        "trace_processes": {t: sorted(x for x in lanes if x)
+                            for t, lanes in ranked[:20]},
+        "final_records": sum(len(r.get("final", ()))
+                             for r in bundle["records"]),
+        "has_thread_stacks": any(r.get("stacks")
+                                 for r in bundle["records"]),
+        "tsdb": tsdb,
+    }
+    events.sort(key=lambda e: e.get("ts", 0))
+    return {"report": report, "trace": events}
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    """Human-readable incident summary (CLI)."""
+    death = report.get("death") or {}
+    oom = (f"yes — {death.get('oom_evidence', '')}"
+           if death.get("oom") else "no")
+    lines = [
+        f"incident   {report.get('incident', '?')}",
+        f"cause      {death.get('cause', '?')}"
+        + (f"  (signal {death.get('signal_name')})"
+           if death.get("signal_name") else ""),
+        f"node       {str(death.get('node_id', ''))[:12] or '-'}"
+        f"  pid {death.get('pid', '-')}",
+        f"oom        {oom}",
+        f"processes  {len(report.get('processes', ()))} in merged "
+        f"trace ({report.get('events', 0)} events, "
+        f"{report.get('final_records', 0)} final records, "
+        f"thread stacks: "
+        f"{'yes' if report.get('has_thread_stacks') else 'no'})",
+    ]
+    tp = report.get("trace_processes") or {}
+    if tp:
+        top = max(tp.items(), key=lambda kv: len(kv[1]))
+        lines.append(f"correlated {top[0]}: "
+                     f"{', '.join(map(str, top[1]))}")
+    if death.get("last_logs"):
+        lines.append("last logs:")
+        lines.extend(f"  {line}" for line in death["last_logs"])
+    return "\n".join(lines)

@@ -18,6 +18,9 @@ Commands:
   metrics (query|names|alerts) --address H:P    windowed TSDB queries
                                                 + alert states
   memory --address H:P                          object-store stats
+  postmortem [INCIDENT] --address H:P           incident forensics:
+             [--capture] [-o trace.json]        death reports + merged
+                                                crash traces
   job (submit|status|logs|stop|list) ...        job control
   lint [PATH] [--format json|sarif] [--changed] [--lock-graph dot|json]
        [--update-baseline]                      raylint static analysis
@@ -85,6 +88,12 @@ def cmd_status(args) -> int:
             print(f"  {k}: {avail.get(k, 0):g}/{totals[k]:g} available")
     actors = rt.cluster.head.call("list_actors", {})
     print(f"{len(actors)} registered actors")
+    crashed = [n for n in nodes if n.get("crashes")]
+    if crashed:
+        print("crashes (death reports per node):")
+        for n in crashed:
+            label = n.get("name") or n["node_id"][:12]
+            print(f"  {label}: {n['crashes']}")
     _print_device_summary(rt, nodes)
     return 0
 
@@ -226,6 +235,49 @@ def cmd_memory(args) -> int:
         "local_store": rt.object_store.stats(),
         "plasma": rt.plasma.stats(),
     }, indent=2))
+    return 0
+
+
+def cmd_postmortem(args) -> int:
+    """Incident forensics (observability/postmortem.py):
+
+    - ``postmortem`` — list recent death reports;
+    - ``postmortem --capture`` — snapshot + bundle the live cluster's
+      flight records without a death (pre-crash baseline);
+    - ``postmortem <incident>`` — merge that incident's bundle with
+      the surviving cluster timeline/logs into one Chrome trace
+      (``--out``, Perfetto-loadable) + a printed report."""
+    rt = _connect(args.address)
+    from ray_tpu.observability import postmortem as pm
+
+    head_call = rt.cluster.head.call
+    if args.capture:
+        report = pm.capture_incident(head_call)
+        print(f"captured {report['incident']} "
+              f"({report['processes']} process records) -> "
+              f"artifact {report['artifact']}")
+        if not args.incident:
+            args.incident = report["incident"]
+    if not args.incident:
+        resp = head_call("list_death_reports", {"limit": args.limit})
+        reports = resp.get("reports", [])
+        if not reports:
+            print("no death reports")
+            return 0
+        for r in reports:
+            node = str(r.get("node_id", ""))[:12] or "-"
+            print(f"{r.get('incident', '?')}  {r.get('cause', '?')}"
+                  f"  node {node}  pid {r.get('pid', '-')}"
+                  + ("  [oom]" if r.get("oom") else ""))
+        return 0
+    merged = pm.merge_incident(head_call, args.incident,
+                               window_s=args.window)
+    print(pm.render_report(merged["report"]))
+    out = args.out or f"postmortem-{args.incident}.trace.json"
+    with open(out, "w") as f:
+        json.dump({"traceEvents": merged["trace"]}, f)
+    print(f"wrote {out} ({len(merged['trace'])} events) — "
+          f"load in Perfetto / chrome://tracing")
     return 0
 
 
@@ -452,7 +504,19 @@ def _top_snapshot(rt):
         "qdepth": q("last(ray_tpu_queue_depth)[60s] by (node_id)"),
         "train_tps": q("last(ray_tpu_train_tokens_per_s)[60s] "
                        "by (node_id)"),
+        "incidents": _recent_incidents(rt),
     }
+
+
+def _recent_incidents(rt, limit: int = 5):
+    """Newest death reports for the ``top`` incidents lane ([] on a
+    pre-postmortem head)."""
+    try:
+        resp = rt.cluster.head.call("list_death_reports",
+                                    {"limit": limit}, timeout=15.0)
+        return resp.get("reports", [])
+    except Exception:  # raylint: disable=ft-exception-swallow -- the incidents lane degrades to empty rather than killing the live view
+        return []
 
 
 def render_top(snap) -> str:
@@ -491,6 +555,15 @@ def render_top(snap) -> str:
     lines.append(f"{alive}/{len(snap['nodes'])} nodes alive · "
                  f"{sum(snap['actors'].values())} actors · "
                  f"{time.strftime('%H:%M:%S')}")
+    incidents = snap.get("incidents") or []
+    if incidents:
+        lines.append("INCIDENTS (newest first):")
+        for r in incidents:
+            node = str(r.get("node_id", ""))[:12] or "-"
+            lines.append(
+                f"  {r.get('incident', '?')}  {r.get('cause', '?')}"
+                f"  node {node}  pid {r.get('pid', '-')}"
+                + ("  [oom]" if r.get("oom") else ""))
     return "\n".join(lines)
 
 
@@ -640,6 +713,26 @@ def main(argv=None) -> int:
     p = sub.add_parser("memory", help="object store stats")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser(
+        "postmortem",
+        help="incident forensics: list death reports, capture a "
+             "live bundle, or merge one into a Chrome trace")
+    p.add_argument("incident", nargs="?", default="",
+                   help="incident id to merge (omit to list)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--capture", action="store_true",
+                   help="bundle the live cluster's flight records "
+                        "now (no death required)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="merge: seconds of surviving-cluster "
+                        "history around the crash")
+    p.add_argument("-o", "--out", default="",
+                   help="merge: trace output path (default "
+                        "postmortem-<incident>.trace.json)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="list mode: reports to show")
+    p.set_defaults(fn=cmd_postmortem)
 
     p = sub.add_parser(
         "logs", help="structured cluster logs (query/follow) or a "

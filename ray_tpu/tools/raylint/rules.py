@@ -1623,6 +1623,164 @@ def rule_exception_contract(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: crash-handler-safety
+# --------------------------------------------------------------------------
+
+_CRASH_DEPTH = 4
+_CRASH_METRIC_MODULES = ("observability.metrics", "observability.tsdb")
+_CRASH_RPC_ATTRS = _RPC_BLOCKING_ATTRS | {"call_async", "mut_call",
+                                          "publish"}
+# confident edge kinds only: one class-blind unique-name guess must not
+# smear "reachable from a crash hook" across the package
+_CRASH_EDGE_KINDS = ("self", "local", "module", "import", "init")
+
+
+def _crash_ref(model: ProjectModel, info: ModuleInfo, fi: FuncInfo,
+               expr: ast.AST) -> Optional[str]:
+    """Resolve a BARE function reference (hook installation passes the
+    function, it doesn't call it): ``self._hook`` / ``local_fn``."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id == "self" and fi.cls is not None:
+        return model._method_on(info.name, fi.cls, expr.attr)
+    if isinstance(expr, ast.Name):
+        return model._resolve_name(info, fi, expr.id)
+    return None
+
+
+def _crash_roots(model: ProjectModel) -> Dict[str, str]:
+    """qualname -> how-installed for every function registered as a
+    crash hook: ``sys.excepthook``/``threading.excepthook`` assignment
+    targets, ``signal.signal(...)`` handlers, and ``atexit.register``
+    callbacks — the latter only in modules that also call
+    ``faulthandler.enable`` (ordinary shutdown hooks are NOT crash
+    code; a module wiring faulthandler is doing crash forensics and
+    its atexit hook runs on fatal paths it must not deadlock)."""
+    roots: Dict[str, str] = {}
+    fh_modules = set()
+    for fi in model.functions.values():
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "enable" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    info.imports.get(node.func.value.id,
+                                     node.func.value.id) == "faulthandler":
+                fh_modules.add(fi.module)
+    for fi in list(model.functions.values()):
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "excepthook" and \
+                            isinstance(t.value, ast.Name) and \
+                            info.imports.get(t.value.id, t.value.id) in (
+                                "sys", "threading"):
+                        qn = _crash_ref(model, info, fi, node.value)
+                        if qn is not None:
+                            roots.setdefault(
+                                qn, f"{t.value.id}.excepthook")
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if not isinstance(f, ast.Attribute) or \
+                        not isinstance(f.value, ast.Name):
+                    continue
+                base = info.imports.get(f.value.id, f.value.id)
+                if f.attr == "signal" and base == "signal" and \
+                        len(node.args) >= 2:
+                    qn = _crash_ref(model, info, fi, node.args[1])
+                    if qn is not None:
+                        roots.setdefault(qn, "signal handler")
+                elif f.attr == "register" and base == "atexit" and \
+                        node.args and fi.module in fh_modules:
+                    qn = _crash_ref(model, info, fi, node.args[0])
+                    if qn is not None:
+                        roots.setdefault(qn, "atexit hook in a "
+                                             "faulthandler module")
+    return roots
+
+
+def _crash_violations(model: ProjectModel, info: ModuleInfo,
+                      fi: FuncInfo) -> List[Tuple[int, str]]:
+    """(line, description) for every op a crash hook must not perform:
+    lock acquisition, metrics/TSDB-plane calls, RPC/pubsub."""
+    out: List[Tuple[int, str]] = []
+    for node in model.walk_own(fi.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lock = model.lock_context(info, fi, item.context_expr)
+                if lock is not None:
+                    out.append((node.lineno,
+                                f"takes lock {lock[0]!r}"))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr == "acquire":
+            lock = model.lock_context(info, fi, f.value)
+            if lock is not None:
+                out.append((node.lineno,
+                            f"acquires lock {lock[0]!r}"))
+            continue
+        if f.attr in _CRASH_RPC_ATTRS:
+            out.append((node.lineno,
+                        f"performs RPC {call_desc(node)}(...)"))
+            continue
+        if isinstance(f.value, ast.Name):
+            target = info.imports.get(f.value.id, "")
+            if target.endswith(_CRASH_METRIC_MODULES):
+                out.append((node.lineno,
+                            f"allocates via the metrics plane "
+                            f"({call_desc(node)})"))
+    return out
+
+
+def rule_crash_handler_safety(model: ProjectModel) -> List[Finding]:
+    out = _Collector(model, "crash-handler-safety")
+    viol_memo: Dict[str, List[Tuple[int, str]]] = {}
+    for root_qn, how in sorted(_crash_roots(model).items()):
+        seen = {root_qn}
+        queue: List[Tuple[str, List[str]]] = [(root_qn, [])]
+        while queue:
+            qn, path = queue.pop(0)
+            fi = model.functions.get(qn)
+            if fi is None:
+                continue
+            info = model.modules[fi.module]
+            if qn not in viol_memo:
+                viol_memo[qn] = _crash_violations(model, info, fi)
+            for line, desc in viol_memo[qn]:
+                via = (f" via {' -> '.join(path)}" if path else "")
+                out.add(info, line, fi.qualname,
+                        f"{desc} on a path reachable from crash hook "
+                        f"{_short_fn(root_qn)} ({how}){via} — crash "
+                        f"hooks are flush-to-fd only")
+            if len(path) >= _CRASH_DEPTH:
+                continue
+            for e in model.call_edges.get(qn, ()):
+                if e.kind not in _CRASH_EDGE_KINDS or e.target in seen:
+                    continue
+                callee = model.functions.get(e.target)
+                if callee is not None and callee.module.endswith(
+                        _CRASH_METRIC_MODULES):
+                    via = (f" via {' -> '.join(path)}" if path else "")
+                    out.add(info, e.line, fi.qualname,
+                            f"allocates via the metrics plane "
+                            f"({e.via}) on a path reachable from "
+                            f"crash hook {_short_fn(root_qn)} "
+                            f"({how}){via} — crash hooks are "
+                            f"flush-to-fd only")
+                    continue
+                seen.add(e.target)
+                queue.append((e.target, path + [f"{e.via}()"]))
+    return out.findings
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -1643,6 +1801,7 @@ RULES = {
     "wait-holding-foreign-lock": rule_wait_holding_foreign_lock,
     "rpc-protocol": rule_rpc_protocol,
     "exception-contract": rule_exception_contract,
+    "crash-handler-safety": rule_crash_handler_safety,
 }
 
 RULE_DOCS = {
@@ -1753,4 +1912,13 @@ RULE_DOCS = {
         "site handles it typed, a try here that catches only a "
         "parent class (or lets it escape its clauses) silently "
         "drops the recovery dispatch the typed handler implements."),
+    "crash-handler-safety": (
+        "Code reachable from crash hooks (sys.excepthook/"
+        "threading.excepthook assignments, signal handlers, atexit "
+        "callbacks registered by faulthandler-wiring modules) must "
+        "not take locks, allocate via the metrics/TSDB plane, or "
+        "perform RPC: the hook may run with arbitrary locks already "
+        "held by the dying thread, so anything beyond flush-to-fd "
+        "(os.write to a pre-opened fd) can deadlock the process "
+        "during its last breath and lose the flight record."),
 }

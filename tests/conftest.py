@@ -40,6 +40,23 @@ class ChaosHangGuardTimeout(BaseException):
     retry, and SIGALRM is one-shot."""
 
 
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Slowest-10 report on every run: the tier-1 wall-clock budget is
+    guarded by knowing where it goes, without -durations plumbing in
+    each CI invocation."""
+    rows = []
+    for key in ("passed", "failed"):
+        for rep in terminalreporter.stats.get(key, ()):
+            if getattr(rep, "when", "") == "call":
+                rows.append((rep.duration, rep.nodeid))
+    if not rows:
+        return
+    rows.sort(reverse=True)
+    terminalreporter.write_sep("-", "slowest 10 tests")
+    for duration, nodeid in rows[:10]:
+        terminalreporter.write_line(f"{duration:8.2f}s  {nodeid}")
+
+
 def pytest_collection_modifyitems(config, items):
     # ``stress`` implies ``slow``: the virtual-cluster soaks run
     # hundreds of simulated nodes for tens of seconds — tier-1
@@ -60,11 +77,15 @@ def _chaos_hang_guard(request):
     # tsdb cluster tests poll shipped history with bounded deadlines;
     # the guard catches the same failure mode (a wedged flush/standby
     # pump blocking the poll loop forever).
+    # postmortem tests kill -9 real worker subprocesses and then wait
+    # on supervisor-shipped reports: their failure mode is the same
+    # wait-forever hang.
     if request.node.get_closest_marker("chaos") is None and \
             request.node.get_closest_marker("overload") is None and \
             request.node.get_closest_marker("net") is None and \
             request.node.get_closest_marker("tsdb") is None and \
             request.node.get_closest_marker("device") is None and \
+            request.node.get_closest_marker("postmortem") is None and \
             request.node.get_closest_marker("stress") is None:
         yield
         return

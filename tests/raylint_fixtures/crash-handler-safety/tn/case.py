@@ -1,0 +1,40 @@
+"""True negatives: a flush-to-fd-only crash hook, an ordinary atexit
+shutdown hook in a module that never wires faulthandler, and lock use
+on paths crash hooks cannot reach."""
+
+import atexit
+import json
+import os
+import sys
+import threading
+
+
+class Recorder:
+    """Crash hooks that only os.write to a pre-opened fd."""
+
+    def __init__(self, fd):
+        self._fd = fd
+        self._lock = threading.Lock()
+        import faulthandler
+
+        faulthandler.enable()
+        sys.excepthook = self._excepthook
+        atexit.register(self._on_exit)
+
+    def _excepthook(self, exc_type, exc, tb):
+        self._write_final("excepthook", exc)
+
+    def _on_exit(self):
+        self._write_final("atexit", None)
+
+    def _write_final(self, why, exc):
+        payload = json.dumps({"why": why, "exc": repr(exc)})
+        try:
+            os.write(self._fd, payload.encode())
+        except OSError:
+            pass
+
+    def snapshot(self):
+        # NOT a crash hook: the periodic snapshot thread may lock.
+        with self._lock:
+            return True

@@ -1,0 +1,17 @@
+"""atexit in a faulthandler-free module: ordinary shutdown code, free
+to lock and RPC (atexit only counts as a crash hook in modules doing
+crash forensics — i.e. wiring faulthandler)."""
+
+import atexit
+import threading
+
+
+class Service:
+    def __init__(self, head):
+        self._head = head
+        self._lock = threading.Lock()
+        atexit.register(self.shutdown)
+
+    def shutdown(self):
+        with self._lock:
+            self._head.call("goodbye", {})

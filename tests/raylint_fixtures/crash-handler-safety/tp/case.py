@@ -1,0 +1,42 @@
+"""True positives: crash hooks that lock, touch the metrics plane,
+or RPC — directly and through one call hop."""
+
+import atexit
+import signal
+import sys
+import threading
+
+from .observability import metrics
+
+_state_lock = threading.Lock()
+
+
+class Recorder:
+    def __init__(self, head):
+        self._head = head
+        self._lock = threading.Lock()
+        import faulthandler
+
+        faulthandler.enable()
+        sys.excepthook = self._excepthook
+        threading.excepthook = self._thread_hook
+        signal.signal(signal.SIGTERM, _on_signal)
+        atexit.register(self._on_exit)
+
+    def _excepthook(self, exc_type, exc, tb):
+        with self._lock:  # lock in a crash hook
+            pass
+
+    def _thread_hook(self, args):
+        self._flush()  # transitive: hop into an RPC
+
+    def _flush(self):
+        self._head.call("report_death", {})  # RPC under a crash hook
+
+    def _on_exit(self):
+        metrics.counter_inc("exits")  # metrics plane in an atexit hook
+
+
+def _on_signal(signum, frame):
+    with _state_lock:  # module lock in a signal handler
+        pass

@@ -115,3 +115,16 @@ def test_shuffle_bench_phase_smoke():
     if channels_available():
         # Same-host soak: fragments must ride the shm rings.
         assert out["shuffle_shm_bytes"] > 0
+
+
+def test_flightrec_overhead_phase_smoke():
+    """The flight-recorder overhead phase runs the paired-adjacent
+    harness end to end at smoke size and emits its keys (the <5
+    guard is asserted on the full-size BENCH run)."""
+    from bench import _flightrec_overhead_bench
+
+    out = _flightrec_overhead_bench(n_pairs=6)
+    assert "flightrec_overhead_pct" in out
+    assert out["flightrec_on_roundtrip_us"] > 0
+    assert out["flightrec_off_roundtrip_us"] > 0
+    assert -50.0 < out["flightrec_overhead_pct"] < 100.0

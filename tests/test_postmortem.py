@@ -1,0 +1,408 @@
+"""Flight recorder, exit-cause classification, and incident bundles
+(ISSUE 18).
+
+Unit layers (classifiers, recorder files, bundle round-trip, error
+rendering) run in-process; the chaos soak kills -9 a real worker
+mid-pass and asserts the whole postmortem pipeline end to end:
+supervisor verdict -> head-stored bundle -> merged trace correlated
+by trace id -> enriched ActorDiedError at the caller.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.observability import flightrec
+from ray_tpu.observability import postmortem as pm
+
+pytestmark = pytest.mark.postmortem
+
+
+# ---------------------------------------------------------------------------
+# Exit-cause classification (pure)
+# ---------------------------------------------------------------------------
+
+class TestClassifyExit:
+    def test_sigkill_with_oom_evidence_is_oom_kill(self):
+        v = flightrec.classify_exit(
+            -9, oom_evidence="cgroup oom_kill count 3 (baseline 2)")
+        assert v["cause"] == "oom-kill"
+        assert v["oom"] is True
+        assert v["signal"] == 9 and v["signal_name"] == "SIGKILL"
+
+    def test_sigkill_without_evidence_is_signal(self):
+        v = flightrec.classify_exit(-9)
+        assert v["cause"] == "signal:SIGKILL"
+        assert v["oom"] is False
+
+    def test_sigsegv_with_evidence_stays_signal(self):
+        # The kernel OOM killer delivers SIGKILL; evidence next to a
+        # SIGSEGV is a neighbour's kill, not this death's cause.
+        v = flightrec.classify_exit(-11, oom_evidence="cgroup moved")
+        assert v["cause"] == "signal:SIGSEGV"
+        assert v["oom"] is False
+
+    def test_clean_exit(self):
+        v = flightrec.classify_exit(0)
+        assert v["cause"] == "clean-exit"
+        assert v["signal"] is None and v["exit_code"] == 0
+
+    def test_nonzero_exit_code(self):
+        v = flightrec.classify_exit(3)
+        assert v["cause"] == "exit:3"
+        assert v["exit_code"] == 3 and v["signal"] is None
+
+    def test_still_running(self):
+        assert flightrec.classify_exit(None)["cause"] == "running"
+
+
+class TestOomEvidence:
+    def test_cgroup_counter_parses_v2_text(self):
+        text = "low 0\nhigh 4\noom 2\noom_kill 7\noom_group_kill 0\n"
+        assert flightrec.read_cgroup_oom_count(text=text) == 7
+
+    def test_cgroup_counter_garbage_is_zero(self):
+        assert flightrec.read_cgroup_oom_count(text="nonsense\n") == 0
+        assert flightrec.read_cgroup_oom_count(
+            text="oom_kill not-a-number") == 0
+
+    def test_counter_past_baseline_convicts(self):
+        ev = flightrec.gather_oom_evidence(
+            1234, cgroup_text="oom_kill 5\n", baseline_oom_count=4)
+        assert "oom_kill count 5" in ev and "baseline 4" in ev
+
+    def test_counter_at_baseline_does_not_convict(self):
+        # Counters are cumulative: a box with historical kills must not
+        # convict every later SIGKILL.
+        assert flightrec.gather_oom_evidence(
+            1234, cgroup_text="oom_kill 5\n",
+            baseline_oom_count=5) == ""
+
+    def test_dmesg_line_naming_the_pid_convicts(self):
+        dmesg = ("[12.3] usb 1-1: new device\n"
+                 "[99.1] Out of memory: Killed process 4242 (worker)\n")
+        ev = flightrec.gather_oom_evidence(
+            4242, cgroup_text="oom_kill 0\n", dmesg_text=dmesg,
+            baseline_oom_count=0)
+        assert "Killed process 4242" in ev
+
+    def test_dmesg_other_pid_does_not_convict(self):
+        dmesg = "[99.1] Out of memory: Killed process 4242 (worker)\n"
+        assert flightrec.gather_oom_evidence(
+            7, cgroup_text="oom_kill 0\n", dmesg_text=dmesg,
+            baseline_oom_count=0) == ""
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder files
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_snapshot_final_and_read_back(self, tmp_path):
+        from ray_tpu.observability import logs as logs_mod
+        from ray_tpu.observability import timeline
+
+        rec = flightrec.install(str(tmp_path), interval_s=30.0)
+        assert rec is not None
+        try:
+            timeline.record_event("unit:span", "i",
+                                  args={"trace_id": "tid-unit"})
+            logs_mod.emit_record({"msg": "flightrec unit log line",
+                                  "level": "INFO"})
+            assert flightrec.snapshot_now() >= 1
+            # Simulate the fatal-exit path the excepthook wrappers
+            # drive (kill -9 never runs them; Python deaths do).
+            rec._write_final("unit-test",
+                             ValueError("boom"), thread="t-0")
+
+            loaded = flightrec.read_record(rec.base)
+            kinds = {r["kind"] for r in loaded["records"]}
+            assert "boot" in kinds and "events" in kinds
+            assert any(r.get("kind") == "logs"
+                       for r in loaded["records"])
+            (fin,) = loaded["final"]
+            assert fin["why"] == "unit-test"
+            assert "ValueError: boom" in fin["exc"]
+            assert fin["stacks"], "final record lost thread stacks"
+
+            evs = flightrec.record_events(loaded)
+            names = [e.get("name") for e in evs]
+            assert "unit:span" in names
+            assert "fatal:unit-test" in names
+            # The in-process log ring is shared state: a full-suite
+            # run has earlier tests' records in front of ours.
+            assert pm.last_log_lines(loaded)[-1] == \
+                "flightrec unit log line"
+            assert pm.last_log_lines(loaded, n=1) == [
+                "flightrec unit log line"]
+        finally:
+            flightrec.uninstall()
+
+    def test_truncated_ring_line_is_skipped(self, tmp_path):
+        base = str(tmp_path / "flight-1")
+        with open(base + ".jsonl", "w") as f:
+            f.write(json.dumps({"kind": "boot", "pid": 1}) + "\n")
+            f.write('{"kind": "events", "events": [{"na')  # crash cut
+        loaded = flightrec.read_record(base)
+        assert [r["kind"] for r in loaded["records"]] == ["boot"]
+
+    def test_disable_makes_snapshot_noop(self, tmp_path):
+        rec = flightrec.install(str(tmp_path), interval_s=30.0)
+        assert rec is not None
+        try:
+            flightrec.disable()
+            assert flightrec.snapshot_now() == 0
+        finally:
+            flightrec.enable()
+            flightrec.uninstall()
+
+    def test_env_kill_switch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_FLIGHTREC", "0")
+        assert flightrec.install(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# Bundle round-trip
+# ---------------------------------------------------------------------------
+
+class TestBundle:
+    def test_build_load_roundtrip(self):
+        record = {"base": "/tmp/x/flight-7",
+                  "records": [{"kind": "boot", "pid": 7}],
+                  "final": [{"kind": "final", "why": "atexit"}],
+                  "stacks": "Thread 0x1 (most recent call first):\n"}
+        report = {"incident": "inc-unit", "cause": "signal:SIGKILL"}
+        data = pm.build_bundle([record], report)
+        out = pm.load_bundle(data)
+        assert out["report"]["incident"] == "inc-unit"
+        (rec,) = out["records"]
+        assert rec["records"] == record["records"]
+        assert rec["final"] == record["final"]
+        assert rec["stacks"] == record["stacks"]
+
+
+# ---------------------------------------------------------------------------
+# Error rendering (satellite: signal= / oom= / postmortem= + last logs)
+# ---------------------------------------------------------------------------
+
+class TestErrorRendering:
+    def test_actor_died_error_names_cause_and_logs(self):
+        from ray_tpu.exceptions import ActorDiedError
+
+        err = ActorDiedError(
+            "actor-1", "actor died", node_id="abcd" * 8,
+            context={"signal": "SIGKILL", "oom": "no",
+                     "postmortem": "inc-20260807-1",
+                     "last_logs": ["pass 41 start", "pass 42 start"]})
+        s = str(err)
+        assert "signal=SIGKILL" in s
+        assert "oom=no" in s
+        assert "postmortem=inc-20260807-1" in s
+        assert "last logs from the dead process:" in s
+        assert "pass 42 start" in s
+        # The log block renders AFTER the bracket, not inside it.
+        assert s.index("]") < s.index("pass 41")
+
+    def test_report_to_context_shape(self):
+        from ray_tpu.cluster.client import ClusterClient
+
+        ctx = ClusterClient._report_to_context({
+            "incident": "inc-x", "signal_name": "SIGKILL",
+            "oom": True, "last_logs": [str(i) for i in range(9)]})
+        assert ctx["signal"] == "SIGKILL"
+        assert ctx["oom"] == "yes"
+        assert ctx["postmortem"] == "inc-x"
+        assert ctx["last_logs"] == ["4", "5", "6", "7", "8"]
+
+    def test_exit_code_report_without_signal(self):
+        from ray_tpu.cluster.client import ClusterClient
+
+        ctx = ClusterClient._report_to_context(
+            {"incident": "inc-y", "exit_code": 3, "oom": False})
+        assert ctx["exit_code"] == 3 and "signal" not in ctx
+
+
+# ---------------------------------------------------------------------------
+# top / status surfaces (satellite: incidents lane)
+# ---------------------------------------------------------------------------
+
+class TestTopIncidentsLane:
+    def test_render_top_shows_incidents(self):
+        from ray_tpu.scripts.cli import render_top
+
+        snap = {"nodes": [{"node_id": "aaaa1111", "name": "w0",
+                           "alive": True}],
+                "actors": {}, "hbm_used": {}, "hbm_limit": {},
+                "bufs": {}, "xla": {}, "occupancy": {}, "qdepth": {},
+                "train_tps": {},
+                "incidents": [
+                    {"incident": "inc-20260807-ab", "cause": "oom-kill",
+                     "node_id": "aaaa1111bbbb2222", "pid": 4242,
+                     "oom": True},
+                    {"incident": "inc-20260807-cd",
+                     "cause": "signal:SIGSEGV", "node_id": "",
+                     "pid": 7}]}
+        out = render_top(snap)
+        assert "INCIDENTS (newest first):" in out
+        assert "inc-20260807-ab  oom-kill  node aaaa1111bbbb  " \
+               "pid 4242  [oom]" in out
+        assert "inc-20260807-cd  signal:SIGSEGV  node -  pid 7" in out
+
+    def test_render_top_without_incidents_key(self):
+        # Old synthetic snapshots (and quiet clusters) have no lane.
+        from ray_tpu.scripts.cli import render_top
+
+        out = render_top({"nodes": [], "actors": {}, "hbm_used": {},
+                          "hbm_limit": {}, "bufs": {}, "xla": {},
+                          "occupancy": {}, "qdepth": {},
+                          "train_tps": {}})
+        assert "INCIDENTS" not in out
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: kill -9 mid-pass -> bundle -> merged trace -> typed error
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestKillNineSoak:
+    def test_kill_mid_pass_yields_bundle_and_named_error(
+            self, monkeypatch):
+        """Acceptance (ISSUE 18): SIGKILL one worker of a 3-process
+        DAG mid-pass.  The supervisor classifies the death, ships the
+        victim's on-disk flight record into the head artifact store,
+        and publishes a typed death report; the caller's
+        ActorDiedError names the signal and the bundle; the merged
+        trace holds the victim's lane next to >=2 survivors,
+        correlated by trace id."""
+        from ray_tpu.cluster.cluster_utils import Cluster
+        from ray_tpu.exceptions import ActorDiedError, ChannelError
+
+        # Fast ring flush so the dying pass is on disk when SIGKILL
+        # lands (workers inherit the env at spawn).
+        monkeypatch.setenv("RAY_TPU_FLIGHTREC_FLUSH_S", "0.05")
+        ray_tpu.shutdown()
+        c = Cluster()
+        procs = [c.add_node(num_cpus=2, resources={f"d{i}": 10},
+                            name=f"d{i}") for i in range(3)]
+        c.connect(num_cpus=1)
+        try:
+            rt = ray_tpu.get_runtime()
+            head_call = rt.cluster.head.call
+            nodes = {n["name"]: n["node_id"]
+                     for n in rt.cluster.list_nodes() if n["name"]}
+
+            @ray_tpu.remote
+            class Stage:
+                def step(self, x):
+                    return x + 1
+
+            from ray_tpu.dag import InputNode
+
+            with InputNode() as inp:
+                a = Stage.options(resources={"d0": 1}).bind()
+                b = Stage.options(resources={"d1": 1}).bind()
+                d = Stage.options(resources={"d2": 1}).bind()
+                dag = d.step.bind(b.step.bind(a.step.bind(inp)))
+            compiled = dag.experimental_compile()
+
+            # Warm passes: every node's lane gets trace-id-stamped
+            # spans into its flight ring (0.05s flush) and the
+            # survivors' EventShippers.
+            for i in range(20):
+                assert ray_tpu.get(compiled.execute(i),
+                                   timeout=60) == i + 3
+            time.sleep(0.5)
+
+            # Kill the MIDDLE stage's host while a pass is in flight.
+            ref = compiled.execute(100)
+            c.kill_node(procs[1])
+            err = None
+            seen = []
+            deadline = time.monotonic() + 60
+            while err is None and time.monotonic() < deadline:
+                try:
+                    ray_tpu.get(ref, timeout=10)
+                    time.sleep(0.1)
+                    ref = compiled.execute(100)
+                except (ActorDiedError, ChannelError) as e:
+                    err = e
+                except Exception as e:
+                    # The in-flight ref can die with a generic loss
+                    # error first; the NEXT pass against the dead
+                    # stage surfaces the typed one.
+                    seen.append(f"{type(e).__name__}: {e}")
+                    time.sleep(0.3)
+                    try:
+                        ref = compiled.execute(100)
+                    except (ActorDiedError, ChannelError) as e2:
+                        err = e2
+                    except Exception as e2:
+                        seen.append(f"{type(e2).__name__}: {e2}")
+                        break
+            assert err is not None, (
+                f"kill -9 never surfaced a typed error; saw {seen[-3:]}")
+
+            # Typed death report at the head, naming the bundle.
+            resp = head_call("get_death_report",
+                             {"node_id": nodes["d1"]})
+            assert resp["found"], "supervisor never shipped a report"
+            report = resp["report"]
+            assert report["cause"] in ("signal:SIGKILL", "oom-kill")
+            assert report["node_id"] == nodes["d1"]
+            art = head_call("get_artifact",
+                            {"name": report["artifact"]})
+            assert art["found"], "bundle missing from artifact store"
+
+            # The caller's error names the cause and the bundle
+            # (kill_node ships the report synchronously, so it is
+            # queryable before the error constructs; ChannelError
+            # carries the same death context as ActorDiedError).
+            s = str(err)
+            assert "signal=" in s or "oom=" in s, s
+            assert "postmortem=inc-" in s, s
+
+            # Merged trace: victim lane + >=2 survivors under one
+            # trace id.  Retry while the survivors' shippers flush.
+            merged = None
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                merged = pm.merge_incident(head_call,
+                                           report["incident"])
+                rep = merged["report"]
+                correlated = [
+                    lanes for lanes in
+                    rep["trace_processes"].values()
+                    if len(lanes) >= 3
+                    and any(l in rep["crashed_lanes"] for l in lanes)]
+                if rep["crashed_lanes"] and correlated:
+                    break
+                time.sleep(1.0)
+            rep = merged["report"]
+            assert rep["crashed_lanes"], \
+                "victim's flight record contributed no span lanes"
+            assert correlated, (
+                "no trace id correlates the victim with >=2 "
+                f"survivors: {rep['trace_processes']}")
+            assert len(rep["processes"]) >= 3
+            assert rep["events"] > 0
+
+            # Death-less capture path shares the same store + merge.
+            cap = pm.capture_incident(head_call)
+            assert cap["processes"] >= 1
+            cap_merged = pm.merge_incident(head_call, cap["incident"])
+            assert cap_merged["report"]["incident"] == cap["incident"]
+
+            # status surface: the victim's crash count is visible.
+            crashed = [n for n in rt.cluster.list_nodes()
+                       if n["node_id"] == nodes["d1"]]
+            assert crashed and crashed[0]["crashes"] >= 1
+
+            with pytest.raises(KeyError):
+                pm.merge_incident(head_call, "inc-does-not-exist")
+            compiled.teardown()
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
