@@ -1256,6 +1256,34 @@ def _head_persist_bench(n_ops: int = 400,
     return out
 
 
+def _raylint_bench() -> dict:
+    """Static-analysis cost tracking: whole-package raylint wall clock
+    (cold parse vs warm = AST-memo-served) plus the parse-cache hit
+    rate, so the analysis stays honest against its 10 s gate as rules
+    accumulate across PRs."""
+    from ray_tpu.tools import raylint
+    from ray_tpu.tools.raylint.model import _ParseCache
+
+    root = raylint.default_package_root()
+    _ParseCache._memo.clear()
+    _ParseCache.reset_stats()
+    t0 = time.perf_counter()
+    findings = raylint.run_lint(root, use_baseline=False)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    raylint.run_lint(root, use_baseline=False)
+    warm = time.perf_counter() - t0
+    stats = _ParseCache.stats()
+    total = stats["hits"] + stats["misses"]
+    return {
+        "raylint_wall_clock_s": round(cold, 3),
+        "raylint_warm_wall_clock_s": round(warm, 3),
+        "raylint_parse_cache_hit_rate": round(
+            stats["hits"] / total, 3) if total else 0.0,
+        "raylint_findings": len(findings),
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1533,6 +1561,12 @@ def main():
         extra.update(_head_persist_bench())
     except Exception as e:  # noqa: BLE001
         extra["head_persist_error"] = f"{type(e).__name__}: {e}"
+
+    print("bench: raylint phase start", file=sys.stderr, flush=True)
+    try:
+        extra.update(_raylint_bench())
+    except Exception as e:  # noqa: BLE001
+        extra["raylint_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

@@ -69,7 +69,9 @@ class _TransitionRunner:
         E = len(self.envs)
         obs, act, rew, nobs, done = [], [], [], [], []
         for _ in range(self.steps):
-            q = np.asarray(self._apply(params, self._obs))
+            # Explicit transfer: the policy net's Q values are consumed
+            # host-side immediately (argmax + env.step).
+            q = jax.device_get(self._apply(params, self._obs))
             greedy = q.argmax(-1)
             explore = self._rng.random(E) < epsilon
             actions = np.where(
@@ -205,7 +207,9 @@ class DQN(Algorithm):
 
         self.params = _init_q(jax.random.key(config.seed), self.obs_dim,
                               self.n_actions, config.hidden)
-        self.target_params = jax.tree.map(lambda x: x, self.params)
+        # Real buffer copies, not aliases: the jitted update donates
+        # params, so the target net must own distinct device buffers.
+        self.target_params = jax.tree.map(jax.numpy.copy, self.params)
         self._optimizer = optax.adam(config.lr)
         self.opt_state = self._optimizer.init(self.params)
         self._update = self._make_update()
@@ -279,7 +283,10 @@ class DQN(Algorithm):
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
-        return jax.jit(update)
+        # params/opt_state are overwritten by the call's own result
+        # (the target net, arg 1, persists across updates): donate
+        # their buffers so XLA updates the state in place.
+        return jax.jit(update, donate_argnums=(0, 2))
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -314,9 +321,10 @@ class DQN(Algorithm):
                       self.buffer.sample(cfg.train_batch_size).items()}
                 self.params, self.opt_state, loss = self._update(
                     self.params, self.target_params, self.opt_state, mb)
-            loss = float(loss)
+            loss = float(jax.device_get(loss))
         if self.iteration % cfg.target_update_freq == 0:
-            self.target_params = jax.tree.map(lambda x: x, self.params)
+            # Copy, don't alias: params buffers are donated each update.
+            self.target_params = jax.tree.map(jnp.copy, self.params)
         return {
             "episode_return_mean": (float(np.mean(self._ep_returns))
                                     if self._ep_returns

@@ -163,7 +163,12 @@ class IMPALA(Algorithm):
             params = optax.apply_updates(params, updates)
             return params, opt_state, {"total_loss": total, **aux}
 
-        return jax.jit(update)
+        # opt_state is overwritten by the returned value every
+        # fragment: donate its buffers back to XLA.  params must NOT
+        # be donated — _harvest_one re-arms runners with
+        # sample.remote(self.params) that are still in flight when the
+        # next update runs, so the old buffers are still being read.
+        return jax.jit(update, donate_argnums=(1,))
 
     # ------------------------------------------------------------- driver
     def _harvest_one(self, timeout: float = 120.0):
@@ -209,8 +214,14 @@ class IMPALA(Algorithm):
         for _ in range(cfg.fragments_per_iteration):
             batch = self._harvest_one()
             steps += int(batch["obs"].shape[0] * batch["obs"].shape[1])
+            # raylint: disable=missing-donation -- params are read by in-flight async sample.remote calls; donating them would invalidate buffers the runners still consume
             self.params, self.opt_state, stats = self._update(
                 self.params, self.opt_state, batch)
+            # One explicit transfer for the stats dict; the staleness
+            # check and the report below consume host values.
+            import jax
+
+            stats = jax.device_get(stats)
             # Off-policy (stale-weights) fragment: the importance
             # ratios moved materially away from 1 (float-noise between
             # the runner's numpy logp and the device logp is ~ulp).

@@ -161,7 +161,9 @@ class PPO(Algorithm):
             return params, opt_state, {"total_loss": total, **aux}
 
         if self._mesh is None:
-            return jax.jit(update)
+            # params/opt_state are overwritten by the returned values
+            # every minibatch: donate their buffers back to XLA.
+            return jax.jit(update, donate_argnums=(0, 1))
 
         # Mesh learner: batch shards over the data axes, params
         # replicate; XLA inserts the gradient psums (the DDP role).
@@ -174,6 +176,7 @@ class PPO(Algorithm):
         shard = NamedSharding(mesh, P(batch_axes))
         jit_update = jax.jit(
             update,
+            donate_argnums=(0, 1),
             in_shardings=(rep, rep,
                           {k: shard for k in ("obs", "actions", "logp",
                                               "advantages", "returns")}),
@@ -208,6 +211,11 @@ class PPO(Algorithm):
                 mini = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
                 self.params, self.opt_state, stats = self._update(
                     self.params, self.opt_state, mini)
+        import jax
+
+        # One explicit transfer for the whole stats dict instead of a
+        # blocking float() per entry below.
+        stats = jax.device_get(stats)
         mean_ret = (float(np.mean(self._ep_returns))
                     if self._ep_returns else float("nan"))
         return {

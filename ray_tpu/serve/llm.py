@@ -350,7 +350,9 @@ class LLMServer:
             return cache, toks, tok, lens
 
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
-        self._decode_k = jax.jit(decode_k, donate_argnums=(1,),
+        # tok_dev/len_dev (args 2, 3) are always overwritten by the
+        # returned carries at every call site: donate them too.
+        self._decode_k = jax.jit(decode_k, donate_argnums=(1, 2, 3),
                                  static_argnames=("k", "s_active"))
 
     def _make_decode_step(self, params, key_pos, active, llama, jax,
@@ -664,7 +666,10 @@ class LLMServer:
 
         self._prefill_cold = jax.jit(prefill_cold, donate_argnums=(1,))
         self._prefill_warm = jax.jit(prefill_warm, donate_argnums=(1,))
-        self._decode_paged = jax.jit(decode_paged, donate_argnums=(1,),
+        # tok_dev/len_dev (args 2, 3) are always overwritten by the
+        # returned carries at every call site: donate them too.
+        self._decode_paged = jax.jit(decode_paged,
+                                     donate_argnums=(1, 2, 3),
                                      static_argnames=("k",))
         self._inject = jax.jit(inject, donate_argnums=(0,))
         self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
@@ -1457,7 +1462,9 @@ class LLMServer:
                 self.draft_params, self.draft_cache, jnp.asarray(tok),
                 jnp.asarray(pos), jnp.asarray(active), k=int(k),
                 s_active=int(sa))
-        dtoks = np.asarray(dts)  # (k, B): d1..dk per slot
+            # Intentional blocking materialization: the verify pass
+            # below needs d1..d_{k-1} host-side to build its inputs.
+            dtoks = np.asarray(dts)  # (k, B): d1..dk per slot
         # Verify inputs: [last accepted, d1..d_{k-1}] — outputs are
         # the target's tokens for positions pos+1..pos+k, lining up
         # 1:1 with the k proposals.  (No Leviathan "bonus" token: the
@@ -1480,7 +1487,9 @@ class LLMServer:
                 self.params, self.pool, jnp.asarray(vtoks),
                 jnp.asarray(vpos), jnp.asarray(active),
                 jnp.asarray(bt))
-        g = np.asarray(g_dev)  # (B, k) target tokens for pos+1..pos+k
+            # Intentional blocking materialization: acceptance below
+            # compares draft vs target tokens on the host.
+            g = np.asarray(g_dev)  # (B, k) target tokens pos+1..pos+k
         now = time.perf_counter()
         dt = now - t0
         self._chunk_ema = (dt if self._chunk_ema is None
@@ -1682,7 +1691,11 @@ class LLMServer:
         device call completes — by then the NEXT chunk is already
         queued) and route them to their requests."""
         toks_dev, snapshot, k, t0 = pending
-        toks = np.asarray(toks_dev)  # (k, B)
+        # Declared sync boundary: this is THE pipeline's harvest
+        # point — the next chunk is already dispatched, so blocking
+        # here overlaps host routing with device compute.
+        with _device.annotation("serve.harvest_chunk"):
+            toks = np.asarray(toks_dev)  # (k, B)
         now = time.perf_counter()
         dt = now - t0
         self._chunk_ema = (dt if self._chunk_ema is None

@@ -30,13 +30,35 @@ distributed-runtime invariants the test suite can only sample:
                             re-install the envelope
 - ``exception-contract``    typed FT errors caught typed where a
                             typed handler exists for the callee
+- ``jit-in-hot-path``       jit/pjit wrappers built per call inside
+                            dispatch/decode/step loops
+- ``host-device-sync``      implicit blocking device->host transfers
+                            (float()/.item()/np.asarray/truth-tests/
+                            print) on traced values in hot paths
+- ``recompile-hazard``      per-call-varying Python scalars into
+                            non-static jitted wrappers; shape
+                            branching inside jitted bodies
+- ``missing-donation``      jitted state updates whose input buffer
+                            is dead after the call but not donated
+- ``sharding-contract``     literal partition-spec axes must name
+                            axes some constructible mesh carries
+
+The device-plane rules ride a conservative traced-value lattice
+(``model.DeviceFlow``): values provably holding ``jax.Array``\\ s —
+returns of jitted callables, device-module results, collective
+outputs — are propagated intraprocedurally and across confident
+call-graph edges, and a shared hot-path classifier
+(``model.hot_paths``) decides which methods sit on dispatch/decode/
+train loops.
 
 Suppress a finding in place::
 
     something_flagged()  # raylint: disable=<rule> -- why it is safe
 
-or grandfather pre-existing debt in ``tools/raylint_baseline.json``
-(regenerate with ``ray_tpu lint --update-baseline``).
+grandfather pre-existing debt in ``tools/raylint_baseline.json``
+(regenerate with ``ray_tpu lint --update-baseline``), or apply the
+mechanically-safe autofixes with ``ray_tpu lint --fix`` (preview with
+``--fix --diff``).
 
 Programmatic entry point: :func:`run_lint`.
 """

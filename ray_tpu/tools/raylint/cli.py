@@ -7,7 +7,9 @@ machine-readable report for CI gating; ``--format sarif`` emits SARIF
 ``--update-baseline`` grandfathers the current findings;
 ``--changed`` scopes REPORTING to git-changed files (the analysis
 stays whole-program — interprocedural rules need every file);
-``--lock-graph dot|json`` dumps the global lock-order graph.
+``--lock-graph dot|json`` dumps the global lock-order graph;
+``--fix`` applies mechanically-safe autofixes (``--diff`` previews
+them as a unified diff without writing).
 """
 
 from __future__ import annotations
@@ -61,6 +63,14 @@ def add_lint_parser(sub) -> None:
                    help="dump the global lock-acquisition-order "
                         "graph (nodes, edges with witness sites, "
                         "cycles) and exit")
+    p.add_argument("--fix", action="store_true",
+                   help="apply mechanically-safe autofixes "
+                        "(suppression-comment normalization, eager "
+                        "hot-path log formatting -> lazy %%-args) "
+                        "and exit")
+    p.add_argument("--diff", action="store_true",
+                   help="with --fix: print a unified diff instead of "
+                        "writing files")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print grandfathered findings")
     p.add_argument("--list-rules", action="store_true",
@@ -188,9 +198,38 @@ def cmd_lint(args) -> int:
               "--changed (a file-scoped run would drop every other "
               "file's baseline entries)", file=sys.stderr)
         return 2
+    if args.diff and not args.fix:
+        print("raylint: --diff requires --fix", file=sys.stderr)
+        return 2
     root = args.path or default_package_root()
     baseline_path = args.baseline or default_baseline_path(root)
     select = [s.strip() for s in args.select.split(",") if s.strip()]
+
+    if args.fix:
+        from . import fixes as fixes_mod
+
+        changed = fixes_mod.compute_fixes(root)
+        if args.diff:
+            import difflib
+
+            for relpath in sorted(changed):
+                old, new = changed[relpath]
+                sys.stdout.writelines(difflib.unified_diff(
+                    old.splitlines(keepends=True),
+                    new.splitlines(keepends=True),
+                    fromfile=f"a/{relpath}", tofile=f"b/{relpath}"))
+            print(f"raylint: --fix would change "
+                  f"{len(changed)} file(s)", file=sys.stderr)
+            return 0
+        project_dir = os.path.dirname(os.path.abspath(root)) or "."
+        for relpath in sorted(changed):
+            path = os.path.join(project_dir, relpath)
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(changed[relpath][1])
+        print(f"raylint: fixed {len(changed)} file(s)")
+        for relpath in sorted(changed):
+            print(f"  {relpath}")
+        return 0
 
     if args.lock_graph:
         from .model import ProjectModel

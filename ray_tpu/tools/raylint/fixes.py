@@ -1,0 +1,191 @@
+"""raylint ``--fix`` — mechanically-safe autofixes.
+
+Two fix classes, both chosen because the rewrite is provably
+behavior-preserving:
+
+- **suppression-syntax normalization**: any comment the suppression
+  parser already accepts (``model._SUPPRESS_RE``) is rewritten to the
+  canonical ``# raylint: disable=<r1>,<r2> -- reason`` spelling.  The
+  parse result is identical before and after, so only the bytes
+  change.
+- **eager log formatting -> lazy %-args**: a hot-path logger call
+  whose message is an f-string (``log.info(f"x {a!r}")``) or a
+  %-interpolated string (``log.info("x %s" % a)``) becomes the lazy
+  form ``log.info("x %r", a)`` / ``log.info("x %s", a)`` — the
+  ``log-hygiene`` finding's suggested fix, applied only when the
+  translation is exact: no format specs, no ``!a`` conversions, the
+  call on a single line, and no positional args already present.
+
+Anything outside those bounds is left alone — ``--fix`` must never
+produce a diff a reviewer has to think about.  Applying the fixer to
+its own output is a no-op (idempotence is tested).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .model import _SUPPRESS_RE, ProjectModel, hot_paths
+from .rules import _is_logger_call
+
+__all__ = ["compute_fixes", "apply_fixes"]
+
+
+# ------------------------------------------------------------------ comments
+def _normalize_suppression(line: str) -> Optional[str]:
+    """Canonical spelling for a suppression comment, or None when the
+    line is already canonical / is not a suppression."""
+    m = _SUPPRESS_RE.search(line)
+    if m is None:
+        return None
+    rules = ",".join(r.strip() for r in m.group(1).split(",")
+                     if r.strip())
+    reason = m.group("reason")
+    canon = f"# raylint: disable={rules}"
+    if reason is not None:
+        canon += f" -- {reason.strip()}"
+    prefix = line[:m.start()].rstrip()
+    fixed = f"{prefix}  {canon}" if prefix else canon
+    return fixed if fixed != line.rstrip("\n") else None
+
+
+# ------------------------------------------------------------------ logging
+def _fstring_to_lazy(
+        arg: ast.JoinedStr) -> Optional[Tuple[str, List[ast.expr]]]:
+    """(format string, interpolated exprs) for an exactly-translatable
+    f-string, else None."""
+    parts: List[str] = []
+    exprs: List[ast.expr] = []
+    for v in arg.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value.replace("%", "%%"))
+        elif isinstance(v, ast.FormattedValue):
+            if v.format_spec is not None:
+                return None
+            if v.conversion == ord("r"):
+                parts.append("%r")
+            elif v.conversion in (-1, ord("s")):
+                parts.append("%s")
+            else:           # !a has no %-directive twin
+                return None
+            exprs.append(v.value)
+        else:
+            return None
+    if not exprs:
+        return None         # placeholder-free: nothing to defer
+    return "".join(parts), exprs
+
+
+def _percent_to_lazy(
+        arg: ast.BinOp) -> Optional[Tuple[str, List[ast.expr]]]:
+    """("fmt" % args) -> (fmt, [args...]); the directives are already
+    %-style so the string passes through untouched."""
+    if not (isinstance(arg.op, ast.Mod)
+            and isinstance(arg.left, ast.Constant)
+            and isinstance(arg.left.value, str)):
+        return None
+    fmt = arg.left.value
+    if "%(" in fmt:
+        return None         # dict interpolation has no lazy-args twin
+    right = arg.right
+    exprs = (list(right.elts) if isinstance(right, ast.Tuple)
+             else [right])
+    if any(isinstance(e, ast.Starred) for e in exprs):
+        return None
+    return fmt, exprs
+
+
+def _lazy_call_source(node: ast.Call, fmt: str,
+                      exprs: List[ast.expr]) -> str:
+    new = ast.Call(
+        func=node.func,
+        args=[ast.Constant(fmt)] + list(exprs),
+        keywords=node.keywords)
+    return ast.unparse(ast.fix_missing_locations(
+        ast.copy_location(new, node)))
+
+
+def _log_call_edits(model: ProjectModel,
+                    info) -> List[Tuple[int, int, int, str]]:
+    """(lineno, col_start, col_end, replacement) for every exactly
+    translatable eager hot-path logger call in one module."""
+    edits: List[Tuple[int, int, int, str]] = []
+    for fi in model.functions.values():
+        if fi.module != info.name:
+            continue
+        if not hot_paths.dispatch_hot(fi.name):
+            continue
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if node.lineno != node.end_lineno:
+                continue    # multi-line: splicing is not safe
+            if len(node.args) != 1:
+                continue    # extra positional args already feed %
+            if _is_logger_call(node) is None:
+                continue
+            arg = node.args[0]
+            lazy = None
+            if isinstance(arg, ast.JoinedStr):
+                lazy = _fstring_to_lazy(arg)
+            elif isinstance(arg, ast.BinOp):
+                lazy = _percent_to_lazy(arg)
+            if lazy is None:
+                continue
+            edits.append((node.lineno, node.col_offset,
+                          node.end_col_offset,
+                          _lazy_call_source(node, *lazy)))
+    return edits
+
+
+# ------------------------------------------------------------------ driver
+def compute_fixes(root: str,
+                  model: Optional[ProjectModel] = None,
+                  ) -> Dict[str, Tuple[str, str]]:
+    """relpath -> (old_source, new_source) for every module the fixer
+    would change.  Pure: nothing is written."""
+    model = model or ProjectModel(root)
+    out: Dict[str, Tuple[str, str]] = {}
+    for name in sorted(model.modules):
+        info = model.modules[name]
+        lines = list(info.lines)
+        changed = False
+
+        by_line: Dict[int, List[Tuple[int, int, str]]] = {}
+        for lineno, c0, c1, repl in _log_call_edits(model, info):
+            by_line.setdefault(lineno, []).append((c0, c1, repl))
+        for lineno, edits in by_line.items():
+            text = lines[lineno - 1]
+            for c0, c1, repl in sorted(edits, reverse=True):
+                text = text[:c0] + repl + text[c1:]
+            if text != lines[lineno - 1]:
+                lines[lineno - 1] = text
+                changed = True
+
+        for i, text in enumerate(lines):
+            fixed = _normalize_suppression(text)
+            if fixed is not None:
+                lines[i] = fixed
+                changed = True
+
+        if changed:
+            old = "\n".join(info.lines) + "\n"
+            new = "\n".join(lines) + "\n"
+            if new != old:
+                out[info.relpath] = (old, new)
+    return out
+
+
+def apply_fixes(root: str,
+                model: Optional[ProjectModel] = None) -> List[str]:
+    """Write the fixes to disk; returns the changed relpaths."""
+    import os
+
+    project_dir = os.path.dirname(os.path.abspath(root)) or "."
+    changed = compute_fixes(root, model)
+    for relpath, (_old, new) in sorted(changed.items()):
+        path = os.path.join(project_dir, relpath)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(new)
+    return sorted(changed)

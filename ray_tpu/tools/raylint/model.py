@@ -133,6 +133,7 @@ class ProjectModel:
         self._edge_cache: Dict[Tuple[int, str],
                                Optional[Tuple[str, str]]] = {}
         self._locks: Optional[LockAnalysis] = None
+        self._flow: Optional[DeviceFlow] = None
         self._load()
         self._index()
         self._build_call_graph()
@@ -144,6 +145,14 @@ class ProjectModel:
         if self._locks is None:
             self._locks = LockAnalysis(self)
         return self._locks
+
+    def device_flow(self) -> "DeviceFlow":
+        """The traced-value (device-plane) dataflow model, built once
+        on demand — the host-device-sync / recompile-hazard /
+        missing-donation rules all read it."""
+        if self._flow is None:
+            self._flow = DeviceFlow(self)
+        return self._flow
 
     # ------------------------------------------------------------ loading
     def _load(self) -> None:
@@ -552,6 +561,10 @@ class _ParseCache:
 
     _memo: Dict[str, ast.Module] = {}
     _MAX_ENTRIES = 4096  # ~40 MiB worst case; clear-all on overflow
+    # Process-lifetime hit/miss counters: bench.py's raylint phase
+    # reports the hit rate so the memo's payoff is tracked across PRs.
+    _hits = 0
+    _misses = 0
 
     def __init__(self, enabled: bool):
         self._enabled = enabled
@@ -560,6 +573,15 @@ class _ParseCache:
     def open(cls, root: str) -> "_ParseCache":
         return cls(os.environ.get("RAY_TPU_RAYLINT_CACHE", "") != "0")
 
+    @classmethod
+    def stats(cls) -> Dict[str, int]:
+        return {"hits": cls._hits, "misses": cls._misses}
+
+    @classmethod
+    def reset_stats(cls) -> None:
+        cls._hits = 0
+        cls._misses = 0
+
     @staticmethod
     def _key(raw: bytes) -> str:
         return hashlib.sha1(raw).hexdigest()
@@ -567,7 +589,12 @@ class _ParseCache:
     def get(self, raw: bytes) -> Optional[ast.Module]:
         if not self._enabled:
             return None
-        return self._memo.get(self._key(raw))
+        tree = self._memo.get(self._key(raw))
+        if tree is None:
+            _ParseCache._misses += 1
+        else:
+            _ParseCache._hits += 1
+        return tree
 
     def put(self, raw: bytes, tree: ast.Module) -> None:
         if not self._enabled:
@@ -1027,3 +1054,1300 @@ def _shortest_path(adj: Dict[str, List[str]], src: str, dst: str,
                 nxt_frontier.append(nxt)
         frontier = nxt_frontier
     return None
+
+
+# --------------------------------------------------------------------------
+# hot-path classifier
+# --------------------------------------------------------------------------
+
+# ONE token table behind every hot-path heuristic, split into two
+# profiles.  "dispatch": per-message/per-request control-plane verbs
+# (log-hygiene's original set — eager work there is paid per op even
+# when the result is discarded).  "device": per-step/per-chunk verbs of
+# the jit/pjit hot loops (jit-in-hot-path's original set, plus the
+# fwd/bwd shorthand the pipeline stages use).  The builder exemption is
+# shared: make_train_step and friends exist to pay setup cost once.
+_DISPATCH_TOKENS = (
+    "submit", "dispatch", "enqueue", "push", "send", "put", "call",
+    "request", "recv", "handle", "deliver", "ship", "ingest", "accept",
+    "execute", "step", "read", "write", "flush", "poll", "emit",
+    "sample", "observe", "record")
+_DEVICE_TOKENS = (
+    "dispatch", "handle", "submit", "execute", "request", "recv",
+    "decode", "generate", "sample", "collect", "predict", "forward",
+    "backward", "fwd", "bwd", "step", "loop", "round", "chunk",
+    "process", "call")
+_BUILDER_TOKENS = (
+    "make", "build", "init", "create", "compile", "setup", "warmup")
+
+
+def _token_re(tokens: Tuple[str, ...]) -> "re.Pattern":
+    return re.compile(
+        r"(?:^|_)(?:" + "|".join(tokens) + r")(?:_|$)|(?:^|_)on_", re.I)
+
+
+class HotPathClassifier:
+    """Name-based hot-path classification shared by log-hygiene,
+    jit-in-hot-path, and the device-plane rules.
+
+    ``dispatch_hot``: the message/RPC dispatch plane (no builder
+    exemption — log-hygiene's historical behavior).  ``device_hot``:
+    the jit/decode/train-step plane, builder-exempt.  ``sync_hot``:
+    the union profile the host-device-sync rule uses — a blocking
+    transfer hurts on EITHER plane, but builders/warmups are sync
+    points by design."""
+
+    def __init__(self):
+        self._dispatch = _token_re(_DISPATCH_TOKENS)
+        self._device = _token_re(_DEVICE_TOKENS)
+        self._builder = re.compile(
+            r"(?:^|_)(?:" + "|".join(_BUILDER_TOKENS) + r")(?:_|$)",
+            re.I)
+
+    def is_builder(self, name: str) -> bool:
+        return bool(self._builder.search(name))
+
+    def dispatch_hot(self, name: str) -> bool:
+        return bool(self._dispatch.search(name))
+
+    def device_hot(self, name: str) -> bool:
+        return bool(self._device.search(name)) and \
+            not self.is_builder(name)
+
+    def sync_hot(self, name: str) -> bool:
+        if self.is_builder(name):
+            return False
+        return bool(self._dispatch.search(name)
+                    or self._device.search(name))
+
+
+hot_paths = HotPathClassifier()
+
+
+# --------------------------------------------------------------------------
+# device-plane dataflow: the traced-value lattice
+# --------------------------------------------------------------------------
+
+def lvalue_key(expr: ast.AST) -> Optional[str]:
+    """'self._apply' / 'cache' for Name/Attribute chains, ignoring
+    the Load/Store context."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+def jit_build_desc(info: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """'jax.jit' / 'pjit' when this call builds a jit wrapper, else
+    None.  Resolution is import-aware but tolerant of function-local
+    ``import jax`` (the name itself then reads as the module)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in ("jit", "pjit"):
+        base = f.value
+        name = (base.id if isinstance(base, ast.Name)
+                else getattr(base, "attr", ""))
+        resolved = info.imports.get(name, name)
+        if resolved == "jax" or resolved.startswith("jax."):
+            return f"{name}.{f.attr}"
+        return None
+    if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
+        resolved = info.imports.get(f.id, "")
+        if resolved.startswith("jax"):
+            return f.id
+    return None
+
+
+# Module roots whose call results live on device (the lattice's TRACED
+# generators) and the host-side numpy root (results are host values;
+# asarray/array of a traced input is the implicit-sync shape).
+_DEVICE_MODULES = ("jax", "jax.numpy", "jax.lax", "jax.random",
+                   "jax.nn", "jax.scipy", "jax.tree", "jax.tree_util",
+                   "optax")
+# jax.* calls whose results are host-side metadata (device handles,
+# counts, backend names) — NOT arrays, never a sync to consume.
+_JAX_HOST_FNS = frozenset((
+    "devices", "local_devices", "device_count", "local_device_count",
+    "process_index", "process_count", "default_backend",
+    "live_arrays", "clear_caches", "make_mesh", "debug_print"))
+# Bare-name fallbacks for function-local aliases the import table
+# can't see ("jnp = self._jnp" in the serve engine).
+_DEVICE_NAME_HINTS = {"jnp": "jax.numpy", "jax": "jax"}
+_HOST_NAME_HINTS = {"np": "numpy", "numpy": "numpy"}
+
+
+@dataclass
+class JitBuild:
+    """One ``jax.jit``/``pjit`` wrapper build site with the facts the
+    device rules need: where it lives (``key`` — 'self._update',
+    a module-level name, or None for anonymous builds that only feed
+    the jitted-body index), what it donates, and whether any arg is
+    static (bucketing evidence for recompile-hazard)."""
+    qualname: str                # function containing the build
+    module: str
+    line: int
+    desc: str                    # "jax.jit" / "pjit"
+    key: Optional[str] = None
+    donated: Tuple[int, ...] = ()
+    donate_names: bool = False
+    has_static: bool = False
+    fn_qualnames: Tuple[str, ...] = ()
+
+    def merged_with(self, other: "JitBuild") -> "JitBuild":
+        """Conservative join when one attribute can hold either of two
+        builds (a factory with a mesh and a mesh-less branch): only
+        argnums BOTH donate count as donated; static-ness of either
+        exempts (no false recompile findings)."""
+        return JitBuild(
+            qualname=self.qualname, module=self.module, line=self.line,
+            desc=self.desc, key=self.key,
+            donated=tuple(sorted(set(self.donated)
+                                 & set(other.donated))),
+            donate_names=self.donate_names or other.donate_names,
+            has_static=self.has_static or other.has_static,
+            fn_qualnames=tuple(sorted(set(self.fn_qualnames)
+                                      | set(other.fn_qualnames))))
+
+
+@dataclass
+class SyncSite:
+    """A host-forcing operation applied to a traced value."""
+    line: int
+    kind: str                    # "float()" / ".item()" / "truth-test"
+    expr: str                    # printable traced expression
+    annotated: bool              # inside a *.annotation(...) region
+
+
+@dataclass
+class WrapperArg:
+    index: int
+    key: Optional[str]           # lvalue key when Name/Attribute
+    fresh_device_temp: bool      # inline jnp.asarray(...)-style temp
+    dead_local: bool             # single-use local fed by a call
+    scalar_desc: Optional[str]   # "len(xs)" when per-call-varying
+
+
+@dataclass
+class WrapperCall:
+    """A call of a known jit wrapper, with everything missing-donation
+    / recompile-hazard need about its arguments and targets."""
+    line: int
+    build: JitBuild
+    args: List[WrapperArg]
+    kw_scalars: List[Tuple[str, str]]  # (kwarg name, scalar desc)
+    target_keys: Tuple[str, ...]       # lvalue keys when the call is
+    #                                    the RHS of an assignment
+    starred_from: Optional[int]        # index of first *args, if any
+    in_loop: bool
+
+
+@dataclass
+class ShapeBranch:
+    line: int
+    desc: str
+
+
+# A taint is False (host), True (may hold a jax.Array), or a tuple of
+# bools — one per element of a tuple-shaped value, so unpacking
+# ``toks, snapshot, t0 = pending`` taints only the device leaf, not
+# the host bookkeeping riding in the same tuple.
+Taint = object
+
+
+def _join_taint(a, b):
+    if a is True or b is True:
+        return True
+    if not a:
+        return b
+    if not b:
+        return a
+    if isinstance(a, tuple) and isinstance(b, tuple) and \
+            len(a) == len(b):
+        return tuple(x or y for x, y in zip(a, b))
+    return True
+
+
+def _taint_any(t) -> bool:
+    return any(t) if isinstance(t, tuple) else bool(t)
+
+
+@dataclass
+class FuncFlow:
+    """Per-function device-plane facts from one abstract-interpretation
+    pass: the sites rules turn into findings, plus the summary bits
+    (returns/assigns traced values) the interprocedural fixpoint
+    propagates."""
+    sync_sites: List[SyncSite] = field(default_factory=list)
+    wrapper_calls: List[WrapperCall] = field(default_factory=list)
+    returns_traced: bool = False
+    # per-element taints of literal-tuple returns; None once a traced
+    # NON-tuple return poisons the element view
+    return_tuples: List[Tuple[bool, ...]] = field(default_factory=list)
+    returns_poisoned: bool = False
+    # (class qualname, attr) assigned a traced value in this function
+    traced_attr_assigns: Set[Tuple[str, str]] = field(
+        default_factory=set)
+    # callee qualname -> {param name: taint} observed at call sites
+    callee_traced_params: Dict[str, Dict[str, Taint]] = field(
+        default_factory=dict)
+
+
+class DeviceFlow:
+    """The conservative traced-value lattice over the package.
+
+    A value is TRACED when it may hold a ``jax.Array`` (or a pytree of
+    them): the return of a jitted wrapper, a ``jnp.*``/``jax.*`` call
+    result (collectives included), a traced attribute (model params,
+    KV caches), or anything data-derived from one (subscripts, method
+    calls, arithmetic).  ``jax.device_get`` / ``float()`` / ``np.
+    asarray()`` results are HOST — the conversions themselves are the
+    implicit-sync sites host-device-sync reports.
+
+    Tracedness propagates intraprocedurally (statement-ordered, with
+    strong updates so an explicit ``device_get`` kills the taint) and
+    interprocedurally over the call graph's confident edges, exactly
+    the kinds LockAnalysis trusts: callee returns flow to caller
+    assignment targets, traced arguments flow to callee parameters,
+    traced ``self.X =`` assignments flow class-wide.  All three
+    summaries grow monotonically, so the worklist fixpoint terminates;
+    iteration is sorted everywhere for byte-identical runs."""
+
+    _PROPAGATE_KINDS = ("self", "local", "module", "import", "init")
+    _SYNC_BUILTINS = ("float", "int", "bool")
+
+    def __init__(self, model: ProjectModel):
+        self.model = model
+        # wrapper registries
+        self._attr_builds: Dict[Tuple[str, str],
+                                Dict[str, JitBuild]] = {}
+        self._local_builds: Dict[Tuple[str, str], JitBuild] = {}
+        self._module_builds: Dict[Tuple[str, str], JitBuild] = {}
+        self.builds: List[JitBuild] = []
+        self.jitted: Set[str] = set()          # jitted-body qualnames
+        self.dispatchers: Set[str] = set()     # _run(fn, *a) shims
+        self.shape_branches: Dict[str, List[ShapeBranch]] = {}
+        self.mesh_axes: Set[str] = set()       # constructible axes
+        # interprocedural summaries (monotone)
+        self.returns_traced: Set[str] = set()
+        # qualname -> per-element taints when every traced return is a
+        # literal tuple (callers unpacking it get leaf-level taint)
+        self.returns_tuple: Dict[str, Tuple[bool, ...]] = {}
+        self.param_traced: Dict[str, Dict[str, Taint]] = {}
+        self.traced_attrs: Dict[str, Set[str]] = {}
+        self.flows: Dict[str, FuncFlow] = {}
+        self._rev_edges: Dict[str, Set[str]] = {}
+        self._class_methods: Dict[str, List[str]] = {}
+
+        self._scan_builds()
+        self._scan_dispatchers()
+        self._mark_jitted_bodies()
+        self._scan_mesh_axes()
+        self._build_reverse_edges()
+        self._fixpoint()
+        self._scan_shape_branches()
+
+    # ------------------------------------------------- wrapper registry
+    def _scan_builds(self) -> None:
+        for modname in sorted(self.model.modules):
+            info = self.model.modules[modname]
+            # module-level "step = jax.jit(...)" bindings
+            for node in info.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Call):
+                    build = self._parse_build(info, None, node.value)
+                    if build is not None:
+                        build.key = node.targets[0].id
+                        self._module_builds[
+                            (modname, build.key)] = build
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            info = self.model.modules[fi.module]
+            for node in self.model.walk_own(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                build = self._parse_build(info, fi, node)
+                if build is None:
+                    continue
+                self.builds.append(build)
+            for node in self.model.walk_own(fi.node):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.value, ast.Call):
+                    build = self._parse_build(info, fi, node.value,
+                                              register=False)
+                    if build is None:
+                        continue
+                    key = lvalue_key(node.targets[0])
+                    if key is None:
+                        continue
+                    build.key = key
+                    if key.startswith("self.") and fi.cls is not None:
+                        self._register_attr(fi.module, fi.cls,
+                                            key[5:], build)
+                    elif "." not in key:
+                        self._local_builds[(qn, key)] = build
+        # attrs filled from a factory: self._update = self._make_...()
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            if fi.cls is None:
+                continue
+            info = self.model.modules[fi.module]
+            for node in self.model.walk_own(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                key = lvalue_key(node.targets[0])
+                if key is None or not key.startswith("self."):
+                    continue
+                hit = self.model._resolve_call_edge(info, fi,
+                                                    node.value)
+                if hit is None or hit[1] not in self._PROPAGATE_KINDS:
+                    continue
+                build = self._returned_build(hit[0])
+                if build is not None:
+                    self._register_attr(fi.module, fi.cls, key[5:],
+                                        build)
+
+    def _register_attr(self, module: str, cls: str, attr: str,
+                       build: JitBuild) -> None:
+        slot = self._attr_builds.setdefault((module, cls), {})
+        if attr in slot:
+            slot[attr] = slot[attr].merged_with(build)
+        else:
+            slot[attr] = build
+
+    def _parse_build(self, info: ModuleInfo, fi: Optional[FuncInfo],
+                     call: ast.Call,
+                     register: bool = True) -> Optional[JitBuild]:
+        desc = jit_build_desc(info, call)
+        if desc is None:
+            return None
+        donated: Tuple[int, ...] = ()
+        donate_names = False
+        has_static = False
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    donated = (v.value,)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    donated = tuple(
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int))
+            elif kw.arg == "donate_argnames":
+                donate_names = True
+            elif kw.arg in ("static_argnums", "static_argnames"):
+                has_static = True
+        fn_qns: List[str] = []
+        if call.args:
+            fn_qns = self._resolve_callable(info, fi, call.args[0])
+        qn = fi.qualname if fi is not None else f"{info.name}:<module>"
+        build = JitBuild(qualname=qn, module=info.name,
+                         line=call.lineno, desc=desc, donated=donated,
+                         donate_names=donate_names,
+                         has_static=has_static,
+                         fn_qualnames=tuple(fn_qns))
+        return build
+
+    def _resolve_callable(self, info: ModuleInfo,
+                          fi: Optional[FuncInfo],
+                          expr: ast.AST) -> List[str]:
+        """Project qualnames a jit build's first argument may name."""
+        if isinstance(expr, ast.Name):
+            if fi is not None:
+                hit = self.model._resolve_name_kind(info, fi, expr.id)
+                if hit is not None:
+                    return [hit[0]]
+            qn = f"{info.name}:{expr.id}"
+            if qn in self.model.functions:
+                return [qn]
+            imported = info.imports.get(expr.id)
+            if imported:
+                mod, _, sym = imported.rpartition(".")
+                qn = f"{mod}:{sym}"
+                if qn in self.model.functions:
+                    return [qn]
+        elif isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            target = info.imports.get(expr.value.id)
+            if target in self.model.modules:
+                qn = f"{target}:{expr.attr}"
+                if qn in self.model.functions:
+                    return [qn]
+        elif isinstance(expr, ast.Call):
+            # functools.partial(fn, ...) and friends: chase arg 0
+            if expr.args:
+                return self._resolve_callable(info, fi, expr.args[0])
+        return []
+
+    def _returned_build(self, qn: str) -> Optional[JitBuild]:
+        """The JitBuild a factory function returns, when its return
+        statements are jit builds (directly, or a local bound to
+        one).  Multiple return branches merge conservatively."""
+        fi = self.model.functions.get(qn)
+        if fi is None:
+            return None
+        info = self.model.modules[fi.module]
+        found: Optional[JitBuild] = None
+        for node in self.model.walk_own(fi.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            build: Optional[JitBuild] = None
+            if isinstance(node.value, ast.Call):
+                build = self._parse_build(info, fi, node.value,
+                                          register=False)
+            elif isinstance(node.value, ast.Name):
+                build = self._local_builds.get((qn, node.value.id))
+            if build is None:
+                continue
+            found = build if found is None else \
+                found.merged_with(build)
+        return found
+
+    # --------------------------------------------------- jitted bodies
+    def _scan_dispatchers(self) -> None:
+        """Functions that only forward to their first parameter
+        (``def _run(self, fn, *args): return fn(*args)``) — a wrapper
+        passed through one still counts as called."""
+        for qn in sorted(self.model.functions):
+            fi = self.model.functions[qn]
+            args = [a.arg for a in fi.node.args.args
+                    if a.arg != "self"]
+            if not args:
+                continue
+            p0 = args[0]
+            returns = [n for n in self.model.walk_own(fi.node)
+                       if isinstance(n, ast.Return)
+                       and n.value is not None]
+            if not returns:
+                continue
+            if all(isinstance(r.value, ast.Call)
+                   and isinstance(r.value.func, ast.Name)
+                   and r.value.func.id == p0 for r in returns):
+                self.dispatchers.add(qn)
+
+    def _mark_jitted_bodies(self) -> None:
+        """Every function a jit build compiles, closed transitively
+        over confident call edges: code that runs under trace cannot
+        host-sync (it would fail at trace time), so the sync rule
+        skips it wholesale."""
+        pending = set()
+        for build in self.builds:
+            pending.update(build.fn_qualnames)
+        for builds in (self._module_builds, self._local_builds):
+            for b in builds.values():
+                pending.update(b.fn_qualnames)
+        for slot in self._attr_builds.values():
+            for b in slot.values():
+                pending.update(b.fn_qualnames)
+        while pending:
+            nxt: Set[str] = set()
+            for qn in sorted(pending):
+                if qn in self.jitted:
+                    continue
+                self.jitted.add(qn)
+                for e in self.model.call_edges.get(qn, ()):
+                    if e.kind in self._PROPAGATE_KINDS and \
+                            e.target not in self.jitted:
+                        nxt.add(e.target)
+            pending = nxt
+
+    # ------------------------------------------------------- mesh axes
+    def _scan_mesh_axes(self) -> None:
+        """Axis names a mesh constructible in this package can carry:
+        ``Mesh(...)/AbstractMesh(...)`` axis tuples, ``*AXIS*``
+        module constants, and the MeshSpec/ShardingRules field
+        vocabulary.  sharding-contract checks literal PartitionSpec
+        axes against this set."""
+        def strings_in(node: ast.AST) -> List[str]:
+            """DIRECT string literals only — a ``tuple(d["axis_names"])``
+            expression contributes nothing (its subscript key is not an
+            axis name)."""
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                return [node.value]
+            if isinstance(node, (ast.Tuple, ast.List)):
+                out: List[str] = []
+                for e in node.elts:
+                    out.extend(strings_in(e))
+                return out
+            return []
+
+        for modname in sorted(self.model.modules):
+            info = self.model.modules[modname]
+            for node in ast.walk(info.tree):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        ("AXIS" in node.targets[0].id.upper()
+                         or "AXES" in node.targets[0].id.upper()) and \
+                        isinstance(node.value, (ast.Tuple, ast.List)):
+                    self.mesh_axes.update(strings_in(node.value))
+                elif isinstance(node, ast.Call):
+                    fname = (node.func.attr
+                             if isinstance(node.func, ast.Attribute)
+                             else getattr(node.func, "id", ""))
+                    if fname in ("Mesh", "AbstractMesh", "make_mesh"):
+                        for kw in node.keywords:
+                            if kw.arg == "axis_names":
+                                self.mesh_axes.update(
+                                    strings_in(kw.value))
+                        if len(node.args) >= 2:
+                            self.mesh_axes.update(
+                                strings_in(node.args[1]))
+                    elif fname in ("ShardingRules", "MeshSpec"):
+                        for kw in node.keywords:
+                            if isinstance(kw.value, ast.Constant) and \
+                                    isinstance(kw.value.value, str):
+                                self.mesh_axes.add(kw.value.value)
+                elif isinstance(node, ast.ClassDef) and \
+                        node.name in ("ShardingRules", "MeshSpec"):
+                    for item in node.body:
+                        if isinstance(item, ast.AnnAssign) and \
+                                isinstance(item.target, ast.Name):
+                            self.mesh_axes.add(item.target.id)
+                            if item.value is not None:
+                                self.mesh_axes.update(
+                                    strings_in(item.value))
+
+    # --------------------------------------------------- the fixpoint
+    def _build_reverse_edges(self) -> None:
+        for qn in sorted(self.model.call_edges):
+            for e in self.model.call_edges[qn]:
+                if e.kind in self._PROPAGATE_KINDS:
+                    self._rev_edges.setdefault(e.target,
+                                               set()).add(qn)
+        for cqn in sorted(self.model.classes):
+            ci = self.model.classes[cqn]
+            self._class_methods[cqn] = sorted(ci.methods.values())
+
+    def _fixpoint(self) -> None:
+        pending = set(self.model.functions)
+        rounds = 0
+        while pending and rounds < 24:
+            rounds += 1
+            requeue: Set[str] = set()
+            for qn in sorted(pending):
+                flow = _FlowInterp(self, qn).run()
+                self.flows[qn] = flow
+                if flow.returns_traced and \
+                        qn not in self.returns_traced:
+                    self.returns_traced.add(qn)
+                    requeue.update(self._rev_edges.get(qn, ()))
+                rt: Optional[Tuple[bool, ...]] = None
+                if flow.return_tuples and not flow.returns_poisoned:
+                    rt = flow.return_tuples[0]
+                    for t in flow.return_tuples[1:]:
+                        joined = _join_taint(rt, t)
+                        rt = joined if isinstance(joined, tuple) \
+                            else None
+                        if rt is None:
+                            break
+                if rt is not None and \
+                        self.returns_tuple.get(qn) != rt:
+                    self.returns_tuple[qn] = rt
+                    requeue.update(self._rev_edges.get(qn, ()))
+                elif rt is None and qn in self.returns_tuple:
+                    del self.returns_tuple[qn]
+                    requeue.update(self._rev_edges.get(qn, ()))
+                for cls_qn, attr in sorted(flow.traced_attr_assigns):
+                    attrs = self.traced_attrs.setdefault(cls_qn,
+                                                         set())
+                    if attr not in attrs:
+                        attrs.add(attr)
+                        requeue.update(
+                            self._class_methods.get(cls_qn, ()))
+                for callee in sorted(flow.callee_traced_params):
+                    taints = flow.callee_traced_params[callee]
+                    have = self.param_traced.setdefault(callee, {})
+                    for name in sorted(taints):
+                        new = _join_taint(have.get(name, False),
+                                          taints[name])
+                        if new != have.get(name, False):
+                            have[name] = new
+                            requeue.add(callee)
+            pending = requeue
+
+    def attr_traced(self, module: str, cls: Optional[str],
+                    attr: str) -> bool:
+        if cls is None:
+            return False
+        seen: Set[str] = set()
+        stack = [f"{module}:{cls}"]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            if attr in self.traced_attrs.get(key, ()):
+                return True
+            ci = self.model.classes.get(key)
+            if ci is None:
+                continue
+            for base in ci.bases:
+                if f"{ci.module}:{base}" in self.model.classes:
+                    stack.append(f"{ci.module}:{base}")
+        return False
+
+    def attr_build(self, module: str, cls: Optional[str],
+                   attr: str) -> Optional[JitBuild]:
+        if cls is None:
+            return None
+        seen: Set[str] = set()
+        stack = [(module, cls)]
+        while stack:
+            mk = stack.pop()
+            if mk in seen:
+                continue
+            seen.add(mk)
+            hit = self._attr_builds.get(mk, {}).get(attr)
+            if hit is not None:
+                return hit
+            ci = self.model.classes.get(f"{mk[0]}:{mk[1]}")
+            if ci is None:
+                continue
+            for base in ci.bases:
+                if f"{ci.module}:{base}" in self.model.classes:
+                    stack.append((ci.module, base))
+        return None
+
+    # ------------------------------------------------- shape branches
+    def _scan_shape_branches(self) -> None:
+        """Python ``if``/``while`` on ``.shape``/``len()`` inside
+        jitted bodies: legal (shapes are static under trace) but each
+        distinct shape class re-traces — the static half of the
+        recompile-storm signal."""
+        for qn in sorted(self.jitted):
+            fi = self.model.functions.get(qn)
+            if fi is None or hot_paths.is_builder(fi.name):
+                continue
+            sites: List[ShapeBranch] = []
+            for node in self.model.walk_own(fi.node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                for sub in ast.walk(node.test):
+                    if (isinstance(sub, ast.Attribute)
+                            and sub.attr in ("shape", "ndim")) or \
+                            (isinstance(sub, ast.Call)
+                             and isinstance(sub.func, ast.Name)
+                             and sub.func.id == "len"):
+                        try:
+                            desc = ast.unparse(node.test)
+                        except Exception:
+                            desc = "<test>"
+                        sites.append(ShapeBranch(node.lineno, desc))
+                        break
+            if sites:
+                self.shape_branches[qn] = sites
+
+
+class _FlowInterp:
+    """One statement-ordered abstract-interpretation pass over one
+    function: ``env`` maps local names and ``self.X`` keys to
+    may-be-traced, with strong updates (``stats = jax.device_get(
+    stats)`` kills the taint for everything after it).  ``if`` runs
+    both arms on copies and joins with union; loop bodies run twice so
+    a value traced at the bottom taints the top.  Side products are
+    the SyncSites and WrapperCalls the device rules read."""
+
+    def __init__(self, df: DeviceFlow, qn: str):
+        self.df = df
+        self.qn = qn
+        self.fi = df.model.functions[qn]
+        self.info = df.model.modules[self.fi.module]
+        self.flow = FuncFlow()
+        self.env: Dict[str, bool] = {}
+        # name -> per-element taints for locals known to hold a tuple
+        # (a mixed device/host bundle unpacks leaf-by-leaf)
+        self._tuples: Dict[str, Tuple[bool, ...]] = {}
+        self._ann_depth = 0
+        self._loop_depth = 0
+        self._params = [a.arg for a in self._all_args(self.fi.node)]
+        # name -> Load-occurrence count / Call-RHS-assignment count,
+        # for the dead-local judgement
+        self._loads: Dict[str, int] = {}
+        self._call_assigns: Dict[str, int] = {}
+        self._other_assigns: Dict[str, int] = {}
+        for node in df.model.walk_own(self.fi.node):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    self._loads[node.id] = \
+                        self._loads.get(node.id, 0) + 1
+            if isinstance(node, ast.Assign):
+                is_call = isinstance(node.value, ast.Call)
+                for t in node.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            slot = (self._call_assigns if is_call
+                                    else self._other_assigns)
+                            slot[sub.id] = slot.get(sub.id, 0) + 1
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                                   ast.For)):
+                tgt = getattr(node, "target", None)
+                if tgt is not None:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            self._other_assigns[sub.id] = \
+                                self._other_assigns.get(sub.id, 0) + 1
+
+    @staticmethod
+    def _all_args(node: ast.AST) -> List[ast.arg]:
+        a = node.args
+        return (list(a.posonlyargs) + list(a.args)
+                + list(a.kwonlyargs))
+
+    def run(self) -> FuncFlow:
+        seeds = self.df.param_traced.get(self.qn, {})
+        for name in sorted(seeds):
+            taint = seeds[name]
+            self.env[name] = _taint_any(taint)
+            if isinstance(taint, tuple):
+                self._tuples[name] = taint
+        self._block(self.fi.node.body)
+        return self.flow
+
+    # --------------------------------------------------- statements
+    def _block(self, stmts: List[ast.stmt]) -> None:
+        for node in stmts:
+            self._stmt(node)
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                       # executes elsewhere
+        if isinstance(node, ast.Assign):
+            tkeys = tuple(k for t in node.targets
+                          for k in self._target_keys(t))
+            traced = self._eval(node.value, targets=tkeys)
+            elems = self._value_tuple(node.value, traced)
+            for t in node.targets:
+                if elems is not None and \
+                        isinstance(t, (ast.Tuple, ast.List)) and \
+                        len(t.elts) == len(elems) and \
+                        not any(isinstance(e, ast.Starred)
+                                for e in t.elts):
+                    for e, et in zip(t.elts, elems):
+                        self._bind(e, et)
+                    continue
+                self._bind(t, traced)
+                if isinstance(t, ast.Name):
+                    if elems is not None:
+                        self._tuples[t.id] = elems
+                    else:
+                        self._tuples.pop(t.id, None)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                tkeys = tuple(self._target_keys(node.target))
+                traced = self._eval(node.value, targets=tkeys)
+                self._bind(node.target, traced)
+        elif isinstance(node, ast.AugAssign):
+            traced = self._eval(node.value)
+            key = lvalue_key(node.target)
+            if key is not None:
+                old = self._lookup(key, node.target)
+                self._set(key, old or traced, node.target)
+        elif isinstance(node, ast.Expr):
+            self._eval(node.value)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                if isinstance(node.value, ast.Tuple):
+                    elems = tuple(bool(self._eval(e))
+                                  for e in node.value.elts)
+                    self.flow.return_tuples.append(elems)
+                    if any(elems):
+                        self.flow.returns_traced = True
+                elif self._eval(node.value):
+                    self.flow.returns_traced = True
+                    # a traced non-tuple return: callers can no
+                    # longer rely on the per-element view
+                    self.flow.returns_poisoned = True
+        elif isinstance(node, (ast.If, ast.While)):
+            self._truth_test(node.test)
+            if isinstance(node, ast.While):
+                self._loop_depth += 1
+                for _ in range(2):
+                    self._block(node.body)
+                self._loop_depth -= 1
+                self._block(node.orelse)
+            else:
+                saved = dict(self.env)
+                self._block(node.body)
+                then_env = self.env
+                self.env = dict(saved)
+                self._block(node.orelse)
+                for k in sorted(set(then_env) | set(self.env)):
+                    self.env[k] = then_env.get(k, False) or \
+                        self.env.get(k, False)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            it_traced = self._eval(node.iter)
+            self._bind(node.target, it_traced)
+            self._loop_depth += 1
+            for _ in range(2):
+                self._block(node.body)
+            self._loop_depth -= 1
+            self._block(node.orelse)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            annotated = any(self._is_annotation_cm(item.context_expr)
+                            for item in node.items)
+            for item in node.items:
+                traced = self._eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, traced)
+            if annotated:
+                self._ann_depth += 1
+            self._block(node.body)
+            if annotated:
+                self._ann_depth -= 1
+        elif isinstance(node, ast.Try):
+            self._block(node.body)
+            for h in node.handlers:
+                self._block(h.body)
+            self._block(node.orelse)
+            self._block(node.finalbody)
+        elif isinstance(node, ast.Assert):
+            self._truth_test(node.test)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self._eval(node.exc)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                key = lvalue_key(t)
+                if key is not None and key in self.env:
+                    del self.env[key]
+
+    def _value_tuple(self, expr: ast.expr, traced: bool
+                     ) -> Optional[Tuple[bool, ...]]:
+        """Per-element taints when this (already-evaluated) RHS is
+        known tuple-shaped: a local carrying one, or a call whose
+        callee returns literal tuples.  No re-evaluation — the lookup
+        must not duplicate sync sites."""
+        if not traced:
+            return None
+        if isinstance(expr, ast.Name):
+            return self._tuples.get(expr.id)
+        if isinstance(expr, ast.Call):
+            edge = self.df.model._resolve_call_edge(self.info,
+                                                    self.fi, expr)
+            if edge is not None and \
+                    edge[1] in DeviceFlow._PROPAGATE_KINDS:
+                return self.df.returns_tuple.get(edge[0])
+        return None
+
+    def _target_keys(self, target: ast.AST) -> List[str]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in target.elts:
+                out.extend(self._target_keys(e))
+            return out
+        key = lvalue_key(target)
+        return [key] if key is not None else []
+
+    def _bind(self, target: ast.AST, traced: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, traced)
+            return
+        if isinstance(target, ast.Starred):
+            self._bind(target.value, traced)
+            return
+        if isinstance(target, ast.Subscript):
+            # container[k] = traced taints the container itself —
+            # self._inputs[i] = activations makes _inputs a traced
+            # store whose .pop() later yields a traced value.
+            if traced:
+                key = lvalue_key(target.value)
+                if key is not None:
+                    self._set(key, True, target.value)
+            return
+        key = lvalue_key(target)
+        if key is not None:
+            self._set(key, traced, target)
+
+    def _set(self, key: str, traced: bool, node: ast.AST) -> None:
+        self.env[key] = traced
+        if traced and key.startswith("self.") and \
+                "." not in key[5:] and self.fi.cls is not None:
+            self.flow.traced_attr_assigns.add(
+                (f"{self.fi.module}:{self.fi.cls}", key[5:]))
+
+    def _lookup(self, key: str, node: ast.AST) -> bool:
+        if key in self.env:
+            return self.env[key]
+        if key.startswith("self.") and "." not in key[5:]:
+            return self.df.attr_traced(self.fi.module, self.fi.cls,
+                                       key[5:])
+        return False
+
+    # -------------------------------------------------- expressions
+    def _truth_test(self, test: ast.expr) -> None:
+        """Truth-testing a traced value is a blocking device->host
+        read; ``x is None`` guards are identity checks and stay
+        host-side."""
+        if isinstance(test, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+            for sub in [test.left] + list(test.comparators):
+                self._eval(sub)
+            return
+        if self._eval(test):
+            self._sync(test, "truth-test", test)
+        elif isinstance(test, ast.BoolOp):
+            for v in test.values:
+                if self._eval(v):
+                    self._sync(v, "truth-test", v)
+
+    def _sync(self, node: ast.AST, kind: str,
+              expr: ast.AST) -> None:
+        try:
+            desc = ast.unparse(expr)
+        except Exception:
+            desc = "<expr>"
+        if len(desc) > 60:
+            desc = desc[:57] + "..."
+        self.flow.sync_sites.append(SyncSite(
+            line=getattr(node, "lineno", self.fi.line), kind=kind,
+            expr=desc, annotated=self._ann_depth > 0))
+
+    def _is_annotation_cm(self, expr: ast.expr) -> bool:
+        return isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            expr.func.attr == "annotation"
+
+    def _module_root(self, expr: ast.expr) -> Optional[str]:
+        """The fully-qualified module a Name/Attribute base refers to
+        ('jnp' -> 'jax.numpy'), import-table first, then the bare-name
+        conventions local aliases like ``jnp = self._jnp`` follow."""
+        if isinstance(expr, ast.Name):
+            hit = self.info.imports.get(expr.id)
+            if hit:
+                return hit
+            return _DEVICE_NAME_HINTS.get(expr.id) or \
+                _HOST_NAME_HINTS.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return {"_jnp": "jax.numpy", "_jax": "jax",
+                    "_np": "numpy"}.get(expr.attr)
+        return None
+
+    def _eval(self, expr: ast.expr,
+              targets: Tuple[str, ...] = ()) -> bool:
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, targets)
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            key = lvalue_key(expr)
+            if key is not None:
+                if key in self.env:
+                    return self.env[key]
+                if isinstance(expr, ast.Name):
+                    return False
+                return self._lookup(key, expr)
+            # attribute OF a computed value: metadata access
+            # (x.shape, x.dtype) — host-side, never a sync
+            if isinstance(expr, ast.Attribute):
+                self._eval(expr.value)
+            return False
+        if isinstance(expr, ast.Subscript):
+            traced = self._eval(expr.value)
+            self._eval(expr.slice)
+            return traced
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any([self._eval(e) for e in expr.elts])
+        if isinstance(expr, ast.Dict):
+            vals = [self._eval(v) for v in expr.values
+                    if v is not None]
+            for k in expr.keys:
+                if k is not None:
+                    self._eval(k)
+            return any(vals)
+        if isinstance(expr, ast.Starred):
+            return self._eval(expr.value)
+        if isinstance(expr, ast.BinOp):
+            left = self._eval(expr.left)
+            right = self._eval(expr.right)
+            return left or right
+        if isinstance(expr, ast.UnaryOp):
+            return self._eval(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any([self._eval(v) for v in expr.values])
+        if isinstance(expr, ast.Compare):
+            vals = [self._eval(expr.left)]
+            vals += [self._eval(c) for c in expr.comparators]
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                   ast.NotIn)) for op in expr.ops):
+                return False
+            return any(vals)
+        if isinstance(expr, ast.IfExp):
+            self._truth_test(expr.test)
+            body = self._eval(expr.body)
+            orelse = self._eval(expr.orelse)
+            return body or orelse
+        if isinstance(expr, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            return self._eval_comp(expr)
+        if isinstance(expr, ast.JoinedStr):
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._eval(v.value)
+            return False
+        if isinstance(expr, (ast.Await, ast.YieldFrom)):
+            return self._eval(expr.value)
+        if isinstance(expr, ast.Yield):
+            if expr.value is not None:
+                self._eval(expr.value)
+            return False
+        if isinstance(expr, ast.Lambda):
+            return False
+        if isinstance(expr, ast.NamedExpr):
+            traced = self._eval(expr.value)
+            self._bind(expr.target, traced)
+            return traced
+        return False
+
+    def _eval_comp(self, expr: ast.expr) -> bool:
+        saved = dict(self.env)
+        for gen in expr.generators:
+            it_traced = self._eval(gen.iter)
+            self._bind(gen.target, it_traced)
+            for cond in gen.ifs:
+                self._truth_test(cond)
+        if isinstance(expr, ast.DictComp):
+            self._eval(expr.key)
+            traced = self._eval(expr.value)
+        else:
+            traced = self._eval(expr.elt)
+        self.env = saved
+        return traced
+
+    def _fstring_traced(self, expr: ast.expr) -> bool:
+        if not isinstance(expr, ast.JoinedStr):
+            return False
+        return any(self._eval(v.value) for v in expr.values
+                   if isinstance(v, ast.FormattedValue))
+
+    # --------------------------------------------------------- calls
+    def _eval_call(self, call: ast.Call,
+                   targets: Tuple[str, ...] = ()) -> bool:
+        f = call.func
+        fname = (f.id if isinstance(f, ast.Name)
+                 else f.attr if isinstance(f, ast.Attribute) else "")
+
+        # -- explicit host/device boundary builtins ------------------
+        if isinstance(f, ast.Name):
+            if f.id in DeviceFlow._SYNC_BUILTINS and \
+                    len(call.args) == 1 and not call.keywords:
+                if self._eval(call.args[0]):
+                    self._sync(call, f"{f.id}()", call.args[0])
+                return False
+            if f.id == "print":
+                for a in call.args:
+                    if self._eval(a) or self._fstring_traced(a):
+                        self._sync(call, "print", a)
+                        break
+                for kw in call.keywords:
+                    self._eval(kw.value)
+                return False
+            if f.id == "len":
+                for a in call.args:
+                    self._eval(a)
+                return False           # shape metadata, not a sync
+
+        if isinstance(f, ast.Attribute):
+            root = self._module_root(f.value)
+            base_traced = (self._eval(f.value)
+                           if root is None else False)
+            if root is not None and (root == "numpy"
+                                     or root.startswith("numpy.")):
+                if f.attr in ("asarray", "array", "copy") and \
+                        call.args and self._eval(call.args[0]):
+                    self._sync(call, f"np.{f.attr}()", call.args[0])
+                for a in call.args[1:]:
+                    self._eval(a)
+                for kw in call.keywords:
+                    self._eval(kw.value)
+                return False
+            if root is not None and (root in _DEVICE_MODULES
+                                     or root.startswith("jax.")):
+                for a in call.args:
+                    self._eval(a)
+                for kw in call.keywords:
+                    self._eval(kw.value)
+                if f.attr == "device_get":
+                    return False       # explicit transfer: host out
+                if f.attr in _JAX_HOST_FNS:
+                    return False       # host-side metadata
+                # block_until_ready and everything else: device out
+                return True
+            if f.attr == "item" and base_traced and not call.args:
+                self._sync(call, ".item()", f.value)
+                return False
+            if f.attr == "block_until_ready" and base_traced:
+                return True
+            if base_traced:
+                # method on a traced pytree/array (.items(), .get(),
+                # .pop(), .astype(), dict views...) keeps tracedness
+                for a in call.args:
+                    self._eval(a)
+                for kw in call.keywords:
+                    self._eval(kw.value)
+                return True
+
+        # -- known jit wrapper? --------------------------------------
+        build, shifted = self._wrapper_of(call)
+        if build is not None:
+            self._record_wrapper(call, build, shifted, targets)
+            return True
+
+        # -- project call edge: propagate args in, returns out -------
+        edge = self.df.model._resolve_call_edge(self.info, self.fi,
+                                                call)
+        arg_taints: List[Taint] = []
+        for a in call.args:
+            t: Taint = self._eval(a)
+            if t and isinstance(a, ast.Name) and \
+                    a.id in self._tuples:
+                t = self._tuples[a.id]
+            arg_taints.append(t)
+        kw_traced = [(kw.arg, self._eval(kw.value))
+                     for kw in call.keywords]
+        if edge is not None and \
+                edge[1] in DeviceFlow._PROPAGATE_KINDS:
+            callee, _kind = edge
+            cfi = self.df.model.functions.get(callee)
+            if cfi is not None:
+                params = [a.arg for a in self._all_args(cfi.node)]
+                if params and params[0] == "self":
+                    params = params[1:]
+                hot: Dict[str, Taint] = {
+                    p: arg_taints[i]
+                    for i, p in enumerate(params)
+                    if i < len(arg_taints)
+                    and _taint_any(arg_taints[i])}
+                for kw, t in kw_traced:
+                    if t and kw in params:
+                        hot[kw] = True
+                if hot:
+                    slot = self.flow.callee_traced_params.setdefault(
+                        callee, {})
+                    for name in sorted(hot):
+                        slot[name] = _join_taint(
+                            slot.get(name, False), hot[name])
+            return callee in self.df.returns_traced
+        return False
+
+    def _wrapper_of(self, call: ast.Call
+                    ) -> Tuple[Optional[JitBuild], int]:
+        """(build, arg shift) when this call invokes a known jit
+        wrapper — directly, or through a ``_run(fn, *args)``-shaped
+        dispatcher whose first argument is the wrapper."""
+        build = self._build_for_expr(call.func)
+        if build is not None:
+            return build, 0
+        edge = self.df.model._resolve_call_edge(self.info, self.fi,
+                                                call)
+        if edge is not None and edge[0] in self.df.dispatchers \
+                and call.args:
+            inner = self._build_for_expr(call.args[0])
+            if inner is not None:
+                return inner, 1
+        return None, 0
+
+    def _build_for_expr(self, expr: ast.expr) -> Optional[JitBuild]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return self.df.attr_build(self.fi.module, self.fi.cls,
+                                      expr.attr)
+        if isinstance(expr, ast.Name):
+            hit = self.df._local_builds.get((self.qn, expr.id))
+            if hit is not None:
+                return hit
+            return self.df._module_builds.get(
+                (self.fi.module, expr.id))
+        return None
+
+    def _record_wrapper(self, call: ast.Call, build: JitBuild,
+                        shift: int,
+                        targets: Tuple[str, ...]) -> None:
+        args: List[WrapperArg] = []
+        starred_from: Optional[int] = None
+        for i, a in enumerate(call.args[shift:]):
+            if isinstance(a, ast.Starred):
+                if starred_from is None:
+                    starred_from = i
+                self._eval(a.value)
+                continue
+            self._eval(a)
+            args.append(WrapperArg(
+                index=i, key=lvalue_key(a),
+                fresh_device_temp=self._is_fresh_device_temp(a),
+                dead_local=self._is_dead_local(a),
+                scalar_desc=self._scalar_desc(a)))
+        kw_scalars: List[Tuple[str, str]] = []
+        for kw in call.keywords:
+            self._eval(kw.value)
+            if kw.arg is not None:
+                desc = self._scalar_desc(kw.value)
+                if desc is not None:
+                    kw_scalars.append((kw.arg, desc))
+        self.flow.wrapper_calls.append(WrapperCall(
+            line=call.lineno, build=build, args=args,
+            kw_scalars=kw_scalars, target_keys=targets,
+            starred_from=starred_from,
+            in_loop=self._loop_depth > 0))
+
+    def _is_fresh_device_temp(self, expr: ast.expr) -> bool:
+        """An inline jnp.*/jax.* call: a device value nothing else can
+        reference — dead the moment the wrapper consumes it."""
+        if not (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)):
+            return False
+        root = self._module_root(expr.func.value)
+        return root is not None and (root in _DEVICE_MODULES
+                                     or root.startswith("jax."))
+
+    def _is_dead_local(self, expr: ast.expr) -> bool:
+        """A plain local whose ONLY load is this argument, bound
+        exactly once from a call result: the buffer has no other
+        referent, so donating it is free."""
+        if not isinstance(expr, ast.Name) or self._loop_depth > 0:
+            return False
+        name = expr.id
+        if name in self._params:
+            return False
+        return (self._loads.get(name, 0) == 1
+                and self._call_assigns.get(name, 0) == 1
+                and self._other_assigns.get(name, 0) == 0)
+
+    def _scalar_desc(self, expr: ast.expr) -> Optional[str]:
+        """Per-call-varying Python scalar shapes that re-trigger
+        tracing when fed to a jitted callee as dynamic args:
+        ``len(x)``, ``int(x)``, ``x.shape[i]``."""
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Name):
+            if expr.func.id == "len" and expr.args:
+                return _safe_unparse(expr)
+            if expr.func.id == "int" and expr.args and \
+                    not isinstance(expr.args[0], ast.Constant):
+                return _safe_unparse(expr)
+        if isinstance(expr, ast.Subscript) and \
+                isinstance(expr.value, ast.Attribute) and \
+                expr.value.attr == "shape":
+            return _safe_unparse(expr)
+        return None
+
+
+def _safe_unparse(expr: ast.AST) -> str:
+    try:
+        out = ast.unparse(expr)
+    except Exception:
+        return "<expr>"
+    return out if len(out) <= 40 else out[:37] + "..."

@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .model import (FuncInfo, ModuleInfo, ProjectModel, call_desc,
+                    hot_paths, jit_build_desc, lvalue_key,
                     _short_fn, _short_key)
 from .protocol import FT_TYPED_ERRORS, ProtocolIndex
 
@@ -832,13 +833,8 @@ _LOG_METHODS = {"debug", "info", "warning", "error", "exception",
                 "critical", "log"}
 # Receivers that read as loggers ("logger", "_log", "_access_log", ...)
 _LOGGER_NAME_RE = re.compile(r"(^|_)log(ger)?s?($|_)|logger", re.I)
-# Hot/dispatch-path method names: the unbounded-mailbox token set plus
-# the execution/data-plane verbs — a record formatted EAGERLY there is
-# paid even when the level is off.
-_HOT_PATH_RE = re.compile(
-    r"(?:^|_)(submit|dispatch|enqueue|push|send|put|call|request|recv|"
-    r"handle|deliver|ship|ingest|accept|execute|step|read|write|flush|"
-    r"poll|emit|sample|observe|record)(?:_|$)|(?:^|_)on_", re.I)
+# Hot/dispatch-path classification lives in model.hot_paths — ONE
+# token table shared with jit-in-hot-path and the device-plane rules.
 # Modules where bare print() IS the interface (CLI entry points).
 _PRINT_OK_MODULE_RE = re.compile(
     r"(^|\.)((scripts|tools)(\.|$)|__main__$|worker_main$|bench)")
@@ -889,7 +885,7 @@ def rule_log_hygiene(model: ProjectModel) -> List[Finding]:
     out = _Collector(model, "log-hygiene")
     for fi in model.functions.values():
         info = model.modules[fi.module]
-        on_hot_path = bool(_HOT_PATH_RE.search(fi.name))
+        on_hot_path = hot_paths.dispatch_hot(fi.name)
         print_ok = (_PRINT_OK_MODULE_RE.search(info.name) is not None
                     or fi.name == "main")
         for node in model.walk_own(fi.node):
@@ -1025,35 +1021,10 @@ def rule_metric_cardinality(model: ProjectModel) -> List[Finding]:
 # jax.jit/pjit wrapper built THERE is built per call — each wrapper
 # owns a fresh compile cache, so every invocation re-traces and
 # recompiles (the xla-recompile-storm alert's favorite root cause).
-_JIT_HOT_RE = re.compile(
-    r"(?:^|_)(dispatch|handle|submit|execute|request|recv|decode|"
-    r"generate|sample|collect|predict|forward|backward|step|loop|"
-    r"round|chunk|process|call)(?:_|$)|(?:^|_)on_", re.I)
-# Builder/setup names trump hot tokens: make_train_step and friends
-# exist to build the jitted program once.
-_JIT_BUILDER_RE = re.compile(
-    r"(?:^|_)(make|build|init|create|compile|setup|warmup)(?:_|$)",
-    re.I)
-
-
-def _jit_call_desc(info: ModuleInfo, call: ast.Call) -> Optional[str]:
-    """'jax.jit' / 'pjit' when this call builds a jit wrapper, else
-    None.  Resolution is import-aware but tolerant of function-local
-    ``import jax`` (the name itself then reads as the module)."""
-    f = call.func
-    if isinstance(f, ast.Attribute) and f.attr in ("jit", "pjit"):
-        base = f.value
-        name = (base.id if isinstance(base, ast.Name)
-                else getattr(base, "attr", ""))
-        resolved = info.imports.get(name, name)
-        if resolved == "jax" or resolved.startswith("jax."):
-            return f"{name}.{f.attr}"
-        return None
-    if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
-        resolved = info.imports.get(f.id, "")
-        if resolved.startswith("jax"):
-            return f.id
-    return None
+# Classification (device-hot tokens, builder exemption) lives in
+# model.hot_paths; jit-build detection in model.jit_build_desc — both
+# shared with the device-plane dataflow rules.
+_jit_call_desc = jit_build_desc
 
 
 def _none_guard_target(test: ast.AST) -> Optional[ast.AST]:
@@ -1069,18 +1040,7 @@ def _none_guard_target(test: ast.AST) -> Optional[ast.AST]:
     return None
 
 
-def _lvalue_key(expr: ast.AST) -> Optional[str]:
-    """'self._apply' / 'cache' for Name/Attribute chains, ignoring
-    the Load/Store context (a guard test reads what the assignment
-    writes — ast.dump would never match the two)."""
-    parts: List[str] = []
-    while isinstance(expr, ast.Attribute):
-        parts.append(expr.attr)
-        expr = expr.value
-    if not isinstance(expr, ast.Name):
-        return None
-    parts.append(expr.id)
-    return ".".join(reversed(parts))
+_lvalue_key = lvalue_key
 
 
 def rule_jit_in_hot_path(model: ProjectModel) -> List[Finding]:
@@ -1092,8 +1052,7 @@ def rule_jit_in_hot_path(model: ProjectModel) -> List[Finding]:
     self._f = jax.jit(...)`` cached-guard pattern."""
     out = _Collector(model, "jit-in-hot-path")
     for fi in model.functions.values():
-        if not _JIT_HOT_RE.search(fi.name) \
-                or _JIT_BUILDER_RE.search(fi.name):
+        if not hot_paths.device_hot(fi.name):
             continue
         info = model.modules[fi.module]
 
@@ -1781,6 +1740,235 @@ def rule_crash_handler_safety(model: ProjectModel) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: host-device-sync
+# --------------------------------------------------------------------------
+
+def rule_host_device_sync(model: ProjectModel) -> List[Finding]:
+    """Implicit blocking device->host transfers on traced values in
+    hot-path methods: ``float()``/``int()``/``bool()``/``.item()``/
+    ``np.asarray()``/truth-testing/``print`` applied to a value the
+    dataflow lattice proves may hold a ``jax.Array``.  Each one stalls
+    the dispatch queue for a full device round-trip per call.
+    ``jax.device_get``/``block_until_ready`` are explicit boundaries
+    (exempt), and so is anything under a ``*.annotation(...)`` block —
+    the device plane's declared-sync idiom."""
+    out = _Collector(model, "host-device-sync")
+    flow = model.device_flow()
+    for qn in sorted(model.functions):
+        fi = model.functions[qn]
+        if qn in flow.jitted:
+            continue               # runs under trace — cannot sync
+        if not hot_paths.sync_hot(fi.name):
+            continue
+        ff = flow.flows.get(qn)
+        if ff is None:
+            continue
+        info = model.modules[fi.module]
+        seen: Set[Tuple[int, str, str]] = set()
+        for site in ff.sync_sites:
+            if site.annotated:
+                continue
+            key = (site.line, site.kind, site.expr)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.add(info, site.line, fi.qualname,
+                    f"{site.kind} on traced value `{site.expr}` in "
+                    f"hot-path method {fi.name!r} forces a blocking "
+                    f"device->host transfer per call — defer it off "
+                    f"the hot path, make the boundary explicit with "
+                    f"jax.device_get, or declare it with a "
+                    f"device.annotation(...) block")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: recompile-hazard
+# --------------------------------------------------------------------------
+
+def rule_recompile_hazard(model: ProjectModel) -> List[Finding]:
+    """Two static recompile-storm shapes, cross-referenced with the
+    runtime ``ray_tpu_xla_compiles`` series the device plane already
+    tracks: (a) a jitted wrapper fed per-call-varying Python scalars
+    (``len(x)``, ``int(x)``, ``x.shape[i]``) without
+    ``static_argnums``/``static_argnames`` — every distinct value is a
+    fresh trace+compile; (b) Python ``if``/``while`` on ``.shape``/
+    ``len()`` inside a jitted body — legal (shapes are static under
+    trace) but every distinct shape class re-traces, so unbucketed
+    inputs compile without bound."""
+    out = _Collector(model, "recompile-hazard")
+    flow = model.device_flow()
+    for qn in sorted(model.functions):
+        fi = model.functions[qn]
+        info = model.modules[fi.module]
+        ff = flow.flows.get(qn)
+        if ff is not None and not hot_paths.is_builder(fi.name):
+            seen: Set[Tuple[int, str]] = set()
+            for wc in ff.wrapper_calls:
+                if wc.build.has_static:
+                    continue       # bucketing/static args declared
+                descs = [a.scalar_desc for a in wc.args
+                         if a.scalar_desc is not None]
+                descs += [f"{k}={d}" for k, d in wc.kw_scalars]
+                for desc in descs:
+                    key = (wc.line, desc)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.add(info, wc.line, fi.qualname,
+                            f"jitted wrapper is fed per-call-varying "
+                            f"Python scalar `{desc}` but its "
+                            f"{wc.build.desc} build declares no "
+                            f"static_argnums/static_argnames — every "
+                            f"distinct value re-traces and recompiles "
+                            f"(watch the ray_tpu_xla_compiles series "
+                            f"climb); declare it static or bucket it")
+        for sb in flow.shape_branches.get(qn, ()):
+            out.add(info, sb.line, fi.qualname,
+                    f"shape-dependent Python branch `{sb.desc}` "
+                    f"inside a jitted body — each distinct input "
+                    f"shape traces a fresh program (the "
+                    f"ray_tpu_xla_compiles recompile-storm class); "
+                    f"bucket input shapes or branch on traced values "
+                    f"with jnp.where/lax.cond")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: missing-donation
+# --------------------------------------------------------------------------
+
+def rule_missing_donation(model: ProjectModel) -> List[Finding]:
+    """A jitted state-update call whose input buffer is provably dead
+    after the call — overwritten by the call's own result (the
+    ``params, opt = update(params, opt, ...)`` shape), a fresh inline
+    device temporary, or a single-use local — while the wrapper build
+    lacks ``donate_argnums`` for that position.  Donation lets XLA
+    alias the output into the input buffer; without it both copies
+    stay live across the call, the 2x HBM headroom class
+    ``train/optim.py`` already exploits."""
+    out = _Collector(model, "missing-donation")
+    flow = model.device_flow()
+    for qn in sorted(model.functions):
+        ff = flow.flows.get(qn)
+        if ff is None:
+            continue
+        fi = model.functions[qn]
+        info = model.modules[fi.module]
+        seen: Set[Tuple[int, int]] = set()
+        for wc in ff.wrapper_calls:
+            b = wc.build
+            if b.donate_names:
+                continue           # name-based donation: can't map
+            wname = b.key or b.desc
+            for a in wc.args:
+                if wc.starred_from is not None and \
+                        a.index >= wc.starred_from:
+                    continue       # indices past *args are unknown
+                if a.index in b.donated:
+                    continue
+                if a.key is not None and a.key in wc.target_keys:
+                    why = (f"argument {a.index} (`{a.key}`) is "
+                           f"overwritten by the call's own result")
+                elif a.fresh_device_temp and not b.donated:
+                    # A build that already donates its state arg has
+                    # made the donation decision; staging temps next
+                    # to a donated KV cache are not the 2x class.
+                    why = (f"argument {a.index} is a fresh device "
+                           f"temporary no other reference can see")
+                elif a.dead_local:
+                    why = (f"argument {a.index} (`{a.key}`) is a "
+                           f"single-use local, dead after the call")
+                else:
+                    continue
+                key = (wc.line, a.index)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.add(info, wc.line, fi.qualname,
+                        f"{why}, but the {b.desc} build of "
+                        f"`{wname}` does not donate it — add "
+                        f"donate_argnums={a.index} so XLA reuses the "
+                        f"input buffer in place (2x HBM headroom on "
+                        f"the updated state, as train/optim.py does)")
+    return out.findings
+
+
+# --------------------------------------------------------------------------
+# rule: sharding-contract
+# --------------------------------------------------------------------------
+
+_SPEC_KWARGS = {"in_specs", "out_specs", "in_shardings",
+                "out_shardings"}
+
+
+def rule_sharding_contract(model: ProjectModel) -> List[Finding]:
+    """Literal axis names in pjit/``shard_map`` partition specs (and
+    ``NamedSharding`` descriptors) must name axes some mesh
+    constructible in this package actually carries — the vocabulary
+    harvested from ``Mesh(...)`` axis tuples, ``*AXIS*`` constants,
+    and the MeshSpec/ShardingRules fields in parallel/sharding.py.  A
+    drifted axis string fails only at trace time on a real mesh;
+    non-literal specs (built through rule tables) are trusted."""
+    out = _Collector(model, "sharding-contract")
+    flow = model.device_flow()
+    axes = flow.mesh_axes
+    if not axes:
+        return out.findings        # no mesh builders: nothing to check
+    known = ", ".join(sorted(axes))
+    for qn in sorted(model.functions):
+        fi = model.functions[qn]
+        info = model.modules[fi.module]
+        for node in model.walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = (node.func.attr
+                     if isinstance(node.func, ast.Attribute)
+                     else getattr(node.func, "id", ""))
+            spec_exprs: List[ast.AST] = []
+            if fname in ("shard_map", "pjit"):
+                for kw in node.keywords:
+                    if kw.arg in _SPEC_KWARGS:
+                        spec_exprs.append(kw.value)
+            elif fname == "NamedSharding" and len(node.args) >= 2:
+                spec_exprs.append(node.args[1])
+            for spec in spec_exprs:
+                for sub in ast.walk(spec):
+                    if not (isinstance(sub, ast.Call) and
+                            (getattr(sub.func, "id", "") in
+                             ("P", "PartitionSpec")
+                             or getattr(sub.func, "attr", "") ==
+                             "PartitionSpec")):
+                        continue
+                    for bad in _bad_literal_axes(sub, axes):
+                        out.add(
+                            info, sub.lineno, fi.qualname,
+                            f"partition spec names axis "
+                            f"'{bad}' but no mesh "
+                            f"constructible in this package "
+                            f"carries it (known axes: {known}) "
+                            f"— the spec fails at trace time on "
+                            f"a real mesh")
+    return out.findings
+
+
+def _bad_literal_axes(spec_call: ast.Call,
+                      axes: Set[str]) -> List[str]:
+    """Axis strings appearing DIRECTLY in a P(...)/PartitionSpec(...)
+    call (bare literals or literal tuples — computed expressions like
+    ``P(*d['spec'])`` are trusted) that no known mesh carries."""
+    out: List[str] = []
+    for arg in spec_call.args:
+        elts = (arg.elts if isinstance(arg, (ast.Tuple, ast.List))
+                else [arg])
+        for e in elts:
+            if isinstance(e, ast.Constant) and \
+                    isinstance(e.value, str) and e.value not in axes:
+                out.append(e.value)
+    return out
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -1802,6 +1990,10 @@ RULES = {
     "rpc-protocol": rule_rpc_protocol,
     "exception-contract": rule_exception_contract,
     "crash-handler-safety": rule_crash_handler_safety,
+    "host-device-sync": rule_host_device_sync,
+    "recompile-hazard": rule_recompile_hazard,
+    "missing-donation": rule_missing_donation,
+    "sharding-contract": rule_sharding_contract,
 }
 
 RULE_DOCS = {
@@ -1921,4 +2113,34 @@ RULE_DOCS = {
         "held by the dying thread, so anything beyond flush-to-fd "
         "(os.write to a pre-opened fd) can deadlock the process "
         "during its last breath and lose the flight record."),
+    "host-device-sync": (
+        "Implicit blocking device->host transfers on traced values "
+        "(returns of jitted callables, params/caches, collective "
+        "outputs — tracked by the device-plane dataflow lattice) in "
+        "hot-path methods: float()/int()/bool()/.item()/np.asarray/"
+        "truth-testing/print each stall the dispatch queue for a "
+        "device round-trip per call.  jax.device_get and "
+        "block_until_ready are explicit boundaries; sites under a "
+        "device.annotation(...) block are declared syncs."),
+    "recompile-hazard": (
+        "Static recompile-storm shapes, the compile-time half of the "
+        "runtime ray_tpu_xla_compiles tracking: jitted wrappers fed "
+        "per-call-varying Python scalars (len/int/.shape[i]) without "
+        "static_argnums/static_argnames, and shape-dependent Python "
+        "branches inside jitted bodies — every distinct value or "
+        "shape class traces and compiles a fresh program."),
+    "missing-donation": (
+        "A jitted state-update call whose input buffer is provably "
+        "dead after the call (overwritten by the call's own result, "
+        "a fresh inline device temporary, or a single-use local) "
+        "while the jit build lacks donate_argnums for that position "
+        "— without donation both buffers stay live across the call, "
+        "halving HBM headroom on the updated state."),
+    "sharding-contract": (
+        "Literal axis names in pjit/shard_map partition specs and "
+        "NamedSharding descriptors must belong to the axis "
+        "vocabulary of meshes constructible in this package (Mesh "
+        "axis tuples, AXIS_ORDER constants, MeshSpec/ShardingRules "
+        "fields) — a drifted axis string only fails at trace time "
+        "on a real mesh."),
 }

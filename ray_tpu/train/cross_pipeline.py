@@ -93,13 +93,20 @@ class _StageWorker:
                 gp, gh = vjp(jax.numpy.ones((), jax.numpy.float32))
                 return loss, gp, gh
 
-            self._bwd = jax.jit(bwd_last)
+            # h_in is a per-microbatch staging buffer, dead after the
+            # call, and shape-matches gh: donate it.  tokens is dead
+            # too, but int32 can alias no float output — donating it
+            # only buys an XLA unusable-buffer warning.
+            self._bwd = jax.jit(bwd_last, donate_argnums=(1,))
         elif self.first:
             def bwd_first(sl, tokens, g):
                 _, vjp = jax.vjp(lambda p: fwd(p, tokens), sl)
                 (gp,) = vjp(g)
                 return gp
 
+            # No donation: the only outputs are param-shaped grads;
+            # neither tokens (int32) nor g ([B,T,D]) can alias them,
+            # so donation would be pure warning noise.
             self._bwd = jax.jit(bwd_first)
         else:
             def bwd_mid(sl, h_in, g):
@@ -107,11 +114,13 @@ class _StageWorker:
                 gp, gh = vjp(g)
                 return gp, gh
 
-            self._bwd = jax.jit(bwd_mid)
+            # gh can alias exactly one [B,T,D] input: donate h_in (g
+            # would be a second, unusable donation).
+            self._bwd = jax.jit(bwd_mid, donate_argnums=(1,))
 
         self._inputs: Dict[int, Any] = {}   # mb_idx -> stage input
         self._grad_acc: Optional[PyTree] = None
-        self._losses: List[float] = []
+        self._losses: List[Any] = []  # device scalars until apply_update
         self._n_mb = 0
 
     # ------------------------------------------------------------ helpers
@@ -159,10 +168,13 @@ class _StageWorker:
         """Last stage: loss forward + backward in one call (its output
         cotangent is available immediately)."""
         jnp = self._jax.numpy
+        # raylint: disable=missing-donation -- h_in IS donated at the bwd_last build; tokens is int32 and can alias no float output
         loss, gp, gh = self._run(self._bwd, self.params,
                                  jnp.asarray(h_in), jnp.asarray(tokens))
         self._acc(gp)
-        self._losses.append(float(loss))
+        # Keep the loss on device: one blocking materialization per
+        # optimizer step in apply_update instead of one per microbatch.
+        self._losses.append(loss)
         return self._to_host(gh)
 
     def backward(self, mb_idx: int, g_out: np.ndarray) -> np.ndarray:
@@ -170,6 +182,7 @@ class _StageWorker:
         cotangent for the upstream stage."""
         jnp = self._jax.numpy
         h_in = self._inputs.pop(mb_idx)
+        # raylint: disable=missing-donation -- h_in IS donated at the bwd_mid build; gh can alias only one [B,T,D] input, so donating g_out too would be unusable
         gp, gh = self._run(self._bwd, self.params, h_in,
                            jnp.asarray(g_out))
         self._acc(gp)
@@ -178,6 +191,7 @@ class _StageWorker:
     def backward_first(self, mb_idx: int, g_out: np.ndarray) -> bool:
         jnp = self._jax.numpy
         tokens = self._inputs.pop(mb_idx)
+        # raylint: disable=missing-donation -- bwd_first's only outputs are param-shaped grads; neither int32 tokens nor [B,T,D] g_out can alias them
         gp = self._run(self._bwd, self.params, tokens,
                        jnp.asarray(g_out))
         self._acc(gp)

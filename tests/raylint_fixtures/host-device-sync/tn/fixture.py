@@ -1,0 +1,35 @@
+"""True negatives: explicit boundaries (``jax.device_get``,
+``block_until_ready``), declared-sync ``annotation(...)`` blocks,
+host-metadata access, and syncs on NON-hot methods."""
+
+import jax
+import numpy as np
+
+
+def make_recorder():
+    return None
+
+
+class DecodeEngine:
+    def __init__(self):
+        self._step = jax.jit(lambda p, t: p @ t)
+        self._dev = make_recorder()
+
+    def decode_step(self, params, toks):
+        out = self._step(params, toks)
+        out.block_until_ready()          # explicit boundary
+        host = jax.device_get(out)       # explicit boundary
+        lat = float(host)                # host value: clean
+        rows = out.shape[0]              # metadata, no transfer
+        k = len(toks)                    # host-side length
+        with self._dev.annotation("decode.harvest"):
+            arr = np.asarray(out)        # declared sync boundary
+        if host is None:                 # identity test, no sync
+            return None
+        return lat, rows, k, arr
+
+    def summarize(self, params, toks):
+        # not a hot-path method: materializing here is the point —
+        # reporting happens off the dispatch path
+        out = self._step(params, toks)
+        return float(out)

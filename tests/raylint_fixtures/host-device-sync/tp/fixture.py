@@ -1,0 +1,28 @@
+"""True positives: implicit blocking device->host transfers on traced
+values inside hot-path methods — every one stalls the dispatch queue
+for a device round-trip per call."""
+
+import jax
+import numpy as np
+
+
+class DecodeEngine:
+    def __init__(self):
+        self._step = jax.jit(lambda p, t: p @ t)
+
+    def decode_step(self, params, toks):
+        out = self._step(params, toks)
+        lat = float(out)            # finding: float() on traced
+        n = int(out)                # finding: int() on traced
+        ok = bool(out)              # finding: bool() on traced
+        host = np.asarray(out)      # finding: np.asarray on traced
+        val = out.item()            # finding: .item() on traced
+        if out:                     # finding: truth-test on traced
+            print(out)              # finding: print of traced
+        return lat, n, ok, host, val
+
+    def handle_request(self, params, toks):
+        # the traced value flows through a second binding
+        logits = self._step(params, toks)
+        probs = logits
+        return float(probs)         # finding: alias is still traced

@@ -1,0 +1,28 @@
+"""True negatives: builds that already donate (argnums or argnames),
+and inputs that stay live after the call."""
+
+import jax
+import jax.numpy as jnp
+
+
+class Learner:
+    def __init__(self):
+        self._update = jax.jit(lambda p, s, b: (p, s),
+                               donate_argnums=(0, 1))
+        self._named = jax.jit(lambda p, b: p, donate_argnames=("p",))
+        self._embed = jax.jit(lambda t: t, donate_argnums=(0,))
+        self._infer = jax.jit(lambda p, b: b)
+
+    def train_step(self, batch):
+        # donated positions: the 2x-HBM decision is already made
+        self.params, self.opt_state = self._update(
+            self.params, self.opt_state, batch)
+        out = self._named(self.params, batch)
+        tmp = self._embed(jnp.asarray(batch))
+        return out, tmp
+
+    def eval_step(self, batch):
+        # params are read again after the call — not the dead-buffer
+        # class, donation would invalidate a live tree
+        logits = self._infer(self.params, batch)
+        return logits

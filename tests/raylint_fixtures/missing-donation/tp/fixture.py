@@ -1,0 +1,26 @@
+"""True positives: jitted state updates whose input buffers are
+provably dead after the call — overwritten by the result, fresh
+inline temporaries, single-use locals — with no ``donate_argnums``."""
+
+import jax
+import jax.numpy as jnp
+
+
+class Learner:
+    def __init__(self):
+        self._update = jax.jit(lambda p, s, b: (p, s))
+        self._embed = jax.jit(lambda t: t)
+
+    def train_step(self, batch):
+        # findings: args 0 and 1 are overwritten by the call's own
+        # result, yet the build donates nothing
+        self.params, self.opt_state = self._update(
+            self.params, self.opt_state, batch)
+        # finding: fresh inline device temporary nobody else can see
+        return self._embed(jnp.asarray(batch))
+
+    def apply_update(self):
+        # finding: `grads` is a single-use local, dead after the call
+        grads = self.collect_grads()
+        self.params, self.opt_state = self._update(
+            self.params, self.opt_state, grads)

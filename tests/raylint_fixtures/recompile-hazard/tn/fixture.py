@@ -1,0 +1,39 @@
+"""True negatives: static-declared scalar args, builder-time scalar
+feeding, traced branching via ``jnp.where``, and shape branches in
+plain host code."""
+
+import jax
+import jax.numpy as jnp
+
+
+def step(params, toks):
+    # traced select, not a Python branch: one program for all shapes
+    return jnp.where(toks.sum() > 0, params @ toks, params)
+
+
+_step = jax.jit(step)
+
+
+def host_router(batch):
+    # not jitted: host code branches on shapes freely
+    if batch.shape[0] > 128:
+        return "big"
+    return "small"
+
+
+class Runner:
+    def __init__(self, fn):
+        self._apply = jax.jit(fn, static_argnums=(1,))
+        self._bucketed = jax.jit(fn, static_argnames=("width",))
+
+    def run_step(self, params, batch):
+        # static_argnums declared: the scalar is part of the cache key
+        out = self._apply(params, len(batch))
+        out = self._bucketed(out, width=len(batch))
+        return out
+
+    def make_programs(self, fn, batch):
+        # builder-named: warming per-bucket programs with concrete
+        # sizes is exactly what builders are for
+        f = jax.jit(fn)
+        return f(len(batch))

@@ -1,0 +1,29 @@
+"""True positives: the two static recompile-storm shapes — per-call
+varying Python scalars into a non-static jitted wrapper, and
+shape-dependent Python branching inside a jitted body."""
+
+import jax
+
+
+def step(params, toks):
+    # finding: Python branch on .shape inside a jitted body — each
+    # distinct input shape traces a fresh program
+    if toks.shape[0] > 128:
+        return params @ toks
+    return params + toks
+
+
+_step = jax.jit(step)
+
+
+class Runner:
+    def __init__(self, fn):
+        self._apply = jax.jit(fn)
+
+    def run_step(self, params, batch):
+        # finding: len(batch) varies per call, build declares no
+        # static_argnums — every distinct value recompiles
+        out = self._apply(params, len(batch))
+        # finding: same for a raw dimension read
+        out = self._apply(out, batch.shape[0])
+        return out

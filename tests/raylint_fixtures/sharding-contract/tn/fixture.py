@@ -1,0 +1,25 @@
+"""True negatives: specs that name real mesh axes, replicated specs,
+and computed specs (rule tables) which are trusted."""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("data", "model")
+
+
+def make_mesh(devices):
+    return Mesh(devices, MESH_AXES)
+
+
+def shard_params(mesh, params, table):
+    good = NamedSharding(mesh, P("data", "model"))
+    rep = NamedSharding(mesh, P())              # replicated
+    dyn = NamedSharding(mesh, P(*table["spec"]))  # computed: trusted
+    return jax.device_put(params, good), rep, dyn
+
+
+def build_step(mesh, fn):
+    from jax.experimental.pjit import pjit
+
+    return pjit(fn, in_shardings=P("data"),
+                out_shardings=P(None, "model"))

@@ -1,0 +1,34 @@
+"""True positives: literal partition-spec axis names that no mesh
+constructible in this package carries — they fail only at trace time
+on a real mesh."""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("data", "model")
+
+
+def make_mesh(devices):
+    return Mesh(devices, MESH_AXES)
+
+
+def shard_params(mesh, params):
+    # finding: 'dp' is not an axis of any known mesh
+    bad = NamedSharding(mesh, P("dp"))
+    return jax.device_put(params, bad)
+
+
+def build_step(mesh, fn):
+    from jax.experimental.pjit import pjit
+
+    # finding: 'tensor' drifted from the mesh vocabulary
+    return pjit(fn, in_shardings=P("data", "tensor"),
+                out_shardings=P(None))
+
+
+def apply_map(mesh, fn):
+    from jax.experimental.shard_map import shard_map
+
+    # finding: 'rows' is not a mesh axis
+    return shard_map(fn, mesh=mesh, in_specs=P("rows"),
+                     out_specs=P("data"))

@@ -117,6 +117,21 @@ def test_shuffle_bench_phase_smoke():
         assert out["shuffle_shm_bytes"] > 0
 
 
+def test_raylint_bench_phase_smoke():
+    """The raylint phase lints the real package twice (cold parse,
+    then AST-memo-served) and reports wall clock + parse-cache hit
+    rate; the package itself must stay finding-free."""
+    from bench import _raylint_bench
+
+    out = _raylint_bench()
+    assert out["raylint_wall_clock_s"] > 0
+    assert out["raylint_warm_wall_clock_s"] > 0
+    # Second run re-reads identical bytes: every parse is memo-served,
+    # so the process-lifetime hit rate lands at ~50% for two runs.
+    assert out["raylint_parse_cache_hit_rate"] >= 0.4
+    assert out["raylint_findings"] == 0
+
+
 def test_flightrec_overhead_phase_smoke():
     """The flight-recorder overhead phase runs the paired-adjacent
     harness end to end at smoke size and emits its keys (the <5

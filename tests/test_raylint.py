@@ -454,11 +454,16 @@ def test_model_indexes_the_package():
 # ------------------------------------------------------ tier-1 self-lint
 def test_package_self_lint_clean_and_fast():
     """The acceptance gate: the whole package lints clean (zero
-    non-baselined findings) in under 10 seconds."""
+    non-baselined findings) in under 10 seconds (reference-box clock,
+    scaled by the measured box-speed factor on slow CI containers)."""
+    from conftest import box_speed_factor
+
     t0 = time.monotonic()
     findings = raylint.run_lint()
     elapsed = time.monotonic() - t0
     fresh = [f for f in findings if not f.baselined]
     assert not fresh, "raylint regressions:\n" + "\n".join(
         f.render() for f in fresh)
-    assert elapsed < 10.0, f"self-lint took {elapsed:.1f}s (budget 10s)"
+    budget = 10.0 * box_speed_factor()
+    assert elapsed < budget, \
+        f"self-lint took {elapsed:.1f}s (budget {budget:.1f}s)"

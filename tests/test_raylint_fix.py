@@ -1,0 +1,118 @@
+"""raylint ``--fix`` tests: the two autofix classes rewrite exactly
+the mechanically-safe shapes, leave everything else untouched, and
+applying the fixer to its own output is a no-op (idempotence)."""
+
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.tools import raylint
+from ray_tpu.tools.raylint import cli as raylint_cli
+from ray_tpu.tools.raylint import fixes as fixes_mod
+
+pytestmark = pytest.mark.lint
+
+
+def _mkpkg(tmp_path, src):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(src))
+    return str(pkg)
+
+
+FIXABLE = """\
+    import logging
+
+    logger = logging.getLogger(__name__)
+
+
+    class Engine:
+        def dispatch(self, task, n):
+            logger.info(f"task {task!r} fanout {n}")
+            logger.warning("retry %d for %s" % (n, task))
+            return task
+
+        def handle_request(self, req):
+            # format specs are NOT exactly translatable: left alone
+            logger.info(f"took {req.dt:.2f}s")
+            return req
+"""
+
+
+def test_fix_rewrites_eager_hot_path_logging(tmp_path):
+    root = _mkpkg(tmp_path, FIXABLE)
+    changed = fixes_mod.compute_fixes(root)
+    assert list(changed) == [os.path.join("pkg", "mod.py")]
+    _old, new = changed[os.path.join("pkg", "mod.py")]
+    assert "logger.info('task %r fanout %s', task, n)" in new
+    assert "logger.warning('retry %d for %s', n, task)" in new
+    # the format-spec f-string survives verbatim
+    assert 'f"took {req.dt:.2f}s"' in new
+
+
+def test_fix_lazy_rewrite_clears_log_hygiene_findings(tmp_path):
+    root = _mkpkg(tmp_path, FIXABLE)
+    before = [f for f in raylint.run_lint(root, use_baseline=False)
+              if f.rule == "log-hygiene"]
+    assert len(before) == 3        # two fixable + the format-spec one
+    fixes_mod.apply_fixes(root)
+    after = [f for f in raylint.run_lint(root, use_baseline=False)
+             if f.rule == "log-hygiene"]
+    assert len(after) == 1         # only the untranslatable one left
+    assert "took" in open(os.path.join(root, "mod.py")).read()
+
+
+def test_fix_normalizes_suppression_comments(tmp_path):
+    root = _mkpkg(tmp_path, """\
+        #raylint:   disable=log-hygiene , thread-hygiene --   too hot
+        X = 1
+        Y = 2  #  raylint: disable=log-hygiene--inline form
+    """)
+    changed = fixes_mod.compute_fixes(root)
+    _old, new = changed[os.path.join("pkg", "mod.py")]
+    lines = new.splitlines()
+    assert lines[0] == ("# raylint: disable=log-hygiene,thread-hygiene"
+                       " -- too hot")
+    assert lines[2] == "Y = 2  # raylint: disable=log-hygiene -- inline form"
+
+
+def test_fix_is_idempotent(tmp_path):
+    root = _mkpkg(tmp_path, FIXABLE + """\
+
+    #raylint: disable=log-hygiene --  normalize me
+    TAIL = True
+""")
+    first = fixes_mod.apply_fixes(root)
+    assert first                    # something was rewritten
+    snapshot = open(os.path.join(root, "mod.py")).read()
+    second = fixes_mod.apply_fixes(root)
+    assert second == []             # fixpoint after one application
+    assert open(os.path.join(root, "mod.py")).read() == snapshot
+
+
+def test_cli_fix_diff_previews_without_writing(tmp_path, capsys):
+    root = _mkpkg(tmp_path, FIXABLE)
+    before = open(os.path.join(root, "mod.py")).read()
+    rc = raylint_cli.main(["--fix", "--diff", root])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "-        logger.info(f\"task {task!r} fanout {n}\")" in out
+    assert "+        logger.info('task %r fanout %s', task, n)" in out
+    # preview mode: nothing written
+    assert open(os.path.join(root, "mod.py")).read() == before
+
+
+def test_cli_fix_writes_and_reports(tmp_path, capsys):
+    root = _mkpkg(tmp_path, FIXABLE)
+    rc = raylint_cli.main(["--fix", root])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fixed 1 file(s)" in out
+    assert "logger.info('task %r fanout %s', task, n)" in \
+        open(os.path.join(root, "mod.py")).read()
+
+
+def test_cli_diff_without_fix_is_usage_error(tmp_path, capsys):
+    root = _mkpkg(tmp_path, "X = 1\n")
+    assert raylint_cli.main(["--diff", root]) == 2
